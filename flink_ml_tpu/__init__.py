@@ -1,0 +1,45 @@
+"""flink_ml_tpu — a TPU-native ML pipeline framework.
+
+Brand-new design with the capabilities of Apache Flink ML (reference
+snapshot: huangchengmin97/flink-ml): Estimator/Transformer/Model/Pipeline API
+with typed params and directory save/load, an iterative training runtime with
+epoch semantics and checkpoint/resume, and an algorithm library — built
+TPU-first on JAX/XLA: jitted SPMD epoch steps over a device mesh, HBM-resident
+feedback state, ICI collectives for aggregation.
+"""
+
+from .api.stage import AlgoOperator, Estimator, Model, Stage, Transformer
+from .api.pipeline import Pipeline, PipelineModel
+from .data.table import Table
+from .linalg import DenseVector, SparseVector, Vectors
+from .distance import DistanceMeasure
+from .params.param import (
+    BoolParam,
+    DoubleArrayParam,
+    DoubleParam,
+    FloatArrayParam,
+    FloatParam,
+    IntArrayParam,
+    IntParam,
+    InvalidParamError,
+    LongParam,
+    Param,
+    ParamValidators,
+    StringArrayParam,
+    StringParam,
+    VectorParam,
+)
+from .params.with_params import WithParams
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AlgoOperator", "Estimator", "Model", "Stage", "Transformer",
+    "Pipeline", "PipelineModel", "Table",
+    "DenseVector", "SparseVector", "Vectors", "DistanceMeasure",
+    "Param", "ParamValidators", "WithParams", "InvalidParamError",
+    "BoolParam", "IntParam", "LongParam", "FloatParam", "DoubleParam",
+    "StringParam", "IntArrayParam", "FloatArrayParam", "DoubleArrayParam",
+    "StringArrayParam", "VectorParam",
+    "__version__",
+]

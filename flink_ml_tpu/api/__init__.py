@@ -1,0 +1,2 @@
+from .stage import AlgoOperator, Estimator, Model, Stage, Transformer  # noqa: F401
+from .pipeline import Pipeline, PipelineModel  # noqa: F401

@@ -1,0 +1,85 @@
+"""Pluggable-by-name distance measures.
+
+Mirror of ``flink-ml-api/.../distance/DistanceMeasure.java:27-43`` (registry
+by name, ``distance(v1, v2)``) — extended with the **batched pairwise** form
+``pairwise(points, centroids)`` which is what actually runs on the TPU: a
+single MXU matmul per metric instead of a Python double loop.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["DistanceMeasure", "register_distance_measure"]
+
+_REGISTRY: Dict[str, "DistanceMeasure"] = {}
+
+
+def register_distance_measure(name: str) -> Callable[[type], type]:
+    def deco(cls: type) -> type:
+        _REGISTRY[name] = cls()
+        cls.name = name
+        return cls
+    return deco
+
+
+class DistanceMeasure:
+    """Base class; resolve with ``DistanceMeasure.get_instance(name)``
+    (``DistanceMeasure.java:27-36``)."""
+
+    name = "base"
+
+    @staticmethod
+    def get_instance(name: str) -> "DistanceMeasure":
+        if name not in _REGISTRY:
+            raise ValueError(
+                f"distanceMeasure {name!r} is not supported; "
+                f"available: {sorted(_REGISTRY)}")
+        return _REGISTRY[name]
+
+    # -- scalar form (API parity) ------------------------------------------
+    def distance(self, v1, v2) -> float:
+        a = np.asarray(getattr(v1, "values", v1), dtype=np.float64)
+        b = np.asarray(getattr(v2, "values", v2), dtype=np.float64)
+        return float(self.pairwise(a[None, :], b[None, :])[0, 0])
+
+    # -- batched device form (the hot path) --------------------------------
+    def pairwise(self, points, centroids):
+        """``(n, d) x (k, d) -> (n, k)`` distance matrix.  Implementations are
+        jnp-traceable so they inline into jitted estimator steps."""
+        raise NotImplementedError
+
+
+@register_distance_measure("euclidean")
+class EuclideanDistanceMeasure(DistanceMeasure):
+    """``distance/EuclideanDistanceMeasure.java:36-44``.
+
+    Pairwise form uses the ||x||² - 2x·c + ||c||² expansion: the cross term is
+    one MXU matmul; relative ordering (what KMeans argmins over) is exact."""
+
+    def pairwise(self, points, centroids):
+        p2 = jnp.sum(points * points, axis=-1, keepdims=True)          # (n, 1)
+        c2 = jnp.sum(centroids * centroids, axis=-1)[None, :]          # (1, k)
+        cross = jnp.dot(points, centroids.T,
+                        preferred_element_type=jnp.float32)            # (n, k)
+        sq = jnp.maximum(p2 - 2.0 * cross + c2, 0.0)
+        return jnp.sqrt(sq)
+
+
+@register_distance_measure("cosine")
+class CosineDistanceMeasure(DistanceMeasure):
+    def pairwise(self, points, centroids):
+        pn = points / (jnp.linalg.norm(points, axis=-1, keepdims=True) + 1e-12)
+        cn = centroids / (jnp.linalg.norm(centroids, axis=-1, keepdims=True) + 1e-12)
+        return 1.0 - jnp.dot(pn, cn.T, preferred_element_type=jnp.float32)
+
+
+@register_distance_measure("manhattan")
+class ManhattanDistanceMeasure(DistanceMeasure):
+    def pairwise(self, points, centroids):
+        # (n, 1, d) - (1, k, d) — fine for moderate k; KMeans default metric
+        # is euclidean which avoids the broadcast blow-up.
+        return jnp.sum(jnp.abs(points[:, None, :] - centroids[None, :, :]), axis=-1)

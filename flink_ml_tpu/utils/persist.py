@@ -1,0 +1,144 @@
+"""Stage persistence: directory layout + reflective load.
+
+Re-design of ``util/ReadWriteUtils.java``.  The on-disk convention is kept
+compatible in spirit with the reference (``ReadWriteUtils.java:112-223``):
+
+    {path}/metadata        JSON: {className, timestamp, paramMap, extra...}
+    {path}/data/           model data files (.npz instead of Kryo streams)
+    {path}/stages/NN       pipeline children, zero-padded directory names
+
+``load_stage`` resolves the saved class name with importlib and dispatches to
+the class's ``load`` classmethod (the analog of the reflective static-load in
+``ReadWriteUtils.java:294-314``).
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import time
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "save_metadata",
+    "load_metadata",
+    "save_pipeline",
+    "load_pipeline",
+    "load_stage",
+    "load_stage_param",
+    "get_data_path",
+    "save_model_arrays",
+    "load_model_arrays",
+]
+
+
+def _class_name(obj_or_cls: Any) -> str:
+    cls = obj_or_cls if isinstance(obj_or_cls, type) else type(obj_or_cls)
+    return f"{cls.__module__}.{cls.__qualname__}"
+
+
+def _resolve_class(class_name: str) -> type:
+    module_name, _, qualname = class_name.rpartition(".")
+    module = importlib.import_module(module_name)
+    obj: Any = module
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def save_metadata(stage, path: str, extra: Optional[Dict[str, Any]] = None) -> None:
+    """Mirror of ``ReadWriteUtils.saveMetadata`` (``ReadWriteUtils.java:77-96``).
+
+    Unlike the reference (which refuses to overwrite), saving over an existing
+    directory is allowed but the metadata file is always rewritten atomically.
+    """
+    os.makedirs(path, exist_ok=True)
+    meta = dict(extra or {})
+    meta["className"] = _class_name(stage)
+    meta["timestamp"] = int(time.time() * 1000)
+    meta["paramMap"] = stage.params_to_json()
+    tmp = os.path.join(path, ".metadata.tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
+    os.replace(tmp, os.path.join(path, "metadata"))
+
+
+def load_metadata(path: str, expected_class: Optional[type] = None) -> Dict[str, Any]:
+    """Mirror of ``ReadWriteUtils.loadMetadata`` (``ReadWriteUtils.java:139-166``)."""
+    with open(os.path.join(path, "metadata")) as f:
+        meta = json.load(f)
+    if expected_class is not None:
+        expected = _class_name(expected_class)
+        if meta.get("className") != expected:
+            raise IOError(
+                f"Metadata at {path} was saved by {meta.get('className')}, "
+                f"expected {expected}")
+    return meta
+
+
+def stage_path(path: str, index: int) -> str:
+    """``{path}/stages/%02d`` zero-padded child dir
+    (``ReadWriteUtils.java:168-182``)."""
+    return os.path.join(path, "stages", f"{index:02d}")
+
+
+def save_pipeline(pipeline, stages: Sequence[Any], path: str) -> None:
+    """Mirror of ``ReadWriteUtils.savePipeline`` (``ReadWriteUtils.java:184-198``)."""
+    save_metadata(pipeline, path, {"numStages": len(stages)})
+    for i, stage in enumerate(stages):
+        stage.save(stage_path(path, i))
+
+
+def load_pipeline(path: str, expected_class: Optional[type] = None) -> List[Any]:
+    """Mirror of ``ReadWriteUtils.loadPipeline`` (``ReadWriteUtils.java:211-223``)."""
+    meta = load_metadata(path, expected_class)
+    num_stages = int(meta["numStages"])
+    return [load_stage(stage_path(path, i)) for i in range(num_stages)]
+
+
+def load_stage(path: str):
+    """Reflective dispatch to the saved class's ``load``
+    (``ReadWriteUtils.java:294-314``)."""
+    meta = load_metadata(path)
+    cls = _resolve_class(meta["className"])
+    load_fn = getattr(cls, "load", None)
+    if load_fn is None:
+        raise IOError(f"Class {meta['className']} does not implement load()")
+    return cls.load(path)
+
+
+def load_stage_param(path: str):
+    """Instantiate via no-arg constructor + restore params
+    (``ReadWriteUtils.java:258-280``) — for stages whose state is purely
+    their params."""
+    meta = load_metadata(path)
+    cls = _resolve_class(meta["className"])
+    stage = cls()
+    stage.params_from_json(meta.get("paramMap", {}))
+    return stage
+
+
+def get_data_path(path: str) -> str:
+    """``{path}/data`` (``ReadWriteUtils.java:112-118``)."""
+    return os.path.join(path, "data")
+
+
+def save_model_arrays(path: str, name: str, arrays: Dict[str, np.ndarray]) -> str:
+    """Write model data as a compressed npz under ``{path}/data/{name}.npz``
+    (replaces the reference's Kryo FileSink, ``KMeansModel.java:184-199``)."""
+    data_dir = get_data_path(path)
+    os.makedirs(data_dir, exist_ok=True)
+    out = os.path.join(data_dir, f"{name}.npz")
+    np.savez(out, **{k: np.asarray(v) for k, v in arrays.items()})
+    return out
+
+
+def load_model_arrays(path: str, name: str) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`save_model_arrays`
+    (replaces ``KMeansModel.load``'s Kryo FileSource, ``KMeansModel.java:202-213``)."""
+    with np.load(os.path.join(get_data_path(path), f"{name}.npz")) as data:
+        return {k: data[k] for k in data.files}

@@ -1,0 +1,24 @@
+"""Test harness configuration.
+
+The reference exercises multi-"node" behavior with an in-process Flink
+MiniCluster (2 TM x 2 slots, ``UnboundedStreamIterationITCase.java:155-161``).
+The TPU-native analog is a virtual 8-device CPU mesh: we force the host
+platform to expose 8 XLA devices *before* jax is imported anywhere, so every
+sharding/collective test runs real SPMD partitioning in one process.
+"""
+
+import os
+
+# Must happen before any jax import.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

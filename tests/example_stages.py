@@ -1,0 +1,64 @@
+"""Hand-written example stages for pipeline tests.
+
+Analog of the reference's ``ExampleStages.java`` (SumEstimator / SumModel
+used by ``PipelineTest.java``): a trivial estimator that sums an input column
+into model data, and a model that adds that sum to every row.
+"""
+
+from typing import List
+
+import numpy as np
+
+from flink_ml_tpu import Estimator, Model, Table, Transformer
+from flink_ml_tpu.params.param import IntParam
+from flink_ml_tpu.utils import persist
+
+
+class SumModel(Model):
+    """Adds the learned (or provided) delta to column 'x'."""
+
+    DELTA = IntParam("delta", "Value added to inputs", default=0)
+
+    def __init__(self):
+        super().__init__()
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        delta = self.get(SumModel.DELTA)
+        return [table.with_column("x", table["x"] + delta)]
+
+    def set_model_data(self, *inputs) -> "SumModel":
+        (table,) = inputs
+        self.set(SumModel.DELTA, int(table["delta"][0]))
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [Table({"delta": np.array([self.get(SumModel.DELTA)])})]
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(
+            path, "model", {"delta": np.array([self.get(SumModel.DELTA)])})
+
+    @classmethod
+    def load(cls, path: str) -> "SumModel":
+        model = persist.load_stage_param(path)
+        data = persist.load_model_arrays(path, "model")
+        model.set(SumModel.DELTA, int(data["delta"][0]))
+        return model
+
+
+class SumEstimator(Estimator[SumModel]):
+    """fit() sums column 'x' over all rows into the model delta."""
+
+    def fit(self, *inputs) -> SumModel:
+        (table,) = inputs
+        model = SumModel()
+        model.set(SumModel.DELTA, int(np.sum(table["x"])))
+        return model
+
+
+class PlusOne(Transformer):
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        return [table.with_column("x", table["x"] + 1)]

@@ -1,0 +1,165 @@
+"""Param system tests — mirror of ``StageTest.java:51-150`` (a synthetic
+stage with every param type; validators, defaults, json, save/load)."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import (
+    BoolParam,
+    DoubleArrayParam,
+    FloatParam,
+    IntArrayParam,
+    IntParam,
+    InvalidParamError,
+    ParamValidators,
+    StringArrayParam,
+    StringParam,
+    VectorParam,
+)
+from flink_ml_tpu.api.stage import Stage
+from flink_ml_tpu.params.shared import HasMaxIter, HasSeed
+from flink_ml_tpu.utils import persist
+
+
+class MyStage(Stage, HasMaxIter, HasSeed):
+    """Analog of StageTest.MyStage: one param of each type."""
+
+    BOOL_PARAM = BoolParam("boolParam", "Bool param", default=True)
+    INT_PARAM = IntParam("intParam", "Int param", default=1,
+                         validator=ParamValidators.lt(100))
+    DOUBLE_PARAM = FloatParam("doubleParam", "Double param", default=3.0,
+                              validator=ParamValidators.in_range(0.0, 10.0))
+    STRING_PARAM = StringParam("stringParam", "String param", default="5")
+    INT_ARRAY_PARAM = IntArrayParam("intArrayParam", "IntArray param",
+                                    default=(6, 7))
+    DOUBLE_ARRAY_PARAM = DoubleArrayParam("doubleArrayParam",
+                                          "DoubleArray param",
+                                          default=(10.0, 11.0))
+    STRING_ARRAY_PARAM = StringArrayParam("stringArrayParam",
+                                          "StringArray param",
+                                          default=("14", "15"))
+    VECTOR_PARAM = VectorParam("vectorParam", "Vector param",
+                               default=np.array([1.0, 2.0]))
+
+
+def test_defaults():
+    s = MyStage()
+    assert s.get(MyStage.BOOL_PARAM) is True
+    assert s.get(MyStage.INT_PARAM) == 1
+    assert s.get(MyStage.DOUBLE_PARAM) == 3.0
+    assert s.get(MyStage.STRING_PARAM) == "5"
+    assert s.get(MyStage.INT_ARRAY_PARAM) == (6, 7)
+    assert s.get("doubleArrayParam") == (10.0, 11.0)
+    assert s.get(MyStage.STRING_ARRAY_PARAM) == ("14", "15")
+    np.testing.assert_array_equal(s.get(MyStage.VECTOR_PARAM), [1.0, 2.0])
+    # inherited mixin params are discovered too (the MRO walk is the analog
+    # of the reference's interface-field reflection)
+    assert s.get_max_iter() == 20
+    assert s.get_seed() == 0
+
+
+def test_set_get_chaining():
+    s = MyStage().set(MyStage.INT_PARAM, 7).set("stringParam", "x")
+    assert s.get(MyStage.INT_PARAM) == 7
+    assert s.get(MyStage.STRING_PARAM) == "x"
+    # descriptor read access
+    assert s.INT_PARAM == 7
+
+
+def test_validators_reject():
+    s = MyStage()
+    with pytest.raises(InvalidParamError):
+        s.set(MyStage.INT_PARAM, 100)          # lt(100)
+    with pytest.raises(InvalidParamError):
+        s.set(MyStage.DOUBLE_PARAM, 10.5)      # in_range(0, 10)
+    with pytest.raises(InvalidParamError):
+        MyStage().set("noSuchParam", 1)
+
+
+def test_validator_factories():
+    v = ParamValidators
+    assert v.gt(5)(6) and not v.gt(5)(5)
+    assert v.gt_eq(5)(5) and not v.gt_eq(5)(4)
+    assert v.lt(5)(4) and not v.lt(5)(5)
+    assert v.lt_eq(5)(5) and not v.lt_eq(5)(6)
+    assert v.in_range(0, 1)(0) and not v.in_range(0, 1, lower_inclusive=False)(0)
+    assert v.in_array(["a", "b"])("a") and not v.in_array(["a"])("b")
+    assert v.not_null()(0) and not v.not_null()(None)
+    assert not v.gt(0)(None)
+
+
+def test_type_coercion():
+    s = MyStage()
+    s.set(MyStage.DOUBLE_PARAM, 4)  # int -> float
+    assert s.get(MyStage.DOUBLE_PARAM) == 4.0
+    s.set(MyStage.INT_ARRAY_PARAM, [1.0, 2.0])
+    assert s.get(MyStage.INT_ARRAY_PARAM) == (1, 2)
+    with pytest.raises(InvalidParamError):
+        s.set(MyStage.BOOL_PARAM, "yes")
+    with pytest.raises(InvalidParamError):
+        s.set(MyStage.INT_PARAM, True)  # bools are not ints here
+
+
+def test_param_map_isolation():
+    a, b = MyStage(), MyStage()
+    a.set(MyStage.INT_PARAM, 42)
+    assert b.get(MyStage.INT_PARAM) == 1
+
+
+def test_json_round_trip():
+    s = MyStage().set(MyStage.INT_PARAM, 9).set(
+        MyStage.VECTOR_PARAM, np.array([3.0, 4.0]))
+    payload = s.params_to_json()
+    restored = MyStage()
+    restored.params_from_json(payload)
+    assert restored.get(MyStage.INT_PARAM) == 9
+    np.testing.assert_array_equal(restored.get(MyStage.VECTOR_PARAM), [3.0, 4.0])
+    assert restored.get(MyStage.INT_ARRAY_PARAM) == (6, 7)
+
+
+def test_save_load_stage(tmp_path):
+    path = str(tmp_path / "stage")
+    s = MyStage().set(MyStage.INT_PARAM, 11).set(MyStage.STRING_PARAM, "hello")
+    s.save(path)
+    loaded = MyStage.load(path)
+    assert isinstance(loaded, MyStage)
+    assert loaded.get(MyStage.INT_PARAM) == 11
+    assert loaded.get(MyStage.STRING_PARAM) == "hello"
+    # generic reflective load (ReadWriteUtils.loadStage analog)
+    loaded2 = persist.load_stage(path)
+    assert isinstance(loaded2, MyStage)
+    assert loaded2.get(MyStage.INT_PARAM) == 11
+
+
+def test_metadata_class_check(tmp_path):
+    path = str(tmp_path / "stage")
+    MyStage().save(path)
+
+    class Other(Stage):
+        pass
+
+    with pytest.raises(IOError):
+        persist.load_metadata(path, Other)
+
+
+def test_set_null_validated_at_set_time():
+    # WithParams.java:91-95 rejects null at set() unless validator accepts it
+    s = MyStage()
+    with pytest.raises(InvalidParamError):
+        s.set(MyStage.INT_PARAM, None)  # lt(100) rejects None
+
+
+def test_array_param_rejects_bare_string():
+    s = MyStage()
+    with pytest.raises(InvalidParamError):
+        s.set(MyStage.STRING_ARRAY_PARAM, "abc")
+
+
+def test_set_foreign_param_object_rejected():
+    # A same-named but differently-typed Param must not create a shadow entry
+    foreign = FloatParam("intParam", "imposter")
+    s = MyStage()
+    with pytest.raises(InvalidParamError):
+        s.set(foreign, 2.5)
+    assert s.get(MyStage.INT_PARAM) == 1
+    assert s.params_to_json()["intParam"] == 1

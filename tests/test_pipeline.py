@@ -1,0 +1,70 @@
+"""Pipeline composition + persistence — mirror of ``PipelineTest.java``."""
+
+import numpy as np
+
+from flink_ml_tpu import Pipeline, PipelineModel, Table
+from flink_ml_tpu.utils import persist
+
+from example_stages import PlusOne, SumEstimator, SumModel
+
+
+def _table(values):
+    return Table({"x": np.asarray(values, dtype=np.int64)})
+
+
+def test_pipeline_fit_transform():
+    # [PlusOne, SumEstimator, PlusOne]: fit transforms inputs up to the last
+    # estimator only (Pipeline.java:74-103 semantics).
+    pipeline = Pipeline([PlusOne(), SumEstimator(), PlusOne()])
+    model = pipeline.fit(_table([1, 2, 3]))
+    assert isinstance(model, PipelineModel)
+    # SumEstimator sees [2,3,4] -> delta 9; transform: +1, +9, +1
+    out = model.transform(_table([10]))[0]
+    np.testing.assert_array_equal(out["x"], [21])
+
+
+def test_pipeline_with_trailing_estimator():
+    pipeline = Pipeline([SumEstimator()])
+    model = pipeline.fit(_table([1, 2, 3]))
+    out = model.transform(_table([0, 1]))[0]
+    np.testing.assert_array_equal(out["x"], [6, 7])
+
+
+def test_pipeline_model_chaining():
+    m1, m2 = SumModel().set("delta", 1), SumModel().set("delta", 10)
+    chained = PipelineModel([m1, m2])
+    out = chained.transform(_table([5]))[0]
+    np.testing.assert_array_equal(out["x"], [16])
+
+
+def test_pipeline_save_load(tmp_path):
+    path = str(tmp_path / "pipeline")
+    pipeline = Pipeline([PlusOne(), SumEstimator()])
+    pipeline.save(path)
+    loaded = Pipeline.load(path)
+    assert len(loaded.stages) == 2
+    assert isinstance(loaded.stages[0], PlusOne)
+    assert isinstance(loaded.stages[1], SumEstimator)
+    model = loaded.fit(_table([1, 2, 3]))
+    out = model.transform(_table([0]))[0]
+    np.testing.assert_array_equal(out["x"], [10])
+
+
+def test_pipeline_model_save_load(tmp_path):
+    path = str(tmp_path / "pm")
+    model = Pipeline([PlusOne(), SumEstimator()]).fit(_table([1, 2, 3]))
+    model.transform(_table([0]))  # exercise before save
+    model.save(path)
+    loaded = PipelineModel.load(path)
+    out = loaded.transform(_table([0]))[0]
+    np.testing.assert_array_equal(out["x"], [10])
+    # generic reflective load resolves PipelineModel from metadata
+    loaded2 = persist.load_stage(path)
+    assert isinstance(loaded2, PipelineModel)
+
+
+def test_sum_model_data_round_trip():
+    model = SumModel()
+    model.set_model_data(Table({"delta": np.array([7])}))
+    (data,) = model.get_model_data()
+    assert int(data["delta"][0]) == 7

@@ -1,0 +1,106 @@
+"""Table substrate + linalg + distance tests."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import DenseVector, DistanceMeasure, Table, Vectors
+
+
+def test_table_basics():
+    t = Table({"a": [1, 2, 3], "b": np.ones((3, 4))})
+    assert t.num_rows == 3
+    assert t.column_names == ["a", "b"]
+    assert t["b"].shape == (3, 4)
+    with pytest.raises(ValueError):
+        Table({"a": [1, 2], "b": [1, 2, 3]})
+    with pytest.raises(KeyError):
+        t.column("nope")
+
+
+def test_table_from_rows():
+    t = Table.from_rows([(1, "x"), (2, "y")], ["id", "name"])
+    np.testing.assert_array_equal(t["id"], [1, 2])
+    assert list(t["name"]) == ["x", "y"]
+    assert list(t.rows()) == [(1, "x"), (2, "y")]
+
+
+def test_table_ops():
+    t = Table({"a": [1, 2, 3, 4], "b": [10, 20, 30, 40]})
+    assert t.select("a").column_names == ["a"]
+    assert t.drop("a").column_names == ["b"]
+    assert t.rename({"a": "z"}).column_names == ["z", "b"]
+    np.testing.assert_array_equal(t.with_column("c", t["a"] * 2)["c"], [2, 4, 6, 8])
+    np.testing.assert_array_equal(t.slice(1, 3)["a"], [2, 3])
+    merged = t.concat(t)
+    assert merged.num_rows == 8
+    shuffled = t.shuffle(seed=1)
+    assert sorted(shuffled["a"].tolist()) == [1, 2, 3, 4]
+
+
+def test_table_batches_and_padding():
+    t = Table({"a": np.arange(10)})
+    batches = list(t.batches(4))
+    assert [b.num_rows for b in batches] == [4, 4, 2]
+    batches = list(t.batches(4, drop_remainder=True))
+    assert [b.num_rows for b in batches] == [4, 4]
+    padded, mask = t.pad_to_multiple(8)
+    assert padded.num_rows == 16
+    assert mask.sum() == 10
+    same, mask = Table({"a": np.arange(8)}).pad_to_multiple(8)
+    assert same.num_rows == 8 and mask.sum() == 8
+
+
+def test_dense_vector():
+    v = Vectors.dense(1.0, 2.0, 3.0)
+    assert v.size() == 3
+    assert v.get(1) == 2.0
+    np.testing.assert_array_equal(v.to_array(), [1.0, 2.0, 3.0])
+    assert v == DenseVector([1, 2, 3])
+    assert Vectors.dense([4.0, 5.0]) == DenseVector([4, 5])
+
+
+def test_sparse_vector():
+    v = Vectors.sparse(5, [1, 3], [2.0, 4.0])
+    assert v.size() == 5
+    assert v.get(3) == 4.0 and v.get(0) == 0.0
+    np.testing.assert_array_equal(v.to_array(), [0, 2, 0, 4, 0])
+
+
+def test_distance_registry():
+    m = DistanceMeasure.get_instance("euclidean")
+    assert m.distance(Vectors.dense(0, 0), Vectors.dense(3, 4)) == pytest.approx(5.0)
+    with pytest.raises(ValueError):
+        DistanceMeasure.get_instance("nope")
+
+
+def test_pairwise_distances():
+    m = DistanceMeasure.get_instance("euclidean")
+    pts = np.array([[0.0, 0.0], [1.0, 1.0]])
+    cents = np.array([[0.0, 0.0], [3.0, 4.0]])
+    d = np.asarray(m.pairwise(pts, cents))
+    np.testing.assert_allclose(d[0], [0.0, 5.0], atol=1e-5)
+    np.testing.assert_allclose(d[1, 0], np.sqrt(2), atol=1e-5)
+
+    man = DistanceMeasure.get_instance("manhattan")
+    d = np.asarray(man.pairwise(pts, cents))
+    np.testing.assert_allclose(d[1], [2.0, 5.0], atol=1e-5)
+
+    cos = DistanceMeasure.get_instance("cosine")
+    d = np.asarray(cos.pairwise(np.array([[1.0, 0.0]]), np.array([[0.0, 2.0], [2.0, 0.0]])))
+    np.testing.assert_allclose(d[0], [1.0, 0.0], atol=1e-5)
+
+
+def test_batches_rejects_nonpositive():
+    t = Table({"a": np.arange(4)})
+    with pytest.raises(ValueError):
+        list(t.batches(0))
+    with pytest.raises(ValueError):
+        list(t.batches(-1))
+
+
+def test_stack_vectors_shapes():
+    from flink_ml_tpu.linalg import stack_vectors
+    # 1-D numeric column = n scalar samples -> (n, 1)
+    assert stack_vectors(np.arange(5.0)).shape == (5, 1)
+    assert stack_vectors(np.ones((3, 4))).shape == (3, 4)
+    assert stack_vectors([DenseVector([1, 2]), DenseVector([3, 4])]).shape == (2, 2)
