@@ -1,0 +1,15 @@
+from .body import (  # noqa: F401
+    EpochContext,
+    FnListener,
+    IterationBodyResult,
+    IterationConfig,
+    IterationListener,
+    OperatorLifeCycle,
+)
+from .checkpoint import (  # noqa: F401
+    CheckpointConfig,
+    CheckpointManager,
+    load_pytree,
+    save_pytree,
+)
+from .core import IterationResult, iterate  # noqa: F401

@@ -1,0 +1,153 @@
+"""Iteration body contract: result type, listeners, epoch context.
+
+Re-design of the reference's iteration API surface
+(``IterationBody.java:54-98``, ``IterationBodyResult.java:28-76``,
+``IterationListener.java:30-74``, ``IterationConfig.java:22-66``).
+
+The body is a function, not a graph: ``body(state, epoch, data) ->
+IterationBodyResult``.  ``state`` is the feedback-variable pytree — the
+TPU-native feedback edge is simply that this pytree never leaves HBM between
+epochs (donated jit buffers), replacing the reference's StateFun
+FeedbackChannel + Tail/Head operators.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import jax
+
+__all__ = [
+    "IterationBodyResult",
+    "IterationListener",
+    "EpochContext",
+    "OperatorLifeCycle",
+    "IterationConfig",
+    "normalize_body_result",
+]
+
+
+class OperatorLifeCycle(enum.Enum):
+    """``IterationConfig.OperatorLifeCycle`` (``IterationConfig.java:22-66``):
+    ALL_ROUND state is carried across epochs; PER_ROUND state is functionally
+    re-initialised every epoch (the analog of the reference physically
+    scrubbing per-round operator state,
+    ``perround/AbstractPerRoundWrapperOperator.java:579-650``)."""
+
+    ALL_ROUND = "all_round"
+    PER_ROUND = "per_round"
+
+
+@dataclass
+class IterationConfig:
+    """Mirror of ``IterationConfig.java`` extended with the TPU-native knobs
+    the driver loop needs."""
+
+    lifecycle: OperatorLifeCycle = OperatorLifeCycle.ALL_ROUND
+    max_epochs: Optional[int] = None
+    # "hosted": python epoch loop around a jitted step (listeners, streaming
+    #           data, checkpoints). "fused": whole loop on device via
+    #           lax.scan/while_loop (no per-epoch host round-trip at all).
+    # "auto": fused when there are no listeners/checkpoints/streaming data.
+    mode: str = "auto"
+    jit: bool = True
+    # Donate the state buffers to the jitted step so the feedback pytree is
+    # updated in place in HBM (flat memory across epochs).
+    donate_state: bool = True
+
+    def __post_init__(self):
+        if self.mode not in ("auto", "hosted", "fused"):
+            raise ValueError(f"Unknown iteration mode {self.mode!r}")
+
+
+@dataclass
+class IterationBodyResult:
+    """(feedback, outputs, termination) — mirror of
+    ``IterationBodyResult.java:28-76``.
+
+    - ``feedback``: next-epoch variable state (pytree).
+    - ``outputs``: per-epoch emission (pytree or None).
+    - ``termination``: optional scalar vote. Truthy / nonzero means "records
+      still flowing — continue"; the iteration terminates on a zero vote,
+      mirroring the aligner's zero-feedback-records rule
+      (``SharedProgressAligner.java:277-300``).
+    """
+
+    feedback: Any
+    outputs: Any = None
+    termination: Optional[Any] = None
+
+
+def _result_flatten(res: IterationBodyResult):
+    return (res.feedback, res.outputs, res.termination), None
+
+
+def _result_unflatten(_, children):
+    return IterationBodyResult(*children)
+
+
+jax.tree_util.register_pytree_node(
+    IterationBodyResult, _result_flatten, _result_unflatten)
+
+
+def normalize_body_result(result: Any) -> IterationBodyResult:
+    """Accept ``IterationBodyResult`` or a bare state pytree (which may
+    itself be a tuple — bare returns are never unpacked: outputs/termination
+    require the explicit result type, so a tuple-shaped state can't be
+    silently misread as (feedback, outputs))."""
+    if isinstance(result, IterationBodyResult):
+        return result
+    return IterationBodyResult(result)
+
+
+@dataclass
+class EpochContext:
+    """Handed to listeners between epochs (hosted mode) — the analog of the
+    ``IterationListener.Context`` + Collector pair."""
+
+    epoch: int
+    state: Any
+    outputs: Any = None
+    terminated: bool = False
+    side: dict = field(default_factory=dict)
+
+    def output(self, key: str, value: Any) -> None:
+        """Side-output channel (the analog of ``ctx.output(OutputTag, v)``)."""
+        self.side.setdefault(key, []).append(value)
+
+
+class IterationListener:
+    """Epoch-watermark callbacks (``IterationListener.java:30-74``).
+
+    In hosted mode these fire on the host between jitted epoch steps — the
+    exact analog of ``onEpochWatermarkIncremented`` firing after the
+    superstep-alignment barrier (which, in SPMD, *is* the jitted step
+    boundary)."""
+
+    def on_epoch_watermark_incremented(self, epoch: int,
+                                       context: EpochContext) -> None:
+        pass
+
+    def on_iteration_terminated(self, context: EpochContext) -> None:
+        pass
+
+
+class FnListener(IterationListener):
+    """Adapter: wrap plain callables as a listener."""
+
+    def __init__(self,
+                 on_epoch: Optional[Callable[[int, EpochContext], None]] = None,
+                 on_terminated: Optional[Callable[[EpochContext], None]] = None):
+        self._on_epoch = on_epoch
+        self._on_terminated = on_terminated
+
+    def on_epoch_watermark_incremented(self, epoch, context):
+        if self._on_epoch:
+            self._on_epoch(epoch, context)
+
+    def on_iteration_terminated(self, context):
+        if self._on_terminated:
+            self._on_terminated(context)

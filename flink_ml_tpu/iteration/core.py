@@ -1,0 +1,345 @@
+"""The iteration runtime: ``iterate`` — jitted SPMD epoch loops.
+
+Re-design of ``Iterations.java:104-286`` + the whole operator/wrapper
+machinery it drives.  80% of the reference's 16k-line iteration module exists
+to retrofit cycles, BSP epoch alignment, per-round state and exactly-once
+checkpointing onto an acyclic streaming engine (SURVEY §7).  On TPU none of
+that machinery is needed:
+
+- feedback edge      -> the state pytree stays in HBM (donated jit buffers),
+                        replacing FeedbackChannel + Head/Tail operators
+- epoch watermark    -> the jitted step boundary *is* the superstep barrier
+                        (SPMD alignment is implicit), replacing
+                        OperatorEpochWatermarkTracker + SharedProgressAligner
+- termination vote   -> a device scalar reduced inside the step (psum over
+                        the mesh), replacing SubtaskAlignedEvent/
+                        GloballyAlignedEvent RPC
+- replayed inputs    -> device-resident arrays are "replayed" for free each
+                        epoch (they never left HBM), replacing ReplayOperator's
+                        disk cache re-reads
+- per-round state    -> functional re-initialisation per epoch, replacing
+                        reflective state-backend scrubbing
+
+Two execution modes:
+- **fused**: the entire loop compiles to one XLA program (lax.scan or
+  lax.while_loop) — zero host round-trips per epoch; listeners can't fire.
+- **hosted**: python loop around a jitted step — per-epoch listener
+  callbacks, streaming data sources, and checkpoint/resume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Iterator, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from .body import (
+    EpochContext,
+    IterationBodyResult,
+    IterationConfig,
+    IterationListener,
+    OperatorLifeCycle,
+    normalize_body_result,
+)
+from .checkpoint import CheckpointConfig, CheckpointManager
+
+__all__ = ["iterate", "IterationResult"]
+
+BodyFn = Callable[..., Any]
+
+
+@dataclass
+class IterationResult:
+    """Final state + collected outputs (the analog of the iteration's output
+    streams after ``OutputOperator`` unwrapping)."""
+
+    state: Any
+    outputs: Any
+    num_epochs: int
+    side: dict
+
+
+def _private_copy(state: Any) -> Any:
+    """Copy the caller's state pytree before the loop donates its buffers —
+    donation must consume *our* copy, never arrays the caller still holds."""
+    return jax.tree_util.tree_map(
+        lambda x: x.copy() if isinstance(x, jax.Array) else jnp.asarray(x),
+        state)
+
+
+def _vote_continue(vote: Any) -> bool:
+    """Reference rule: continue while the criteria stream is non-empty /
+    feedback record count nonzero (``SharedProgressAligner.java:277-300``)."""
+    return bool(jax.device_get(vote))
+
+
+class _DataProvider:
+    """Adapts the ``data`` argument to a per-epoch feed.
+
+    - None            -> body gets data=None every epoch
+    - pytree          -> same device-resident pytree every epoch (a *replayed*
+                         bounded input, ``ReplayableDataStreamList.replay()``)
+    - callable        -> ``data(epoch) -> pytree`` (non-replayed / generated)
+    - iterator        -> ``next()`` per epoch; exhaustion terminates the
+                         iteration (the bounded end of an unbounded stream)
+    """
+
+    def __init__(self, data: Any):
+        self._static = None
+        self._fn = None
+        self._it: Optional[Iterator] = None
+        self.exhausted = False
+        if data is None or isinstance(data, (dict, tuple, list)) or hasattr(data, "shape"):
+            self._static = data
+        elif callable(data):
+            self._fn = data
+        elif hasattr(data, "__next__"):
+            self._it = data
+        elif hasattr(data, "__iter__"):
+            self._it = iter(data)
+        else:
+            self._static = data
+
+    @property
+    def is_static(self) -> bool:
+        return self._fn is None and self._it is None
+
+    def __call__(self, epoch: int) -> Any:
+        if self._it is not None:
+            try:
+                return next(self._it)
+            except StopIteration:
+                self.exhausted = True
+                return None
+        if self._fn is not None:
+            return self._fn(epoch)
+        return self._static
+
+    def snapshot(self) -> Optional[dict]:
+        for src in (self._fn, self._it):
+            if src is not None and hasattr(src, "snapshot"):
+                return src.snapshot()
+        return None
+
+    def restore(self, snap: dict) -> None:
+        for src in (self._fn, self._it):
+            if src is not None and hasattr(src, "restore"):
+                src.restore(snap)
+
+
+def _call_body(body: BodyFn, state, epoch, data) -> IterationBodyResult:
+    if data is None:
+        return normalize_body_result(body(state, epoch))
+    return normalize_body_result(body(state, epoch, data))
+
+
+def iterate(
+    body: BodyFn,
+    initial_state: Any,
+    data: Any = None,
+    *,
+    config: Optional[IterationConfig] = None,
+    max_epochs: Optional[int] = None,
+    listeners: Sequence[IterationListener] = (),
+    per_round_init: Optional[Callable[[], Any]] = None,
+    checkpoint: Optional[Union[CheckpointConfig, CheckpointManager]] = None,
+    resume: bool = False,
+) -> IterationResult:
+    """Run an iteration (the analog of
+    ``Iterations.iterateBoundedStreamsUntilTermination``,
+    ``Iterations.java:149-170``).
+
+    ``body(state, epoch[, data]) -> IterationBodyResult | state |
+    (state[, outputs[, termination]])``.  Epoch semantics mirror
+    ``Iterations.java:69-83``: state entering epoch ``e`` produces the state
+    for epoch ``e+1`` (the feedback edge increments the epoch).
+
+    Termination: ``max_epochs`` reached, OR the body's ``termination`` vote
+    is zero/false, OR an iterator data source is exhausted.
+    """
+    config = config or IterationConfig()
+    if max_epochs is not None:
+        config = dataclasses.replace(config, max_epochs=max_epochs)
+
+    provider = _DataProvider(data)
+    per_round = config.lifecycle == OperatorLifeCycle.PER_ROUND
+    if per_round and per_round_init is None:
+        # Default per-round re-init: restart every epoch from initial_state.
+        init_copy = initial_state
+        per_round_init = lambda: init_copy  # noqa: E731
+
+    mode = config.mode
+    if mode == "auto":
+        fusible = (provider.is_static and not listeners and checkpoint is None
+                   and not per_round and config.jit
+                   and config.max_epochs is not None)
+        if fusible:
+            # Criteria-driven fused loops keep only the LAST epoch's outputs
+            # (a while_loop can't stack a dynamic number of them) — auto must
+            # not silently change output semantics, so probe for a vote and
+            # fall back to hosted when one exists.  Explicit mode="fused"
+            # opts into last-output semantics.
+            probe = jax.eval_shape(
+                lambda s, e: _call_body(body, s, e, provider(0)),
+                initial_state, jax.ShapeDtypeStruct((), jnp.int32))
+            fusible = probe.termination is None
+        mode = "fused" if fusible else "hosted"
+
+    if mode == "fused":
+        return _iterate_fused(body, initial_state, provider, config)
+    return _iterate_hosted(body, initial_state, provider, config, listeners,
+                           per_round, per_round_init, checkpoint, resume)
+
+
+# ---------------------------------------------------------------------------
+# fused: whole loop is one XLA program
+# ---------------------------------------------------------------------------
+
+def _iterate_fused(body: BodyFn, initial_state, provider: _DataProvider,
+                   config: IterationConfig) -> IterationResult:
+    if not provider.is_static:
+        raise ValueError("fused mode requires device-resident (static) data")
+    if config.max_epochs is None:
+        raise ValueError("fused mode requires max_epochs")
+    if config.donate_state:
+        initial_state = _private_copy(initial_state)
+    data = provider(0)
+    max_epochs = config.max_epochs
+
+    # Probe the body's output structure without running it.
+    probe = jax.eval_shape(
+        lambda s, e: _call_body(body, s, e, data),
+        initial_state, jax.ShapeDtypeStruct((), jnp.int32))
+    has_criteria = probe.termination is not None
+
+    if not has_criteria:
+        # Fixed epoch count: lax.scan stacks per-epoch outputs.
+        @partial(jax.jit, donate_argnums=(0,) if config.donate_state else ())
+        def run(state, data):
+            def scan_step(state, epoch):
+                res = _call_body(body, state, epoch, data)
+                return res.feedback, res.outputs
+
+            return jax.lax.scan(scan_step, state,
+                                jnp.arange(max_epochs, dtype=jnp.int32))
+
+        final_state, outputs = run(initial_state, data)
+        return IterationResult(final_state, outputs, max_epochs, {})
+
+    # Criteria-driven: lax.while_loop; keeps only the last outputs.
+    zero_out = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), probe.outputs)
+
+    @partial(jax.jit, donate_argnums=(0,) if config.donate_state else ())
+    def run(state, data):
+        def cond(carry):
+            _, _, epoch, keep_going = carry
+            return jnp.logical_and(keep_going, epoch < max_epochs)
+
+        def step(carry):
+            state, _, epoch, _ = carry
+            res = _call_body(body, state, epoch, data)
+            keep_going = jnp.asarray(res.termination).astype(bool).reshape(())
+            return res.feedback, res.outputs, epoch + 1, keep_going
+
+        return jax.lax.while_loop(
+            cond, step, (state, zero_out, jnp.asarray(0, jnp.int32),
+                         jnp.asarray(True)))
+
+    final_state, outputs, num_epochs, _ = run(initial_state, data)
+    return IterationResult(final_state, outputs, int(num_epochs), {})
+
+
+# ---------------------------------------------------------------------------
+# hosted: python epoch loop around a jitted step
+# ---------------------------------------------------------------------------
+
+def _iterate_hosted(body: BodyFn, initial_state, provider: _DataProvider,
+                    config: IterationConfig,
+                    listeners: Sequence[IterationListener],
+                    per_round: bool, per_round_init,
+                    checkpoint, resume: bool) -> IterationResult:
+    donating = config.jit and config.donate_state and not per_round
+    if config.jit:
+        # Donating the state argument keeps HBM flat across epochs: the new
+        # feedback pytree reuses the old buffers (the in-place feedback edge).
+        step = jax.jit(
+            lambda s, e, d: _call_body(body, s, e, d),
+            donate_argnums=(0,) if donating else ())
+    else:
+        step = lambda s, e, d: _call_body(body, s, e, d)  # noqa: E731
+
+    manager: Optional[CheckpointManager] = None
+    if isinstance(checkpoint, CheckpointManager):
+        manager = checkpoint
+    elif isinstance(checkpoint, CheckpointConfig):
+        manager = CheckpointManager(checkpoint)
+
+    state = _private_copy(initial_state) if donating else initial_state
+    start_epoch = 0
+    resumed_terminated = False
+    if manager is not None and resume:
+        restored = manager.restore_latest()
+        if restored is not None:
+            start_epoch, state, meta = restored
+            resumed_terminated = bool(meta.get("terminated"))
+            snap = meta.get("source_snapshot")
+            if snap:
+                provider.restore(snap)
+    if resumed_terminated:
+        # The checkpointed run had already voted to terminate at this epoch:
+        # re-running the body would diverge from the uninterrupted run.
+        ctx = EpochContext(epoch=start_epoch, state=state, terminated=True)
+        for listener in listeners:
+            listener.on_iteration_terminated(ctx)
+        return IterationResult(state, [], start_epoch,
+                               {"termination_reason": "criteria"})
+
+    outputs_log = []
+    side: dict = {}
+    epoch = start_epoch
+    terminated_reason = "max_epochs"
+    while config.max_epochs is None or epoch < config.max_epochs:
+        epoch_data = provider(epoch)
+        if provider.exhausted:
+            terminated_reason = "stream_end"
+            break
+        if per_round and epoch > start_epoch:
+            state = per_round_init()
+        res = step(state, jnp.asarray(epoch, jnp.int32), epoch_data)
+        state = res.feedback
+        if res.outputs is not None:
+            outputs_log.append(res.outputs)
+
+        ctx = EpochContext(epoch=epoch, state=state, outputs=res.outputs,
+                           side=side)
+        for listener in listeners:
+            listener.on_epoch_watermark_incremented(epoch, ctx)
+
+        epoch += 1
+        stop = (res.termination is not None
+                and not _vote_continue(res.termination))
+        if manager is not None and (manager.should_save(epoch) or stop):
+            # The vote travels with the checkpoint: resuming from a
+            # checkpoint of a terminated run must not re-run the body.
+            extra = {"terminated": stop}
+            snap = provider.snapshot()
+            if snap:
+                extra["source_snapshot"] = snap
+            manager.save(epoch, state, extra)
+        if stop:
+            terminated_reason = "criteria"
+            break
+
+    final_ctx = EpochContext(epoch=epoch, state=state, terminated=True,
+                             side=side)
+    for listener in listeners:
+        listener.on_iteration_terminated(final_ctx)
+
+    side["termination_reason"] = terminated_reason
+    return IterationResult(state, outputs_log, epoch, side)

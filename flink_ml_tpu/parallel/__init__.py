@@ -1,0 +1,12 @@
+from .mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_sharding,
+    default_mesh,
+    device_mesh,
+    local_device_count,
+    replicate,
+    replicated,
+    shard_batch,
+    use_mesh,
+)

@@ -1,0 +1,148 @@
+"""Device mesh + sharding helpers — the framework's parallelism substrate.
+
+The reference's only parallelism is data parallelism over Flink subtasks with
+hash/rebalance network shuffles (SURVEY §2.10).  Here the equivalent is a
+``jax.sharding.Mesh`` with named axes and ``NamedSharding`` annotations; XLA
+inserts the collectives (psum/all-gather/reduce-scatter) that replace the
+reference's shuffles, and they ride ICI instead of the datacenter network.
+
+Axis convention used across the framework:
+- ``"data"``  — batch-dim sharding (the reference's subtask parallelism)
+- ``"model"`` — tensor/feature-dim sharding (absent in the reference;
+  reserved so TP can be layered on without API change, SURVEY §7)
+"""
+
+from __future__ import annotations
+
+import math
+
+from contextlib import contextmanager
+from typing import Any, Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "device_mesh",
+    "data_sharding",
+    "replicated",
+    "shard_batch",
+    "replicate",
+    "default_mesh",
+    "use_mesh",
+    "local_device_count",
+]
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+_DEFAULT_MESH: Optional[Mesh] = None
+
+
+def local_device_count() -> int:
+    """Devices attached to THIS host (on a multi-host pod this differs from
+    the global count — size per-host batches with this)."""
+    return len(jax.local_devices())
+
+
+def device_mesh(axis_sizes: Optional[Mapping[str, int]] = None,
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a named mesh.
+
+    Default: all devices on one ``"data"`` axis (pure DP, the reference's
+    model).  Pass e.g. ``{"data": 4, "model": 2}`` for a DP x TP mesh; a
+    ``-1`` size is inferred from the device count.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if not axis_sizes:
+        axis_sizes = {DATA_AXIS: len(devices)}
+    names = list(axis_sizes.keys())
+    sizes = list(axis_sizes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("At most one mesh axis may be -1")
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        if len(devices) % known:
+            raise ValueError(
+                f"Cannot infer -1 axis: {len(devices)} devices not divisible "
+                f"by {known}")
+        sizes[sizes.index(-1)] = len(devices) // known
+    if math.prod(sizes) != len(devices):
+        raise ValueError(
+            f"Mesh {dict(zip(names, sizes))} needs {math.prod(sizes)} devices, "
+            f"have {len(devices)}")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, axis_names=tuple(names))
+
+
+def default_mesh() -> Mesh:
+    """The process-wide default mesh (all devices, one data axis), created
+    lazily; override scoped-ly with :func:`use_mesh`."""
+    global _DEFAULT_MESH
+    if _DEFAULT_MESH is None:
+        _DEFAULT_MESH = device_mesh()
+    return _DEFAULT_MESH
+
+
+@contextmanager
+def use_mesh(mesh: Mesh):
+    global _DEFAULT_MESH
+    prev = _DEFAULT_MESH
+    _DEFAULT_MESH = mesh
+    try:
+        yield mesh
+    finally:
+        _DEFAULT_MESH = prev
+
+
+def data_sharding(mesh: Optional[Mesh] = None, *,
+                  axis: str = DATA_AXIS) -> NamedSharding:
+    """Batch-dim sharding: leading dim split over the data axis (the analog
+    of the reference's keyBy/rebalance partitioning, ``KMeans.java:181``)."""
+    mesh = mesh or default_mesh()
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
+    """Fully-replicated sharding (the analog of ``.broadcast()`` model/
+    centroid streams, ``KMeans.java:152``)."""
+    mesh = mesh or default_mesh()
+    return NamedSharding(mesh, P())
+
+
+def _pad_rows(arr: np.ndarray, multiple: int) -> np.ndarray:
+    remainder = arr.shape[0] % multiple
+    if remainder == 0:
+        return arr
+    pad = multiple - remainder
+    return np.concatenate([arr, np.repeat(arr[:1], pad, axis=0)], axis=0)
+
+
+def shard_batch(tree: Any, mesh: Optional[Mesh] = None, *,
+                axis: str = DATA_AXIS, pad: bool = True) -> Any:
+    """device_put a pytree of host arrays with the leading dim sharded over
+    ``axis``.  With ``pad=True`` rows are padded (repeating row 0) to a
+    multiple of the axis size — callers carrying a mask should use
+    ``Table.pad_to_multiple`` instead to keep the mask."""
+    mesh = mesh or default_mesh()
+    if axis not in mesh.shape:
+        raise ValueError(f"Mesh has no axis {axis!r}; axes: {list(mesh.shape)}")
+    n = int(mesh.shape[axis])
+    sharding = NamedSharding(mesh, P(axis))
+
+    def put(x):
+        arr = np.asarray(x)
+        if pad and arr.shape and arr.shape[0] % n:
+            arr = _pad_rows(arr, n)
+        return jax.device_put(arr, sharding)
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def replicate(tree: Any, mesh: Optional[Mesh] = None) -> Any:
+    """device_put a pytree fully replicated over the mesh."""
+    sharding = replicated(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)

@@ -2,19 +2,29 @@
 
 The reference exercises multi-"node" behavior with an in-process Flink
 MiniCluster (2 TM x 2 slots, ``UnboundedStreamIterationITCase.java:155-161``).
-The TPU-native analog is a virtual 8-device CPU mesh: we force the host
-platform to expose 8 XLA devices *before* jax is imported anywhere, so every
-sharding/collective test runs real SPMD partitioning in one process.
+The TPU-native analog is a virtual 8-device CPU mesh: every sharding /
+collective test runs real SPMD partitioning in one process.
+
+The environment's sitecustomize imports jax at interpreter startup (to
+register the axon TPU backend), so JAX_PLATFORMS in os.environ is already
+consumed before this file runs — we must update the live jax config instead.
+The unit/IT suite always runs on the virtual CPU mesh; real-TPU execution is
+exercised by bench.py and __graft_entry__.py.
 """
 
 import os
 
-# Must happen before any jax import.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA_FLAGS is read lazily at CPU-client creation, so this still works even
+# though jax itself is already imported.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
