@@ -128,18 +128,14 @@ class Table:
         """Pad rows (repeating row 0) so num_rows % multiple == 0; returns the
         padded table plus a float mask (1 for real rows).  Static shapes are
         what keep XLA from recompiling per batch."""
-        if multiple <= 0:
-            raise ValueError("multiple must be positive")
-        remainder = self._num_rows % multiple
+        from ..utils.padding import pad_rows_with_mask
+
         mask = np.ones((self._num_rows,), dtype=np.float32)
-        if remainder == 0 or self._num_rows == 0:
+        cols = {}
+        for n, c in self._columns.items():
+            cols[n], mask = pad_rows_with_mask(c, multiple)
+        if not cols:
             return self, mask
-        pad = multiple - remainder
-        cols = {
-            n: np.concatenate([c, np.repeat(c[:1], pad, axis=0)], axis=0)
-            for n, c in self._columns.items()
-        }
-        mask = np.concatenate([mask, np.zeros((pad,), dtype=np.float32)])
         return Table(cols), mask
 
     def batches(self, batch_size: int, *, drop_remainder: bool = False

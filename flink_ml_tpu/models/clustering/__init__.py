@@ -1,0 +1,1 @@
+from .kmeans import KMeans, KMeansModel, KMeansModelParams, KMeansParams  # noqa: F401

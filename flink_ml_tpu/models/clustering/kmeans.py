@@ -1,0 +1,205 @@
+"""KMeans — the framework's vertical slice, TPU-native.
+
+Capability mirror of ``flink-ml-lib/.../clustering/kmeans/KMeans.java:79-337``
++ ``KMeansModel.java:62-214`` + ``KMeansParams.java``/``KMeansModelParams``.
+
+The reference implements one Lloyd's iteration as a dataflow subgraph:
+broadcast centroids → two-input cache-and-assign operator
+(``KMeans.java:238-315``) → keyed window reduce (``CentroidAccumulator``) →
+parallelism-1 window average (``KMeans.java:172-196``) → feedback edge.  On
+TPU the same epoch is three fused XLA ops on sharded arrays:
+
+- assign   = pairwise-distance argmin (one MXU matmul via the
+             ||x||^2 - 2xc + ||c||^2 expansion)
+- reduce   = one-hot^T @ points matmul (MXU) — replaces the keyed shuffle +
+             reduce; XLA inserts the psum over the data axis of the mesh
+- feedback = centroids stay in HBM between epochs (donated buffers)
+
+and the whole ``maxIter`` loop compiles into a single XLA program
+(``iterate`` fused mode) — zero host round-trips, zero network shuffles
+inside the iteration body.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import Estimator, Model
+from ...data.table import Table
+from ...distance import DistanceMeasure
+from ...iteration import IterationBodyResult, IterationConfig, iterate
+from ...linalg import stack_vectors
+from ...params.param import IntParam, ParamValidators
+from ...params.shared import (
+    HasDistanceMeasure,
+    HasFeaturesCol,
+    HasMaxIter,
+    HasPredictionCol,
+    HasSeed,
+)
+from ...parallel.mesh import default_mesh, data_sharding, replicate
+from ...utils import persist
+from ...utils.padding import pad_rows_with_mask
+
+__all__ = ["KMeans", "KMeansModel", "KMeansParams", "KMeansModelParams"]
+
+
+class KMeansModelParams(HasDistanceMeasure, HasFeaturesCol, HasPredictionCol):
+    """``KMeansModelParams.java`` mixin set."""
+
+
+class KMeansParams(KMeansModelParams, HasSeed, HasMaxIter):
+    """``KMeansParams.java``: adds K (>= 2) and the training-only params."""
+
+    K = IntParam("k", "Number of clusters.", default=2,
+                 validator=ParamValidators.gt_eq(2))
+
+    def get_k(self) -> int:
+        return self.get(KMeansParams.K)
+
+    def set_k(self, value: int):
+        return self.set(KMeansParams.K, value)
+
+
+def _prepare_points(points: np.ndarray, mesh) -> tuple:
+    """Host -> device: pad rows to the data-axis multiple (mask marks real
+    rows) and shard the batch dim."""
+    padded, mask = pad_rows_with_mask(points, int(mesh.shape["data"]))
+    sharding = data_sharding(mesh)
+    return jax.device_put(padded, sharding), jax.device_put(mask, sharding)
+
+
+@partial(jax.jit, static_argnums=0)
+def _predict(measure: DistanceMeasure, pts, centroids):
+    """Module-level jit (cache hit on every transform after the first;
+    DistanceMeasure instances are registry singletons, hashable by id)."""
+    return jnp.argmin(measure.pairwise(pts, centroids), axis=1)
+
+
+def select_random_centroids(points: np.ndarray, k: int, seed: int) -> np.ndarray:
+    """Semantics of ``KMeans.selectRandomCentroids`` (``KMeans.java:317-336``):
+    shuffle all points with the seed, take k."""
+    n = points.shape[0]
+    if n < k:
+        raise ValueError(f"Need at least k={k} points, got {n}")
+    idx = np.random.default_rng(seed).permutation(n)[:k]
+    return points[idx]
+
+
+def kmeans_epoch_step(measure: DistanceMeasure, k: int):
+    """One Lloyd's iteration as a pure jnp function (points, mask are closed
+    over by ``iterate``'s static data)."""
+
+    def body(centroids, epoch, data):
+        points, mask = data
+        dists = measure.pairwise(points, centroids)            # (n, k)
+        assign = jnp.argmin(dists, axis=1)                     # (n,)
+        onehot = jax.nn.one_hot(assign, k, dtype=points.dtype) # (n, k)
+        onehot = onehot * mask[:, None]                        # drop padding
+        sums = jnp.einsum("nk,nd->kd", onehot, points)         # MXU reduce
+        counts = jnp.sum(onehot, axis=0)[:, None]              # (k, 1)
+        # Empty clusters keep their previous centroid (the reference's
+        # keyed-reduce would silently drop them; keeping is strictly better
+        # and identical when all clusters are non-empty, as in KMeansTest).
+        new_centroids = jnp.where(counts > 0,
+                                  sums / jnp.maximum(counts, 1.0), centroids)
+        return IterationBodyResult(feedback=new_centroids)
+
+    return body
+
+
+class KMeans(KMeansParams, Estimator["KMeansModel"]):
+    """Estimator: Lloyd's algorithm for ``maxIter`` rounds
+    (termination parity with ``TerminateOnMaxIterationNum``,
+    ``common/iteration/TerminateOnMaxIterationNum.java:34-55``)."""
+
+    def fit(self, *inputs) -> "KMeansModel":
+        (table,) = inputs
+        mesh = default_mesh()
+        k = self.get_k()
+        measure = DistanceMeasure.get_instance(self.get_distance_measure())
+
+        host_points = stack_vectors(table[self.get_features_col()]).astype(
+            np.float32)
+        init = select_random_centroids(host_points, k, self.get_seed())
+
+        points, mask = _prepare_points(host_points, mesh)
+        init_dev = replicate(init, mesh)
+
+        result = iterate(
+            kmeans_epoch_step(measure, k),
+            init_dev,
+            (points, mask),
+            max_epochs=self.get_max_iter(),
+            config=IterationConfig(mode="fused"),
+        )
+        centroids = np.asarray(jax.device_get(result.state))
+
+        model = KMeansModel()
+        model.copy_params_from(self)
+        model.set_model_data(
+            Table({"centroids": centroids[None, :, :]}))  # 1 row of (k, d)
+        return model
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "KMeans":
+        return persist.load_stage_param(path)
+
+
+class KMeansModel(KMeansModelParams, Model):
+    """Batch prediction: one pairwise-distance matmul + argmin appended as the
+    prediction column (the reference buffers rows until ``finish()`` then
+    loops — ``KMeansModel.java:109-176``; here it's a single jitted call)."""
+
+    def __init__(self):
+        super().__init__()
+        self._centroids: np.ndarray | None = None
+
+    # -- model data ---------------------------------------------------------
+    def set_model_data(self, *inputs) -> "KMeansModel":
+        (table,) = inputs
+        self._centroids = np.asarray(table["centroids"][0], dtype=np.float32)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require_model()
+        return [Table({"centroids": self._centroids[None, :, :]})]
+
+    def _require_model(self):
+        if self._centroids is None:
+            raise RuntimeError(
+                "KMeansModel has no model data; fit a KMeans or call "
+                "set_model_data first")
+
+    # -- inference ----------------------------------------------------------
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        self._require_model()
+        measure = DistanceMeasure.get_instance(self.get_distance_measure())
+        points = stack_vectors(table[self.get_features_col()]).astype(
+            np.float32)
+        assign = np.asarray(
+            _predict(measure, points, jnp.asarray(self._centroids)))
+        return [table.with_column(self.get_prediction_col(),
+                                  assign.astype(np.int64))]
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path: str) -> None:
+        self._require_model()
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(path, "model", {"centroids": self._centroids})
+
+    @classmethod
+    def load(cls, path: str) -> "KMeansModel":
+        model = persist.load_stage_param(path)
+        data = persist.load_model_arrays(path, "model")
+        model._centroids = data["centroids"].astype(np.float32)
+        return model

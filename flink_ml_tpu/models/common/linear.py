@@ -1,0 +1,167 @@
+"""Shared Estimator/Model bases for the linear family (LogisticRegression,
+LinearRegression, LinearSVC) — one SGD skeleton, per-model loss + link."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import Estimator, Model
+from ...data.table import Table
+from ...linalg import stack_vectors
+from ...params.shared import (
+    HasElasticNet,
+    HasFeaturesCol,
+    HasGlobalBatchSize,
+    HasLabelCol,
+    HasLearningRate,
+    HasMaxIter,
+    HasPredictionCol,
+    HasRawPredictionCol,
+    HasRegParam,
+    HasSeed,
+    HasTol,
+    HasWeightCol,
+)
+from ...utils import persist
+from .losses import LOSSES
+from .sgd import LinearState, SGDConfig, sgd_fit
+
+__all__ = ["LinearEstimatorParams", "LinearModelBase", "LinearEstimatorBase"]
+
+
+@jax.jit
+def _jit_margins(X, w, b):
+    """Module-level jit: repeated transform() calls are cache hits."""
+    return X @ w + b
+
+
+class LinearModelParams(HasFeaturesCol, HasPredictionCol, HasRawPredictionCol):
+    pass
+
+
+class LinearEstimatorParams(LinearModelParams, HasLabelCol, HasWeightCol,
+                            HasMaxIter, HasLearningRate, HasRegParam,
+                            HasElasticNet, HasGlobalBatchSize, HasTol,
+                            HasSeed):
+    pass
+
+
+class LinearModelBase(LinearModelParams, Model):
+    """Holds (coefficients, intercept); subclasses map margins to the
+    prediction / raw-prediction columns."""
+
+    loss_name: str = "squared"
+
+    def __init__(self):
+        super().__init__()
+        self._state: Optional[LinearState] = None
+
+    # -- model data ---------------------------------------------------------
+    def set_model_data(self, *inputs) -> "LinearModelBase":
+        (table,) = inputs
+        self._state = LinearState(
+            coefficients=np.asarray(table["coefficients"][0], np.float64),
+            intercept=float(table["intercept"][0]))
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require_model()
+        return [Table({
+            "coefficients": self._state.coefficients[None, :],
+            "intercept": np.array([self._state.intercept]),
+        })]
+
+    def _require_model(self):
+        if self._state is None:
+            raise RuntimeError(
+                f"{type(self).__name__} has no model data; fit the estimator "
+                "or call set_model_data first")
+
+    # -- inference ----------------------------------------------------------
+    def _margins(self, table: Table) -> np.ndarray:
+        self._require_model()
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float32)
+        w = jnp.asarray(self._state.coefficients, jnp.float32)
+        b = jnp.asarray(self._state.intercept, jnp.float32)
+        return np.asarray(_jit_margins(X, w, b), np.float64)
+
+    def _decision(self, margins: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def _raw(self, margins: np.ndarray) -> np.ndarray:
+        return margins
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        m = self._margins(table)
+        out = table.with_column(self.get_prediction_col(), self._decision(m))
+        raw_col = self.get_raw_prediction_col()
+        if raw_col:
+            out = out.with_column(raw_col, self._raw(m))
+        return [out]
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path: str) -> None:
+        self._require_model()
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(path, "model", {
+            "coefficients": self._state.coefficients,
+            "intercept": np.array([self._state.intercept]),
+        })
+
+    @classmethod
+    def load(cls, path: str):
+        model = persist.load_stage_param(path)
+        data = persist.load_model_arrays(path, "model")
+        model._state = LinearState(
+            coefficients=data["coefficients"].astype(np.float64),
+            intercept=float(data["intercept"][0]))
+        return model
+
+
+class LinearEstimatorBase(LinearEstimatorParams, Estimator):
+    """fit(): extract (X, y, weight), run the fused SGD loop, wrap the fitted
+    state in the concrete model class."""
+
+    loss_name: str = "squared"
+    model_cls = None  # set by subclasses
+
+    def _labels(self, table: Table) -> np.ndarray:
+        return np.asarray(table[self.get_label_col()], np.float64)
+
+    def fit(self, *inputs):
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()])
+        y = self._labels(table)
+        weight_col = self.get_weight_col()
+        weights = (np.asarray(table[weight_col], np.float64)
+                   if weight_col else None)
+
+        state, loss_log = sgd_fit(
+            LOSSES[self.loss_name], X, y, weights,
+            SGDConfig(
+                learning_rate=self.get_learning_rate(),
+                reg=self.get_reg(),
+                elastic_net=self.get_elastic_net(),
+                global_batch_size=self.get_global_batch_size(),
+                max_epochs=self.get_max_iter(),
+                tol=self.get_tol(),
+                seed=self.get_seed(),
+            ))
+
+        model = self.model_cls()
+        model.copy_params_from(self)
+        model._state = state
+        model._loss_log = loss_log
+        return model
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+
+    @classmethod
+    def load(cls, path: str):
+        return persist.load_stage_param(path)

@@ -1,0 +1,45 @@
+"""Mask-aware loss functions for the linear-model family.
+
+The reference snapshot contains only KMeans, but its BASELINE configs call
+for LogisticRegression / LinearRegression / LinearSVC (the flink-ml-lib
+linear family).  All losses share the margin form ``m = X @ w + b`` and are
+weighted: padding rows carry weight 0, real rows carry the sample weight
+(``HasWeightCol``), so padded shards contribute nothing to the psum.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["logistic_loss", "squared_loss", "hinge_loss", "LOSSES"]
+
+
+def _weighted_mean(values, weights):
+    # Epsilon only guards the all-padding batch (weight sum exactly 0, where
+    # the numerator is 0 too); real weighted means keep their scale.
+    return jnp.sum(values * weights) / jnp.maximum(jnp.sum(weights), 1e-12)
+
+
+def logistic_loss(margin, labels, weights):
+    """Binary log-loss on +-1 labels: log(1 + exp(-y * m)) — numerically via
+    softplus."""
+    y = labels * 2.0 - 1.0  # {0,1} -> {-1,+1}
+    return _weighted_mean(jnp.logaddexp(0.0, -y * margin), weights)
+
+
+def squared_loss(margin, labels, weights):
+    """0.5 * (m - y)^2 (LinearRegression)."""
+    return _weighted_mean(0.5 * jnp.square(margin - labels), weights)
+
+
+def hinge_loss(margin, labels, weights):
+    """max(0, 1 - y * m) on +-1 labels (LinearSVC)."""
+    y = labels * 2.0 - 1.0
+    return _weighted_mean(jnp.maximum(0.0, 1.0 - y * margin), weights)
+
+
+LOSSES = {
+    "logistic": logistic_loss,
+    "squared": squared_loss,
+    "hinge": hinge_loss,
+}

@@ -1,0 +1,151 @@
+"""Fused mini-batch SGD trainer over a device mesh.
+
+The TPU-native replacement for the reference's iteration-based model update
+path: where flink-ml ships gradients over the network to a reduce operator
+and feeds new weights back through the FeedbackChannel, here one epoch is an
+inner ``lax.scan`` over mini-batches — the gradient psum over the mesh's data
+axis is inserted by XLA and rides ICI — and the whole multi-epoch loop is a
+single compiled program via ``iterate`` (fused mode).
+
+Data layout: inputs are host-shuffled once (seeded), padded, and reshaped to
+``(steps_per_epoch, batch, ...)`` with the batch dim sharded over the data
+axis; weights/optimizer state are replicated.  Shapes are static — no
+recompiles across epochs or batch positions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...iteration import IterationBodyResult, IterationConfig, iterate
+from ...parallel.mesh import default_mesh, replicate
+
+__all__ = ["SGDConfig", "sgd_fit", "LinearState"]
+
+LossFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+@dataclass
+class SGDConfig:
+    learning_rate: float = 0.1
+    reg: float = 0.0            # l2 strength (on coefficients, not intercept)
+    elastic_net: float = 0.0    # l1 mixing (0 = pure l2)
+    global_batch_size: int = 32
+    max_epochs: int = 20
+    tol: float = 1e-6           # epoch-loss-change termination; <=0 disables
+    seed: int = 0
+    fit_intercept: bool = True
+
+
+@dataclass
+class LinearState:
+    coefficients: np.ndarray    # (d,)
+    intercept: float
+
+
+def _prepare_epoch_tensor(arr: np.ndarray, perm: np.ndarray, steps: int,
+                          batch: int, pad_value: float = 0.0) -> np.ndarray:
+    """Shuffle rows by ``perm``, pad to steps*batch, reshape to
+    (steps, batch, ...)."""
+    arr = arr[perm]
+    total = steps * batch
+    if arr.shape[0] < total:
+        pad_shape = (total - arr.shape[0],) + arr.shape[1:]
+        arr = np.concatenate([arr, np.full(pad_shape, pad_value, arr.dtype)])
+    return arr.reshape((steps, batch) + arr.shape[1:])
+
+
+def sgd_fit(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
+            weights: Optional[np.ndarray], config: SGDConfig,
+            mesh=None) -> Tuple[LinearState, list]:
+    """Train (w, b) minimizing ``loss_fn(margin, labels, weights) +
+    reg * penalty(w)``.  Returns the fitted state and the per-epoch loss log.
+
+    The elastic-net penalty matches the classic formulation:
+    ``reg * ((1-alpha)/2 ||w||^2 + alpha ||w||_1)`` with the l1 part applied
+    via proximal soft-thresholding after each step.
+    """
+    mesh = mesh or default_mesh()
+    n_dev = int(mesh.shape["data"])
+    n, d = features.shape
+    batch = max(config.global_batch_size, n_dev)
+    batch += (-batch) % n_dev  # divisible by the data axis
+    steps = max(1, -(-n // batch))
+
+    rng = np.random.default_rng(config.seed)
+    perm = rng.permutation(n)
+
+    X = _prepare_epoch_tensor(features.astype(np.float32), perm, steps, batch)
+    y = _prepare_epoch_tensor(labels.astype(np.float32), perm, steps, batch)
+    w_host = (weights.astype(np.float32) if weights is not None
+              else np.ones((n,), np.float32))
+    w = _prepare_epoch_tensor(w_host, perm, steps, batch, pad_value=0.0)
+
+    batch_sharded = NamedSharding(mesh, P(None, "data"))
+    x_sharded = NamedSharding(mesh, P(None, "data", None))
+    X = jax.device_put(X, x_sharded)
+    y = jax.device_put(y, batch_sharded)
+    w = jax.device_put(w, batch_sharded)
+
+    lr = config.learning_rate
+    reg, alpha = config.reg, config.elastic_net
+    l2 = reg * (1.0 - alpha)
+    l1 = reg * alpha
+
+    def objective(params, xb, yb, wb):
+        margin = xb @ params["w"] + params["b"]
+        return loss_fn(margin, yb, wb) + 0.5 * l2 * jnp.sum(
+            jnp.square(params["w"]))
+
+    grad_fn = jax.value_and_grad(objective)
+
+    def epoch_body(state, epoch, data):
+        Xd, yd, wd = data
+        params, prev_loss, loss_log = state
+
+        def batch_step(params, batch_idx):
+            value, grads = grad_fn(params,
+                                   Xd[batch_idx], yd[batch_idx], wd[batch_idx])
+            new_w = params["w"] - lr * grads["w"]
+            if l1 > 0:
+                # proximal soft-threshold for the l1 part
+                new_w = jnp.sign(new_w) * jnp.maximum(
+                    jnp.abs(new_w) - lr * l1, 0.0)
+            new_b = params["b"] - (lr * grads["b"]
+                                   if config.fit_intercept else 0.0)
+            return {"w": new_w, "b": new_b}, value
+
+        params, losses = jax.lax.scan(
+            batch_step, params, jnp.arange(steps, dtype=jnp.int32))
+        epoch_loss = jnp.mean(losses)
+        # The full loss history rides in the carried state (a fixed-size
+        # buffer indexed by epoch) so the fused while_loop path — which only
+        # keeps the LAST epoch's outputs — still yields the complete log.
+        loss_log = loss_log.at[epoch].set(epoch_loss)
+        termination = (jnp.abs(prev_loss - epoch_loss) > config.tol
+                       if config.tol > 0 else None)
+        return IterationBodyResult(
+            feedback=(params, epoch_loss, loss_log), termination=termination)
+
+    init_params = replicate(
+        {"w": jnp.zeros((d,), jnp.float32), "b": jnp.zeros((), jnp.float32)},
+        mesh)
+    init_state = (init_params, jnp.asarray(jnp.inf, jnp.float32),
+                  jnp.full((config.max_epochs,), jnp.nan, jnp.float32))
+
+    result = iterate(
+        epoch_body, init_state, (X, y, w),
+        max_epochs=config.max_epochs,
+        config=IterationConfig(mode="fused"),
+    )
+    params, _final_loss, loss_buf = result.state
+    params = jax.device_get(params)
+    loss_log = list(np.asarray(jax.device_get(loss_buf))[:result.num_epochs])
+    return LinearState(np.asarray(params["w"], np.float64),
+                       float(params["b"])), loss_log

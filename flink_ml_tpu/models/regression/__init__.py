@@ -1,0 +1,1 @@
+from .linearregression import LinearRegression, LinearRegressionModel  # noqa: F401

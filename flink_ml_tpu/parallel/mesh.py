@@ -23,6 +23,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils.padding import pad_rows_with_mask
+
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
@@ -34,6 +36,7 @@ __all__ = [
     "default_mesh",
     "use_mesh",
     "local_device_count",
+    "pad_rows_with_mask",
 ]
 
 DATA_AXIS = "data"
@@ -114,11 +117,7 @@ def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
 
 
 def _pad_rows(arr: np.ndarray, multiple: int) -> np.ndarray:
-    remainder = arr.shape[0] % multiple
-    if remainder == 0:
-        return arr
-    pad = multiple - remainder
-    return np.concatenate([arr, np.repeat(arr[:1], pad, axis=0)], axis=0)
+    return pad_rows_with_mask(arr, multiple)[0]
 
 
 def shard_batch(tree: Any, mesh: Optional[Mesh] = None, *,

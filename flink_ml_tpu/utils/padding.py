@@ -1,0 +1,28 @@
+"""The canonical pad-to-multiple-with-mask invariant (numpy only — shared by
+the Table substrate, the mesh sharding helpers, and the estimators)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+__all__ = ["pad_rows_with_mask"]
+
+
+def pad_rows_with_mask(arr, multiple: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad rows (repeating row 0) so ``rows % multiple == 0``; returns
+    ``(padded, mask)`` with a float32 mask of 1 for real rows.  Row 0 is a
+    safe filler because every consumer weights rows by the mask."""
+    if multiple <= 0:
+        raise ValueError("multiple must be positive")
+    arr = np.asarray(arr)
+    n = arr.shape[0]
+    mask = np.ones((n,), dtype=np.float32)
+    remainder = n % multiple
+    if remainder == 0 or n == 0:
+        return arr, mask
+    pad = multiple - remainder
+    padded = np.concatenate([arr, np.repeat(arr[:1], pad, axis=0)], axis=0)
+    mask = np.concatenate([mask, np.zeros((pad,), dtype=np.float32)])
+    return padded, mask

@@ -1,0 +1,156 @@
+"""Linear family tests: LogisticRegression / LinearRegression / LinearSVC.
+
+Coverage shape follows KMeansTest: defaults, fit+predict accuracy on a
+separable fixture, save/load, weight column, regularization behavior."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.classification import (
+    LinearSVC,
+    LinearSVCModel,
+    LogisticRegression,
+    LogisticRegressionModel,
+)
+from flink_ml_tpu.models.regression import (
+    LinearRegression,
+    LinearRegressionModel,
+)
+
+
+def _binary_table(n=256, d=4, seed=0, margin=2.0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    w_true = rng.normal(size=(d,))
+    y = (X @ w_true + 0.1 * rng.normal(size=n) > 0).astype(np.int64)
+    X = X + margin * 0.1 * (2 * y[:, None] - 1) * np.sign(w_true)[None, :]
+    return Table({"features": X, "label": y}), w_true
+
+
+def _regression_table(n=256, d=3, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    w_true = np.array([1.5, -2.0, 0.5])
+    y = X @ w_true + 3.0
+    return Table({"features": X, "label": y}), w_true
+
+
+def test_logreg_defaults():
+    lr = LogisticRegression()
+    assert lr.get_max_iter() == 20
+    assert lr.get_learning_rate() == 0.1
+    assert lr.get_reg() == 0.0
+    assert lr.get_global_batch_size() == 32
+    assert lr.get_label_col() == "label"
+    assert lr.get_raw_prediction_col() == "rawPrediction"
+
+
+def test_logreg_fit_predict():
+    table, _ = _binary_table()
+    model = (LogisticRegression().set_max_iter(30).set_learning_rate(0.5)
+             .fit(table))
+    out = model.transform(table)[0]
+    acc = np.mean(out["prediction"] == table["label"])
+    assert acc > 0.95
+    probs = out["rawPrediction"]
+    assert np.all((probs >= 0) & (probs <= 1))
+    # prediction is prob > 0.5
+    np.testing.assert_array_equal(out["prediction"], (probs > 0.5))
+
+
+def test_logreg_save_load(tmp_path):
+    table, _ = _binary_table()
+    model = LogisticRegression().set_max_iter(10).fit(table)
+    path = str(tmp_path / "lr")
+    model.save(path)
+    loaded = LogisticRegressionModel.load(path)
+    np.testing.assert_array_equal(
+        loaded.transform(table)[0]["prediction"],
+        model.transform(table)[0]["prediction"])
+
+
+def test_logreg_model_data_round_trip():
+    table, _ = _binary_table()
+    model = LogisticRegression().set_max_iter(10).fit(table)
+    (data,) = model.get_model_data()
+    fresh = LogisticRegressionModel().set_model_data(data)
+    fresh.copy_params_from(model)
+    np.testing.assert_array_equal(
+        fresh.transform(table)[0]["prediction"],
+        model.transform(table)[0]["prediction"])
+
+
+def test_linear_regression_recovers_coefficients():
+    table, w_true = _regression_table()
+    model = (LinearRegression().set_max_iter(200).set_learning_rate(0.1)
+             .set_global_batch_size(64).set_tol(0.0).fit(table))
+    out = model.transform(table)[0]
+    resid = np.abs(out["prediction"] - table["label"])
+    assert resid.mean() < 0.05
+    np.testing.assert_allclose(model._state.coefficients, w_true, atol=0.05)
+    assert abs(model._state.intercept - 3.0) < 0.05
+
+
+def test_linearsvc_fit_predict():
+    table, _ = _binary_table(margin=4.0)
+    model = LinearSVC().set_max_iter(50).set_learning_rate(0.2).fit(table)
+    out = model.transform(table)[0]
+    acc = np.mean(out["prediction"] == table["label"])
+    assert acc > 0.95
+
+
+def test_linearsvc_threshold():
+    table, _ = _binary_table()
+    model = LinearSVC().set_max_iter(20).fit(table)
+    high = model.set_threshold(1e9).transform(table)[0]
+    assert np.all(high["prediction"] == 0)
+    low = model.set_threshold(-1e9).transform(table)[0]
+    assert np.all(low["prediction"] == 1)
+
+
+def test_weight_column_influences_fit():
+    # All weight on class-1 rows pushes predictions toward 1
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(128, 3))
+    y = (rng.uniform(size=128) > 0.5).astype(np.int64)
+    w = np.where(y == 1, 1000.0, 0.001)
+    t = Table({"features": X, "label": y, "w": w})
+    model = (LogisticRegression().set_weight_col("w").set_max_iter(30)
+             .set_learning_rate(0.5).fit(t))
+    preds = model.transform(t)[0]["prediction"]
+    assert preds.mean() > 0.9
+
+
+def test_l2_regularization_shrinks_weights():
+    table, _ = _binary_table()
+    free = LogisticRegression().set_max_iter(30).fit(table)
+    ridge = LogisticRegression().set_max_iter(30).set_reg(1.0).fit(table)
+    assert (np.linalg.norm(ridge._state.coefficients)
+            < np.linalg.norm(free._state.coefficients))
+
+
+def test_l1_regularization_sparsifies():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(256, 10))
+    y = (X[:, 0] > 0).astype(np.int64)  # only feature 0 matters
+    t = Table({"features": X, "label": y})
+    lasso = (LogisticRegression().set_reg(0.2).set_elastic_net(1.0)
+             .set_max_iter(50).set_learning_rate(0.5).fit(t))
+    coef = lasso._state.coefficients
+    assert np.sum(np.abs(coef[1:]) < 1e-3) >= 7  # most noise features zeroed
+    assert abs(coef[0]) > 0.01
+
+
+def test_loss_log_decreases():
+    table, _ = _binary_table()
+    model = (LogisticRegression().set_max_iter(20).set_tol(0.0)
+             .set_learning_rate(0.3).fit(table))
+    log = model._loss_log
+    assert len(log) == 20
+    assert log[-1] < log[0]
+
+
+def test_untrained_model_errors():
+    with pytest.raises(RuntimeError):
+        LogisticRegressionModel().transform(Table({"features": np.ones((2, 2))}))

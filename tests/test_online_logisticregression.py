@@ -1,0 +1,111 @@
+"""OnlineLogisticRegression (streaming FTRL) tests — the unbounded-iteration
+capability: epoch = one stream window, model versions emitted continuously,
+warm start from initial model data."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.classification.online_logisticregression import (
+    OnlineLogisticRegression,
+    OnlineLogisticRegressionModel,
+)
+
+
+def _stream(n_batches=30, batch=64, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(d,))
+    for _ in range(n_batches):
+        X = rng.normal(size=(batch, d))
+        y = (X @ w_true > 0).astype(np.int64)
+        yield Table({"features": X, "label": y}), w_true
+
+
+def test_defaults():
+    olr = OnlineLogisticRegression()
+    assert olr.get_alpha() == 0.1
+    assert olr.get_beta() == 0.1
+    assert olr.get_global_batch_size() == 32
+    with pytest.raises(Exception):
+        olr.set_alpha(0.0)
+
+
+def test_streaming_fit_learns():
+    batches = []
+    w_true = None
+    for t, w_true in _stream(n_batches=50):
+        batches.append(t)
+    model = (OnlineLogisticRegression().set_alpha(0.5)
+             .fit(iter(batches)))
+    assert isinstance(model, OnlineLogisticRegressionModel)
+    assert model.model_version == 50
+
+    rng = np.random.default_rng(99)
+    X = rng.normal(size=(512, 4))
+    y = (X @ w_true > 0).astype(np.int64)
+    out = model.transform(Table({"features": X, "label": y}))[0]
+    assert np.mean(out["prediction"] == y) > 0.9
+
+
+def test_bounded_table_windowed_by_batch_size():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(100, 3))
+    y = (X[:, 0] > 0).astype(np.int64)
+    t = Table({"features": X, "label": y})
+    model = (OnlineLogisticRegression().set_global_batch_size(32)
+             .set_alpha(0.5).fit(t))
+    # 100 rows / 32 -> 4 windows (last ragged)
+    assert model.model_version == 4
+
+
+def test_version_history_and_interval():
+    batches = [t for t, _ in _stream(n_batches=10)]
+    model = (OnlineLogisticRegression()
+             .set(OnlineLogisticRegression.MODEL_SAVE_INTERVAL, 3)
+             .fit(iter(batches)))
+    # versions at batches 3, 6, 9
+    assert len(model.version_history) == 3
+    # versions evolve
+    assert not np.allclose(model.version_history[0].coefficients,
+                           model.version_history[-1].coefficients)
+
+
+def test_warm_start():
+    batches = [t for t, _ in _stream(n_batches=2, seed=5)]
+    w0 = np.array([1.0, -1.0, 0.5, 0.0])
+    olr = OnlineLogisticRegression().set_initial_model_data(
+        Table({"coefficients": w0[None, :]}))
+    model = olr.fit(iter(batches[:1]))
+    # after only one tiny batch, weights should still be near the warm start
+    assert np.linalg.norm(model._state.coefficients - w0) < 1.0
+
+
+def test_l1_sparsity():
+    rng = np.random.default_rng(7)
+    batches = []
+    for _ in range(40):
+        X = rng.normal(size=(64, 10)).astype(np.float64)
+        y = (X[:, 0] > 0).astype(np.int64)
+        batches.append(Table({"features": X, "label": y}))
+    model = (OnlineLogisticRegression().set_reg(0.2).set_elastic_net(1.0)
+             .set_alpha(0.5).fit(iter(batches)))
+    coef = model._state.coefficients
+    assert np.sum(np.abs(coef[1:]) < 1e-8) >= 5
+    assert abs(coef[0]) > 0.1
+
+
+def test_empty_stream_rejected():
+    with pytest.raises(ValueError):
+        OnlineLogisticRegression().fit(iter([]))
+
+
+def test_save_load(tmp_path):
+    batches = [t for t, _ in _stream(n_batches=5)]
+    model = OnlineLogisticRegression().fit(iter(batches))
+    path = str(tmp_path / "olr")
+    model.save(path)
+    loaded = OnlineLogisticRegressionModel.load(path)
+    X = np.random.default_rng(0).normal(size=(16, 4))
+    t = Table({"features": X})
+    np.testing.assert_array_equal(loaded.transform(t)[0]["prediction"],
+                                  model.transform(t)[0]["prediction"])
