@@ -1,0 +1,78 @@
+"""Broadcast variables.
+
+Capability mirror of ``flink-ml-lib/.../common/broadcast/`` (SURVEY §2.8):
+the reference needs ~1,900 lines (receiver operators, cache-or-block
+wrappers with mailbox yields, spill-to-disk replay, co-location keys) to make
+a small stream fully available to every parallel instance of an operator
+before it runs.  On a TPU mesh the same capability is *replication*: a
+broadcast variable is a pytree device_put with ``PartitionSpec()`` — every
+device holds the full copy, XLA broadcasts it once over ICI, and any jitted
+function can close over it.
+
+``with_broadcast`` keeps the reference's API shape
+(``BroadcastUtils.withBroadcastStream(inputs, broadcastMap, userFn)``,
+``BroadcastUtils.java:67-119``): materialize the named tables onto the mesh,
+expose them through a context, run the user function.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+from ..parallel.mesh import replicate
+from .table import Table
+
+__all__ = ["BroadcastContext", "with_broadcast"]
+
+
+class BroadcastContext:
+    """Named replicated variables (analog of ``BroadcastContext.java:34-113``,
+    whose JVM-singleton map becomes instance state — no global registry or
+    mailbox blocking is needed when materialization is eager)."""
+
+    def __init__(self, variables: Mapping[str, Any]):
+        self._variables = dict(variables)
+
+    def get_broadcast_variable(self, name: str) -> Any:
+        """The analog of ``RichFunction.getBroadcastVariable(name)``
+        (``BroadcastStreamingRuntimeContext.java``)."""
+        if name not in self._variables:
+            raise KeyError(
+                f"No broadcast variable {name!r}; available: "
+                f"{sorted(self._variables)}")
+        return self._variables[name]
+
+    def names(self):
+        return sorted(self._variables)
+
+
+def _materialize(value: Any, mesh) -> Any:
+    """Table -> replicated dict of device arrays; array/pytree -> replicated
+    as-is (numeric object columns are densified)."""
+    if isinstance(value, Table):
+        cols = {}
+        for name in value.column_names:
+            col = value[name]
+            if col.dtype == object:
+                from ..linalg import stack_vectors
+                col = stack_vectors(col)
+            cols[name] = col
+        return replicate(cols, mesh)
+    return replicate(value, mesh)
+
+
+def with_broadcast(fn: Callable[..., Any],
+                   broadcast: Mapping[str, Any],
+                   *inputs,
+                   mesh=None) -> Any:
+    """Run ``fn(*inputs, ctx)`` with ``broadcast`` (name -> Table or array
+    pytree) replicated across the mesh.
+
+    Mirror of ``BroadcastUtils.withBroadcastStream``'s contract: the
+    variables are fully materialized before ``fn`` executes (the reference
+    blocks or spills pending inputs to achieve this; eager device_put makes
+    it trivially true here).
+    """
+    ctx = BroadcastContext(
+        {name: _materialize(value, mesh) for name, value in broadcast.items()})
+    return fn(*inputs, ctx)

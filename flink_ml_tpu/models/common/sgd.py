@@ -26,7 +26,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ...iteration import IterationBodyResult, IterationConfig, iterate
 from ...parallel.mesh import default_mesh, replicate
 
-__all__ = ["SGDConfig", "sgd_fit", "LinearState"]
+__all__ = ["SGDConfig", "sgd_fit", "LinearState", "plan_epoch_layout",
+           "prepare_epoch_tensor"]
 
 LossFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
 
@@ -49,8 +50,20 @@ class LinearState:
     intercept: float
 
 
-def _prepare_epoch_tensor(arr: np.ndarray, perm: np.ndarray, steps: int,
-                          batch: int, pad_value: float = 0.0) -> np.ndarray:
+def plan_epoch_layout(n: int, global_batch_size: int, n_dev: int,
+                      seed: int) -> Tuple[int, int, np.ndarray]:
+    """Size the (steps, batch) epoch grid — batch divisible by the mesh's
+    data axis — and the seeded row shuffle.  THE canonical sizing used by
+    every mini-batch trainer (sgd_fit, WideDeep)."""
+    batch = max(global_batch_size, n_dev)
+    batch += (-batch) % n_dev
+    steps = max(1, -(-n // batch))
+    perm = np.random.default_rng(seed).permutation(n)
+    return steps, batch, perm
+
+
+def prepare_epoch_tensor(arr: np.ndarray, perm: np.ndarray, steps: int,
+                         batch: int, pad_value: float = 0.0) -> np.ndarray:
     """Shuffle rows by ``perm``, pad to steps*batch, reshape to
     (steps, batch, ...)."""
     arr = arr[perm]
@@ -59,6 +72,9 @@ def _prepare_epoch_tensor(arr: np.ndarray, perm: np.ndarray, steps: int,
         pad_shape = (total - arr.shape[0],) + arr.shape[1:]
         arr = np.concatenate([arr, np.full(pad_shape, pad_value, arr.dtype)])
     return arr.reshape((steps, batch) + arr.shape[1:])
+
+
+_prepare_epoch_tensor = prepare_epoch_tensor  # internal alias
 
 
 def sgd_fit(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
@@ -74,12 +90,8 @@ def sgd_fit(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
     mesh = mesh or default_mesh()
     n_dev = int(mesh.shape["data"])
     n, d = features.shape
-    batch = max(config.global_batch_size, n_dev)
-    batch += (-batch) % n_dev  # divisible by the data axis
-    steps = max(1, -(-n // batch))
-
-    rng = np.random.default_rng(config.seed)
-    perm = rng.permutation(n)
+    steps, batch, perm = plan_epoch_layout(
+        n, config.global_batch_size, n_dev, config.seed)
 
     X = _prepare_epoch_tensor(features.astype(np.float32), perm, steps, batch)
     y = _prepare_epoch_tensor(labels.astype(np.float32), perm, steps, batch)

@@ -1,0 +1,1 @@
+from .widedeep import WideDeep, WideDeepModel, WideDeepParams  # noqa: F401

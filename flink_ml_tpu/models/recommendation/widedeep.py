@@ -1,0 +1,340 @@
+"""Wide&Deep two-tower recommender (BASELINE.json stretch config 5).
+
+Not present in the reference (its iteration runtime was never stretched to
+DNNs — that's the point of this config): a wide linear tower over
+categorical ids + dense features, and a deep tower of embeddings + MLP,
+trained jointly with Adam on binary cross-entropy.
+
+TPU-native design:
+- one stacked embedding table ``(total_vocab, emb_dim)`` — lookups are a
+  single gather, MXU-friendly; per-field vocabularies are offset into it
+- the whole multi-epoch training loop is fused (``iterate`` + inner
+  ``lax.scan`` over mini-batches), parameters and optimizer state live in
+  HBM between epochs
+- sharding: batch over the mesh's ``data`` axis; with a ``model`` axis the
+  embedding dim and MLP hidden dims shard over it (tensor parallelism) —
+  see ``build_sharded_train_step`` which __graft_entry__ dry-runs multichip
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...api.stage import Estimator, Model
+from ...data.table import Table
+from ...iteration import IterationBodyResult, IterationConfig, iterate
+from ...params.param import (
+    FloatParam,
+    IntArrayParam,
+    IntParam,
+    ParamValidators,
+    StringParam,
+)
+from ...params.shared import (
+    HasGlobalBatchSize,
+    HasLabelCol,
+    HasMaxIter,
+    HasPredictionCol,
+    HasRawPredictionCol,
+    HasSeed,
+)
+from ...parallel.mesh import default_mesh, replicate
+from ...utils import persist
+from ..common.losses import logistic_loss
+from ..common.sgd import plan_epoch_layout, prepare_epoch_tensor
+
+__all__ = ["WideDeep", "WideDeepModel", "WideDeepParams"]
+
+
+class WideDeepParams(HasLabelCol, HasPredictionCol, HasRawPredictionCol,
+                     HasMaxIter, HasGlobalBatchSize, HasSeed):
+    DENSE_FEATURES_COL = StringParam(
+        "denseFeaturesCol", "Dense feature matrix column.",
+        default="denseFeatures")
+    CAT_FEATURES_COL = StringParam(
+        "catFeaturesCol", "Categorical id matrix column (int).",
+        default="catFeatures")
+    VOCAB_SIZES = IntArrayParam(
+        "vocabSizes", "Vocabulary size per categorical field.",
+        default=None, validator=lambda v: v is None or (len(v) > 0 and
+                                                        all(s > 0 for s in v)))
+    EMBEDDING_DIM = IntParam("embeddingDim", "Embedding width per field.",
+                             default=8, validator=ParamValidators.gt(0))
+    HIDDEN_UNITS = IntArrayParam("hiddenUnits", "Deep-tower MLP widths.",
+                                 default=(64, 32))
+    LEARNING_RATE = FloatParam("learningRate", "Adam learning rate.",
+                               default=1e-2, validator=ParamValidators.gt(0))
+
+    def get_vocab_sizes(self):
+        return self.get(WideDeepParams.VOCAB_SIZES)
+
+    def set_vocab_sizes(self, v):
+        return self.set(WideDeepParams.VOCAB_SIZES, v)
+
+
+def _field_offsets(vocab_sizes) -> np.ndarray:
+    return np.concatenate([[0], np.cumsum(vocab_sizes)[:-1]]).astype(np.int32)
+
+
+def init_params(rng: np.random.Generator, d_dense: int, vocab_sizes,
+                emb_dim: int, hidden) -> Dict[str, Any]:
+    total_vocab = int(np.sum(vocab_sizes))
+    n_fields = len(vocab_sizes)
+    deep_in = d_dense + n_fields * emb_dim
+    layers = []
+    fan_in = deep_in
+    for h in list(hidden) + [1]:
+        scale = np.sqrt(2.0 / fan_in)
+        layers.append({
+            "w": (rng.normal(size=(fan_in, h)) * scale).astype(np.float32),
+            "b": np.zeros((h,), np.float32),
+        })
+        fan_in = h
+    return {
+        "wide_cat": np.zeros((total_vocab,), np.float32),
+        "wide_dense": np.zeros((d_dense,), np.float32),
+        "wide_b": np.zeros((), np.float32),
+        "emb": (rng.normal(size=(total_vocab, emb_dim)) * 0.05
+                ).astype(np.float32),
+        "mlp": layers,
+    }
+
+
+def forward(params: Dict[str, Any], dense: jnp.ndarray,
+            cat_ids: jnp.ndarray) -> jnp.ndarray:
+    """Logits for a batch.  ``cat_ids`` are already offset into the stacked
+    vocab (shape (batch, n_fields))."""
+    wide = (dense @ params["wide_dense"]
+            + jnp.sum(params["wide_cat"][cat_ids], axis=1)
+            + params["wide_b"])
+    emb = params["emb"][cat_ids]                      # (b, fields, emb)
+    deep = jnp.concatenate(
+        [dense, emb.reshape(emb.shape[0], -1)], axis=1)
+    for i, layer in enumerate(params["mlp"]):
+        deep = deep @ layer["w"] + layer["b"]
+        if i + 1 < len(params["mlp"]):
+            deep = jax.nn.relu(deep)
+    return wide + deep[:, 0]
+
+
+def bce_loss(params, dense, cat_ids, labels, mask):
+    # Identical to the linear family's masked binary log-loss — one shared
+    # implementation of the {0,1}->±1 softplus form and padding epsilon.
+    return logistic_loss(forward(params, dense, cat_ids), labels, mask)
+
+
+def _validate_cat_ids(cat: np.ndarray, vocab_sizes) -> np.ndarray:
+    """Range-check raw per-field ids, then offset into the stacked vocab.
+    Both fit() and transform() go through here: a jitted gather silently
+    CLAMPS out-of-range indices, so serving an unseen id would otherwise
+    return another field's embedding with no error."""
+    if cat.shape[1] != len(vocab_sizes):
+        raise ValueError(
+            f"catFeatures has {cat.shape[1]} fields, vocabSizes has "
+            f"{len(vocab_sizes)}")
+    if np.any(cat < 0) or np.any(cat >= np.asarray(vocab_sizes)[None, :]):
+        raise ValueError("categorical id out of vocab range")
+    return cat + _field_offsets(vocab_sizes)[None, :]
+
+
+class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
+    """fit(table with denseFeatures (n,d) float, catFeatures (n,f) int,
+    label (n,) {0,1})."""
+
+    def fit(self, *inputs) -> "WideDeepModel":
+        (table,) = inputs
+        vocab_sizes = self.get_vocab_sizes()
+        if vocab_sizes is None:
+            raise ValueError("WideDeep requires vocabSizes to be set")
+        mesh = default_mesh()
+        n_dev = int(mesh.shape["data"])
+
+        dense = np.asarray(table[self.DENSE_FEATURES_COL],
+                           np.float32)
+        cat = np.asarray(table[self.CAT_FEATURES_COL], np.int32)
+        labels = np.asarray(table[self.get_label_col()], np.float32)
+        cat = _validate_cat_ids(cat, vocab_sizes)
+
+        n = dense.shape[0]
+        steps, batch, perm = plan_epoch_layout(
+            n, self.get_global_batch_size(), n_dev, self.get_seed())
+
+        def layout(arr):
+            return prepare_epoch_tensor(arr, perm, steps, batch)
+
+        mask = layout(np.ones((n,), np.float32))
+        X = layout(dense)
+        C = layout(cat)
+        y = layout(labels)
+
+        bsh = NamedSharding(mesh, P(None, "data"))
+        X = jax.device_put(X, NamedSharding(mesh, P(None, "data", None)))
+        C = jax.device_put(C, NamedSharding(mesh, P(None, "data", None)))
+        y, mask = jax.device_put(y, bsh), jax.device_put(mask, bsh)
+
+        rng = np.random.default_rng(self.get_seed() + 1)  # init-draw stream
+        params = replicate(
+            init_params(rng, dense.shape[1], vocab_sizes,
+                        self.EMBEDDING_DIM,
+                        self.HIDDEN_UNITS), mesh)
+        opt = optax.adam(self.LEARNING_RATE)
+        opt_state = replicate(opt.init(params), mesh)
+        grad_fn = jax.value_and_grad(bce_loss)
+
+        def epoch_body(state, epoch, data):
+            Xd, Cd, yd, md = data
+            params, opt_state, loss_log = state
+
+            def batch_step(carry, i):
+                params, opt_state = carry
+                loss, grads = grad_fn(params, Xd[i], Cd[i], yd[i], md[i])
+                updates, opt_state = opt.update(grads, opt_state, params)
+                return (optax.apply_updates(params, updates), opt_state), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                batch_step, (params, opt_state),
+                jnp.arange(steps, dtype=jnp.int32))
+            loss_log = loss_log.at[epoch].set(jnp.mean(losses))
+            return IterationBodyResult((params, opt_state, loss_log))
+
+        max_epochs = self.get_max_iter()
+        init_state = (params, opt_state,
+                      jnp.full((max_epochs,), jnp.nan, jnp.float32))
+        result = iterate(epoch_body, init_state, (X, C, y, mask),
+                         max_epochs=max_epochs,
+                         config=IterationConfig(mode="fused"))
+        fitted, _, loss_buf = result.state
+
+        model = WideDeepModel()
+        model.copy_params_from(self)
+        model._params = jax.device_get(fitted)
+        model._vocab_sizes = tuple(int(v) for v in vocab_sizes)
+        model._loss_log = list(np.asarray(jax.device_get(loss_buf)))
+        return model
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "WideDeep":
+        return persist.load_stage_param(path)
+
+
+@jax.jit
+def _jit_scores(params, dense, cat_ids):
+    return jax.nn.sigmoid(forward(params, dense, cat_ids))
+
+
+class WideDeepModel(WideDeepParams, Model):
+    def __init__(self):
+        super().__init__()
+        self._params: Optional[Dict[str, Any]] = None
+        self._vocab_sizes: Optional[Tuple[int, ...]] = None
+        self._loss_log: List[float] = []
+
+    def _require_model(self):
+        if self._params is None:
+            raise RuntimeError("WideDeepModel has no model data")
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        self._require_model()
+        dense = np.asarray(table[self.DENSE_FEATURES_COL],
+                           np.float32)
+        cat = np.asarray(table[self.CAT_FEATURES_COL], np.int32)
+        cat = _validate_cat_ids(cat, self._vocab_sizes)
+        scores = np.asarray(_jit_scores(self._params, dense, cat), np.float64)
+        out = table.with_column(self.get_raw_prediction_col(), scores)
+        out = out.with_column(self.get_prediction_col(),
+                              (scores > 0.5).astype(np.int64))
+        return [out]
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path: str) -> None:
+        self._require_model()
+        persist.save_metadata(
+            self, path, {"vocabSizes": list(self._vocab_sizes)})
+        flat = {"wide_cat": self._params["wide_cat"],
+                "wide_dense": self._params["wide_dense"],
+                "wide_b": self._params["wide_b"],
+                "emb": self._params["emb"]}
+        for i, layer in enumerate(self._params["mlp"]):
+            flat[f"mlp_{i}_w"] = layer["w"]
+            flat[f"mlp_{i}_b"] = layer["b"]
+        persist.save_model_arrays(path, "model", flat)
+
+    @classmethod
+    def load(cls, path: str) -> "WideDeepModel":
+        model = persist.load_stage_param(path)
+        meta = persist.load_metadata(path)
+        data = persist.load_model_arrays(path, "model")
+        n_layers = sum(1 for k in data if k.endswith("_w"))
+        model._params = {
+            "wide_cat": data["wide_cat"],
+            "wide_dense": data["wide_dense"],
+            "wide_b": data["wide_b"],
+            "emb": data["emb"],
+            "mlp": [{"w": data[f"mlp_{i}_w"], "b": data[f"mlp_{i}_b"]}
+                    for i in range(n_layers)],
+        }
+        model._vocab_sizes = tuple(meta["vocabSizes"])
+        return model
+
+
+def build_sharded_train_step(mesh, d_dense: int, vocab_sizes, emb_dim: int,
+                             hidden, lr: float = 1e-2):
+    """A dp x tp training step for the multichip dry run: embeddings and MLP
+    hidden dims sharded over 'model', batch over 'data'.  Returns
+    (train_step, sharded_params, opt, sharded_opt_state, shard_batch_fn)."""
+    rng = np.random.default_rng(0)
+    params = init_params(rng, d_dense, vocab_sizes, emb_dim, hidden)
+
+    def param_spec(path_params):
+        specs = {
+            "wide_cat": P(), "wide_dense": P(), "wide_b": P(),
+            "emb": P(None, "model"),
+        }
+        mlp_specs = []
+        n = len(path_params["mlp"])
+        for i in range(n):
+            # Megatron-style pairing: even layers column-parallel (outputs
+            # sharded over 'model'), odd layers row-parallel (inputs sharded;
+            # XLA inserts the psum that gathers activations back).
+            if i % 2 == 0 and i + 1 < n:
+                mlp_specs.append({"w": P(None, "model"), "b": P("model")})
+            elif i % 2 == 1:
+                mlp_specs.append({"w": P("model", None), "b": P()})
+            else:  # final (or only) layer: replicated scalar head
+                mlp_specs.append({"w": P(), "b": P()})
+        return {**{k: specs[k] for k in specs}, "mlp": mlp_specs}
+
+    specs = param_spec(params)
+    sharded_params = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs, is_leaf=lambda x: isinstance(x, np.ndarray))
+
+    opt = optax.adam(lr)
+    opt_state = opt.init(sharded_params)
+    grad_fn = jax.value_and_grad(bce_loss)
+
+    @jax.jit
+    def train_step(params, opt_state, dense, cat_ids, labels, mask):
+        loss, grads = grad_fn(params, dense, cat_ids, labels, mask)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def shard_batch_fn(dense, cat_ids, labels, mask):
+        return (
+            jax.device_put(dense, NamedSharding(mesh, P("data", None))),
+            jax.device_put(cat_ids, NamedSharding(mesh, P("data", None))),
+            jax.device_put(labels, NamedSharding(mesh, P("data"))),
+            jax.device_put(mask, NamedSharding(mesh, P("data"))),
+        )
+
+    return train_step, sharded_params, opt, opt_state, shard_batch_fn

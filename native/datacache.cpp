@@ -1,0 +1,180 @@
+// Native IO core for the host-side epoch data cache.
+//
+// TPU-native replacement for the reference's spill-to-disk record log
+// (flink-ml-iteration datacache/nonkeyed/DataCacheWriter.java:36-145,
+// DataCacheReader.java:35-139).  The reference streams serialized records
+// through the JVM; here segments are raw columnar byte ranges and the native
+// layer provides:
+//   - dc_write / dc_read: positioned bulk IO (pread/pwrite loops)
+//   - dc_prefetch: posix_fadvise(WILLNEED) readahead so the NEXT epoch batch
+//     is in page cache while the device computes the current one (the
+//     double-buffering that keeps the TPU fed without host stalls)
+//   - a background prefetch thread pool so prefetch calls return immediately
+//
+// Built as a plain shared library, bound from Python via ctypes (no pybind11
+// in this image).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+extern "C" {
+
+// Positioned read: returns bytes read, or -1 on error.
+int64_t dc_read(const char* path, int64_t offset, int64_t nbytes, void* out) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  int64_t done = 0;
+  char* dst = static_cast<char*>(out);
+  while (done < nbytes) {
+    ssize_t n = ::pread(fd, dst + done, nbytes - done, offset + done);
+    if (n < 0) { ::close(fd); return -1; }
+    if (n == 0) break;  // EOF
+    done += n;
+  }
+  ::close(fd);
+  return done;
+}
+
+// Positioned/appending write: returns bytes written, or -1 on error.
+int64_t dc_write(const char* path, const void* buf, int64_t nbytes,
+                 int append) {
+  int flags = O_WRONLY | O_CREAT | (append ? O_APPEND : O_TRUNC);
+  int fd = ::open(path, flags, 0644);
+  if (fd < 0) return -1;
+  int64_t done = 0;
+  const char* src = static_cast<const char*>(buf);
+  while (done < nbytes) {
+    ssize_t n = ::write(fd, src + done, nbytes - done);
+    if (n < 0) { ::close(fd); return -1; }
+    done += n;
+  }
+  ::close(fd);
+  return done;
+}
+
+int64_t dc_file_size(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  off_t size = ::lseek(fd, 0, SEEK_END);
+  ::close(fd);
+  return static_cast<int64_t>(size);
+}
+
+// ---------------------------------------------------------------------------
+// Async prefetch pool
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct PrefetchTask {
+  std::string path;
+  int64_t offset;
+  int64_t nbytes;
+};
+
+class PrefetchPool {
+ public:
+  PrefetchPool() : stop_(false), pending_(0) {
+    for (int i = 0; i < 2; ++i) {
+      workers_.emplace_back([this] { Run(); });
+    }
+  }
+
+  ~PrefetchPool() {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+  void Enqueue(PrefetchTask task) {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      queue_.push_back(std::move(task));
+      ++pending_;
+    }
+    cv_.notify_one();
+  }
+
+  int64_t Pending() { return pending_.load(); }
+
+  void Drain() {
+    std::unique_lock<std::mutex> lock(mu_);
+    drained_.wait(lock, [this] { return pending_.load() == 0; });
+  }
+
+ private:
+  void Run() {
+    for (;;) {
+      PrefetchTask task;
+      {
+        std::unique_lock<std::mutex> lock(mu_);
+        cv_.wait(lock, [this] { return stop_ || !queue_.empty(); });
+        if (stop_ && queue_.empty()) return;
+        task = std::move(queue_.front());
+        queue_.pop_front();
+      }
+      int fd = ::open(task.path.c_str(), O_RDONLY);
+      if (fd >= 0) {
+#ifdef POSIX_FADV_WILLNEED
+        ::posix_fadvise(fd, task.offset, task.nbytes, POSIX_FADV_WILLNEED);
+#endif
+        // Touch the range to force it into page cache even on filesystems
+        // that ignore fadvise; 1MB stride keeps syscall count low.
+        static thread_local std::vector<char> scratch(1 << 20);
+        int64_t done = 0;
+        while (done < task.nbytes) {
+          ssize_t n = ::pread(fd, scratch.data(),
+                              std::min<int64_t>(scratch.size(),
+                                                task.nbytes - done),
+                              task.offset + done);
+          if (n <= 0) break;
+          done += n;
+        }
+        ::close(fd);
+      }
+      if (--pending_ == 0) {
+        std::lock_guard<std::mutex> lock(mu_);
+        drained_.notify_all();
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::condition_variable drained_;
+  std::deque<PrefetchTask> queue_;
+  std::vector<std::thread> workers_;
+  bool stop_;
+  std::atomic<int64_t> pending_;
+};
+
+PrefetchPool* pool() {
+  static PrefetchPool* p = new PrefetchPool();
+  return p;
+}
+
+}  // namespace
+
+// Enqueue background readahead of [offset, offset+nbytes) of path.
+void dc_prefetch(const char* path, int64_t offset, int64_t nbytes) {
+  pool()->Enqueue(PrefetchTask{std::string(path), offset, nbytes});
+}
+
+int64_t dc_prefetch_pending() { return pool()->Pending(); }
+
+void dc_prefetch_drain() { pool()->Drain(); }
+
+}  // extern "C"

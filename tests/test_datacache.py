@@ -1,0 +1,180 @@
+"""Data cache tests — mirror of ``DataCacheWriteReadTest`` (186 LoC) and
+``DataCacheSnapshotTest`` (213 LoC, both FS modes)."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.data.datacache import (
+    DataCacheReader,
+    DataCacheSnapshot,
+    DataCacheWriter,
+    Segment,
+    _native_lib,
+    load_segments,
+)
+
+
+def _write_cache(directory, n=100, segment_rows=32, d=3):
+    writer = DataCacheWriter(str(directory), segment_rows=segment_rows)
+    x = np.arange(n * d, dtype=np.float32).reshape(n, d)
+    y = np.arange(n, dtype=np.int64)
+    # append in uneven chunks to exercise rotation mid-batch
+    for lo, hi in [(0, 10), (10, 45), (45, 100)]:
+        writer.append({"x": x[lo:hi], "y": y[lo:hi]})
+    return writer.finish(), x, y
+
+
+def test_write_read_round_trip(tmp_path):
+    segments, x, y = _write_cache(tmp_path / "cache")
+    assert [s.rows for s in segments] == [32, 32, 32, 4]
+    reader = DataCacheReader(segments, batch_rows=17)
+    got_x, got_y = [], []
+    for batch in reader:
+        got_x.append(batch["x"])
+        got_y.append(batch["y"])
+    np.testing.assert_array_equal(np.concatenate(got_x), x)
+    np.testing.assert_array_equal(np.concatenate(got_y), y)
+    assert reader.cursor == 100
+
+
+def test_reader_from_manifest_dir(tmp_path):
+    cache_dir = tmp_path / "cache"
+    _, x, _ = _write_cache(cache_dir)
+    reader = DataCacheReader(str(cache_dir), batch_rows=100)
+    batch = reader.read_batch()
+    np.testing.assert_array_equal(batch["x"], x)
+    assert reader.read_batch() is None
+
+
+def test_reader_batch_spanning_segments(tmp_path):
+    # batch_rows > segment_rows forces concatenation across segments
+    segments, x, _ = _write_cache(tmp_path / "cache", segment_rows=16)
+    reader = DataCacheReader(segments, batch_rows=50)
+    batch = reader.read_batch()
+    assert batch["x"].shape == (50, 3)
+    np.testing.assert_array_equal(batch["x"], x[:50])
+
+
+def test_cursor_resume(tmp_path):
+    # The reference resumes a reader from (segmentIdx, offset)
+    # (DataCacheReader.java:35-139); here the cursor is a global row.
+    segments, x, _ = _write_cache(tmp_path / "cache")
+    r1 = DataCacheReader(segments, batch_rows=30)
+    r1.read_batch()
+    snap = r1.snapshot()
+    assert snap == {"cursor": 30}
+
+    r2 = DataCacheReader(segments, batch_rows=30)
+    r2.restore(snap)
+    batch = r2.read_batch()
+    np.testing.assert_array_equal(batch["x"], x[30:60])
+
+
+def test_schema_mismatch_rejected(tmp_path):
+    writer = DataCacheWriter(str(tmp_path / "c"))
+    writer.append({"x": np.zeros((4, 3), np.float32)})
+    with pytest.raises(ValueError):
+        writer.append({"x": np.zeros((4, 5), np.float32)})  # wrong row shape
+    with pytest.raises(ValueError):
+        writer.append({"z": np.zeros((4, 3), np.float32)})  # wrong name
+
+
+def test_append_after_finish_rejected(tmp_path):
+    writer = DataCacheWriter(str(tmp_path / "c"))
+    writer.append({"x": np.zeros((4, 3), np.float32)})
+    writer.finish()
+    with pytest.raises(RuntimeError):
+        writer.append({"x": np.zeros((4, 3), np.float32)})
+
+
+def test_snapshot_path_mode(tmp_path):
+    segments, x, _ = _write_cache(tmp_path / "cache")
+    snap_dir = str(tmp_path / "snap")
+    DataCacheSnapshot.write(segments, snap_dir, embed=False, cursor=42)
+    recovered, cursor = DataCacheSnapshot.recover(snap_dir)
+    assert cursor == 42
+    reader = DataCacheReader(recovered, batch_rows=100)
+    np.testing.assert_array_equal(reader.read_batch()["x"], x)
+
+
+def test_snapshot_embed_mode(tmp_path):
+    # embed=True copies bytes into the snapshot; recovery rebuilds segments
+    # in a NEW directory and the original cache can be deleted
+    # (DataCacheSnapshot.java:82-111 embedded mode).
+    import shutil
+
+    cache_dir = tmp_path / "cache"
+    segments, x, _ = _write_cache(cache_dir)
+    snap_dir = str(tmp_path / "snap")
+    DataCacheSnapshot.write(segments, snap_dir, embed=True, cursor=7)
+    shutil.rmtree(cache_dir)
+
+    restored, cursor = DataCacheSnapshot.recover(
+        snap_dir, restore_dir=str(tmp_path / "restored"))
+    assert cursor == 7
+    reader = DataCacheReader(restored, batch_rows=1000)
+    np.testing.assert_array_equal(reader.read_batch()["x"], x)
+
+
+def test_native_library_loads_and_prefetch(tmp_path):
+    lib = _native_lib()
+    assert lib is not None, "native datacache library failed to build/load"
+    segments, x, _ = _write_cache(tmp_path / "cache")
+    # prefetch path exercises the native thread pool
+    reader = DataCacheReader(segments, batch_rows=10, prefetch=True)
+    for _ in range(3):
+        reader.read_batch()
+    lib.dc_prefetch_drain()
+    assert lib.dc_prefetch_pending() == 0
+
+
+def test_native_write_read_agree_with_fallback(tmp_path):
+    # Force the fallback path and compare byte-for-byte with native output.
+    import flink_ml_tpu.data.datacache as dc
+
+    segments_native, x, y = _write_cache(tmp_path / "native")
+    lib = dc._LIB
+    try:
+        dc._LIB = None
+        segments_py, x2, y2 = _write_cache(tmp_path / "fallback")
+    finally:
+        dc._LIB = lib
+    for sn, sp in zip(segments_native, segments_py):
+        for name in sn.schema:
+            with open(sn.column_path(name), "rb") as f1, \
+                 open(sp.column_path(name), "rb") as f2:
+                assert f1.read() == f2.read()
+
+
+def test_empty_cache_rejected(tmp_path):
+    writer = DataCacheWriter(str(tmp_path / "c"))
+    segments = writer.finish()
+    with pytest.raises(ValueError):
+        DataCacheReader(segments, batch_rows=10)
+
+
+def test_iterate_integration(tmp_path):
+    # The cache feeds iterate() as a streaming source with cursor checkpoints
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.iteration import (IterationBodyResult, IterationConfig,
+                                        iterate)
+
+    segments, x, _ = _write_cache(tmp_path / "cache")
+    reader = DataCacheReader(segments, batch_rows=25)
+
+    def body(acc, epoch, batch):
+        return IterationBodyResult(acc + jnp.sum(batch["x"]))
+
+    res = iterate(body, jnp.asarray(0.0, jnp.float32),
+                  iter(reader), config=IterationConfig(mode="hosted"))
+    assert res.num_epochs == 4
+    np.testing.assert_allclose(float(res.state), x.sum(), rtol=1e-5)
+
+
+def test_dirty_directory_rejected(tmp_path):
+    # Reusing a cache dir must fail loudly, not serve stale leading bytes.
+    d = tmp_path / "cache"
+    _write_cache(d)
+    with pytest.raises(ValueError):
+        DataCacheWriter(str(d))
