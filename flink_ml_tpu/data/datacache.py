@@ -32,6 +32,12 @@ import numpy as np
 
 __all__ = ["DataCacheWriter", "DataCacheReader", "DataCacheSnapshot", "Segment"]
 
+
+def _col_filename(name: str) -> str:
+    """THE column file naming scheme — writer, reader and snapshot all
+    resolve through here."""
+    return f"col.{name}.bin"
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
@@ -46,13 +52,16 @@ def _native_lib() -> Optional[ctypes.CDLL]:
         return _LIB
     _LIB_TRIED = True
     so_path = os.path.join(_NATIVE_DIR, "build", "libdatacache.so")
-    if not os.path.exists(so_path) and os.path.exists(
-            os.path.join(_NATIVE_DIR, "Makefile")):
+    if os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
+        # Always invoke make: it's an incremental no-op when fresh and
+        # guarantees edits to datacache.cpp are picked up (a stale .so would
+        # silently serve old native code otherwise).
         try:
             subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
                            capture_output=True, timeout=120)
         except Exception:
-            return None
+            if not os.path.exists(so_path):
+                return None
     try:
         lib = ctypes.CDLL(so_path)
         lib.dc_read.restype = ctypes.c_int64
@@ -86,7 +95,7 @@ class Segment:
         self.schema = schema  # name -> (row_shape, dtype_str)
 
     def column_path(self, name: str) -> str:
-        return os.path.join(self.directory, f"col.{name}.bin")
+        return os.path.join(self.directory, _col_filename(name))
 
     def nbytes(self) -> int:
         total = 0
@@ -128,6 +137,7 @@ class DataCacheWriter:
         self._current_rows = 0
         self._current_dir: Optional[str] = None
         self._finished = False
+        self._broken = False
 
     def _check_schema(self, batch: Dict[str, np.ndarray]) -> None:
         schema = {name: (tuple(arr.shape[1:]), str(arr.dtype))
@@ -154,6 +164,10 @@ class DataCacheWriter:
     def append(self, batch: Dict[str, Any]) -> None:
         if self._finished:
             raise RuntimeError("writer already finished")
+        if self._broken:
+            raise RuntimeError(
+                "writer is broken: a previous append failed mid-write, the "
+                "current segment may hold partial column bytes")
         batch = {k: np.ascontiguousarray(v) for k, v in batch.items()}
         rows = next(iter(batch.values())).shape[0]
         for name, arr in batch.items():
@@ -163,28 +177,35 @@ class DataCacheWriter:
 
         written = 0
         lib = _native_lib()
-        while written < rows:
-            if self._current_dir is None:
-                self._open_segment()
-            take = min(rows - written, self.segment_rows - self._current_rows)
-            for name, arr in batch.items():
-                chunk = np.ascontiguousarray(arr[written:written + take])
-                path = self.column_path_for_current(name)
-                if lib is not None:
-                    r = lib.dc_write(path.encode(), chunk.ctypes.data,
-                                     chunk.nbytes, 1)
-                    if r != chunk.nbytes:
-                        raise IOError(f"native write failed for {path}")
-                else:
-                    with open(path, "ab") as f:
-                        f.write(chunk.tobytes())
-            written += take
-            self._current_rows += take
-            if self._current_rows >= self.segment_rows:
-                self._rotate()
+        try:
+            while written < rows:
+                if self._current_dir is None:
+                    self._open_segment()
+                take = min(rows - written,
+                           self.segment_rows - self._current_rows)
+                for name, arr in batch.items():
+                    chunk = np.ascontiguousarray(arr[written:written + take])
+                    path = self.column_path_for_current(name)
+                    if lib is not None:
+                        r = lib.dc_write(path.encode(), chunk.ctypes.data,
+                                         chunk.nbytes, 1)
+                        if r != chunk.nbytes:
+                            raise IOError(f"native write failed for {path}")
+                    else:
+                        with open(path, "ab") as f:
+                            f.write(chunk.tobytes())
+                written += take
+                self._current_rows += take
+                if self._current_rows >= self.segment_rows:
+                    self._rotate()
+        except Exception:
+            # Columns written before the failing one hold partial bytes for
+            # this chunk; retrying would silently shift every later row.
+            self._broken = True
+            raise
 
     def column_path_for_current(self, name: str) -> str:
-        return os.path.join(self._current_dir, f"col.{name}.bin")
+        return os.path.join(self._current_dir, _col_filename(name))
 
     def finish(self) -> List[Segment]:
         """Seal the cache and write the manifest
@@ -342,7 +363,7 @@ class DataCacheSnapshot:
                 for name in seg.schema:
                     shutil.copyfile(
                         seg.column_path(name),
-                        os.path.join(payload_dir, f"{i:05d}.col.{name}.bin"))
+                        os.path.join(payload_dir, f"{i:05d}." + _col_filename(name)))
         with open(os.path.join(target, "snapshot.json"), "w") as f:
             json.dump(doc, f)
 
@@ -363,8 +384,8 @@ class DataCacheSnapshot:
                 for name in seg.schema:
                     shutil.copyfile(
                         os.path.join(target, "payload",
-                                     f"{i:05d}.col.{name}.bin"),
-                        os.path.join(seg_dir, f"col.{name}.bin"))
+                                     f"{i:05d}." + _col_filename(name)),
+                        os.path.join(seg_dir, _col_filename(name)))
                 restored.append(Segment(seg_dir, seg.rows, seg.schema))
             segments = restored
         return segments, int(doc["cursor"])

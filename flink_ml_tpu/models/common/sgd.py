@@ -74,9 +74,6 @@ def prepare_epoch_tensor(arr: np.ndarray, perm: np.ndarray, steps: int,
     return arr.reshape((steps, batch) + arr.shape[1:])
 
 
-_prepare_epoch_tensor = prepare_epoch_tensor  # internal alias
-
-
 def sgd_fit(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
             weights: Optional[np.ndarray], config: SGDConfig,
             mesh=None) -> Tuple[LinearState, list]:
@@ -93,11 +90,11 @@ def sgd_fit(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
     steps, batch, perm = plan_epoch_layout(
         n, config.global_batch_size, n_dev, config.seed)
 
-    X = _prepare_epoch_tensor(features.astype(np.float32), perm, steps, batch)
-    y = _prepare_epoch_tensor(labels.astype(np.float32), perm, steps, batch)
+    X = prepare_epoch_tensor(features.astype(np.float32), perm, steps, batch)
+    y = prepare_epoch_tensor(labels.astype(np.float32), perm, steps, batch)
     w_host = (weights.astype(np.float32) if weights is not None
               else np.ones((n,), np.float32))
-    w = _prepare_epoch_tensor(w_host, perm, steps, batch, pad_value=0.0)
+    w = prepare_epoch_tensor(w_host, perm, steps, batch, pad_value=0.0)
 
     batch_sharded = NamedSharding(mesh, P(None, "data"))
     x_sharded = NamedSharding(mesh, P(None, "data", None))

@@ -1,0 +1,4 @@
+from .kmeans_pallas import (  # noqa: F401
+    kmeans_assign_reduce,
+    kmeans_update_stats,
+)

@@ -10,3 +10,6 @@ from .mesh import (  # noqa: F401
     shard_batch,
     use_mesh,
 )
+from . import collectives  # noqa: F401
+from .ring_attention import attention_reference, ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401

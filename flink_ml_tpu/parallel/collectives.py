@@ -1,0 +1,77 @@
+"""Collective helpers for explicit-SPMD iteration bodies.
+
+The reference's data plane is Flink's netty shuffle chosen by partitioners
+(SURVEY §2.10); the TPU-native data plane is XLA collectives over ICI.  Most
+bodies never call these directly — jit + NamedSharding lets XLA insert them —
+but explicit ``shard_map`` bodies (ring attention, custom reductions, the
+termination vote) use this thin, named vocabulary.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "psum",
+    "pmean",
+    "pmax",
+    "all_gather",
+    "reduce_scatter",
+    "ppermute_ring",
+    "axis_index",
+    "axis_size",
+    "shard_map_fn",
+]
+
+
+def psum(x: Any, axis: str) -> Any:
+    """All-reduce sum over a mesh axis (the gradient/centroid aggregation
+    that replaces the reference's keyed reduce + network shuffle)."""
+    return lax.psum(x, axis)
+
+
+def pmean(x: Any, axis: str) -> Any:
+    return lax.pmean(x, axis)
+
+
+def pmax(x: Any, axis: str) -> Any:
+    return lax.pmax(x, axis)
+
+
+def all_gather(x: Any, axis: str, *, tiled: bool = True) -> Any:
+    """Gather shards along the leading dim (the broadcast-variable fan-in)."""
+    return lax.all_gather(x, axis, tiled=tiled)
+
+
+def reduce_scatter(x: Any, axis: str, *, scatter_dimension: int = 0) -> Any:
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dimension,
+                            tiled=True)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    return lax.axis_size(axis)
+
+
+def ppermute_ring(x: Any, axis: str, *, shift: int = 1) -> Any:
+    """Rotate shards around the ring formed by a mesh axis (the KV rotation
+    of ring attention; rides neighbor ICI links only)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def shard_map_fn(fn: Callable, mesh: Mesh, in_specs, out_specs,
+                 check_vma: bool = False) -> Callable:
+    """``jax.shard_map`` with this framework's default flags."""
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=check_vma)

@@ -1,0 +1,124 @@
+"""Ring attention — sequence/context parallelism over the mesh ring.
+
+Long-context support is first-class in this framework (the reference has no
+attention anywhere — SURVEY §5 "long-context: absent" — but the driver brief
+requires the capability).  Two standard schemes:
+
+- :func:`ring_attention`: Q stays put; K/V blocks rotate around the mesh
+  ring via ``ppermute`` (neighbor ICI links only), with a numerically-stable
+  online-softmax accumulation — memory per device is O(seq/devices), so
+  context length scales linearly with the ring size.
+- :func:`ulysses_attention` (see ulysses.py): all-to-all re-shard from
+  sequence-sharded to head-sharded, run dense local attention, a2a back.
+
+Layout convention: ``(batch, seq, heads, head_dim)``, sequence sharded over
+the given mesh axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "attention_reference"]
+
+
+def attention_reference(q, k, v, *, causal: bool = False, scale=None):
+    """Vanilla full attention (the correctness oracle for the parallel
+    schemes).  Shapes (b, s, h, d)."""
+    b, s_q, h, d = q.shape
+    scale = scale or (1.0 / np.sqrt(d))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((s_q, k.shape[1]), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block_attend(q, k, v, q_offset, k_offset, scale, causal):
+    """Scores of a local Q block against one K/V block with running-softmax
+    stats.  Returns (numerator, running max, running denom)."""
+    s_q, s_k = q.shape[1], k.shape[1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale        # (b,h,sq,sk)
+    if causal:
+        q_idx = q_offset + lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+        k_idx = k_offset + lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+        scores = jnp.where((k_idx <= q_idx)[None, None], scores, -jnp.inf)
+    block_max = jnp.max(scores, axis=-1)                        # (b,h,sq)
+    # guard fully-masked rows (all -inf) -> exp(0)=..0 contribution
+    safe_max = jnp.where(jnp.isfinite(block_max), block_max, 0.0)
+    probs = jnp.exp(scores - safe_max[..., None])
+    probs = jnp.where(jnp.isfinite(scores), probs, 0.0)
+    numer = jnp.einsum("bhqk,bkhd->bqhd", probs, v)             # (b,sq,h,d)
+    denom = jnp.sum(probs, axis=-1)                             # (b,h,sq)
+    return numer, safe_max, denom
+
+
+def _online_merge(acc, update):
+    """Merge two (numer, max, denom) softmax partials."""
+    n1, m1, d1 = acc
+    n2, m2, d2 = update
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    numer = n1 * a1.transpose(0, 2, 1)[..., None] \
+        + n2 * a2.transpose(0, 2, 1)[..., None]
+    denom = d1 * a1 + d2 * a2
+    return numer, m, denom
+
+
+def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "seq",
+                   causal: bool = False, scale: Optional[float] = None):
+    """Exact attention with sequence sharded over ``axis``.
+
+    Each device holds one Q/K/V block; K/V rotate ``axis_size`` times around
+    the ring (``ppermute`` to the right neighbor) while Q stays resident,
+    merging block results with online softmax — the classic ring schedule
+    (Liu et al., Ring Attention; also the blockwise-parallel formulation).
+    """
+    d = q.shape[-1]
+    scale = scale or (1.0 / np.sqrt(d))
+    n = int(mesh.shape[axis])
+    block = q.shape[1] // n
+    if q.shape[1] % n:
+        raise ValueError(f"seq len {q.shape[1]} not divisible by ring size {n}")
+
+    def local(qb, kb, vb):
+        idx = lax.axis_index(axis)
+        q_off = idx * block
+        # Start with the local block, then rotate k/v (n-1) times.
+        numer, m, denom = _block_attend(qb, kb, vb, q_off, idx * block,
+                                        scale, causal)
+
+        def step(i, carry):
+            numer, m, denom, k_cur, v_cur = carry
+            k_cur = _rot(k_cur)
+            v_cur = _rot(v_cur)
+            # after i+1 rotations this device holds the block originally at
+            # ring position (idx - i - 1) mod n
+            src = (idx - i - 1) % n
+            upd = _block_attend(qb, k_cur, v_cur, q_off, src * block,
+                                scale, causal)
+            numer, m, denom = _online_merge((numer, m, denom), upd)
+            return numer, m, denom, k_cur, v_cur
+
+        def _rot(x):
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            return lax.ppermute(x, axis, perm)
+
+        numer, m, denom, _, _ = lax.fori_loop(
+            0, n - 1, step, (numer, m, denom, kb, vb))
+        denom = jnp.maximum(denom, 1e-20)
+        return numer / denom.transpose(0, 2, 1)[..., None]
+
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)

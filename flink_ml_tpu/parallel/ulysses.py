@@ -1,0 +1,54 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence re-shard.
+
+Complementary to ring attention: instead of rotating K/V, one
+``all_to_all`` turns sequence-sharded activations into head-sharded ones, a
+dense local attention runs per device over the FULL sequence for its subset
+of heads, and a second ``all_to_all`` restores sequence sharding.  Two a2a
+hops instead of (n-1) ring steps — better when heads >= devices and the
+interconnect is all-to-all capable (intra-pod ICI)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .ring_attention import attention_reference
+
+__all__ = ["ulysses_attention"]
+
+
+def ulysses_attention(q, k, v, *, mesh: Mesh, axis: str = "seq",
+                      causal: bool = False, scale: Optional[float] = None):
+    """Exact attention with sequence sharded over ``axis``; heads must be
+    divisible by the axis size.  Layout (b, s, h, d)."""
+    n = int(mesh.shape[axis])
+    b, s, h, d = q.shape
+    if h % n:
+        raise ValueError(f"heads {h} not divisible by axis size {n}")
+    if s % n:
+        raise ValueError(f"seq {s} not divisible by axis size {n}")
+
+    def local(qb, kb, vb):
+        # (b, s/n, h, d) -> (b, s, h/n, d): gather sequence, scatter heads
+        def seq_to_heads(x):
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        def heads_to_seq(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        q_h = seq_to_heads(qb)
+        k_h = seq_to_heads(kb)
+        v_h = seq_to_heads(vb)
+        out = attention_reference(q_h, k_h, v_h, causal=causal, scale=scale)
+        return heads_to_seq(out)
+
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)

@@ -1,0 +1,59 @@
+"""Profiler hooks — the TPU answer to the reference's latency tracking.
+
+The reference's only tracing is Flink LatencyMarker stats in the per-round
+wrapper (SURVEY §5).  Here: thin wrappers over ``jax.profiler`` producing
+Perfetto/XPlane traces of the jitted epoch steps, plus named trace
+annotations for host-side phases.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+from typing import Iterator, Optional
+
+import jax
+
+__all__ = ["trace", "annotate", "StepTimer"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a device+host profile into ``log_dir`` (view with Perfetto /
+    tensorboard).  Usage: ``with profiler.trace("/tmp/prof"): fit()``."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named host annotation that shows up on the trace timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Wall-clock timer with a device fence: ``device_get`` of a probe value
+    is the only reliable completion barrier on the axon tunnel (see
+    bench.py), so ``stop(probe_array)`` fetches it before reading the
+    clock."""
+
+    def __init__(self) -> None:
+        self._t0: Optional[float] = None
+        self.laps = []
+
+    def start(self) -> "StepTimer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self, probe=None) -> float:
+        if probe is not None:
+            jax.device_get(probe)
+        if self._t0 is None:
+            raise RuntimeError("StepTimer.stop() before start()")
+        elapsed = time.perf_counter() - self._t0
+        self.laps.append(elapsed)
+        self._t0 = None
+        return elapsed

@@ -178,3 +178,34 @@ def test_dirty_directory_rejected(tmp_path):
     _write_cache(d)
     with pytest.raises(ValueError):
         DataCacheWriter(str(d))
+
+
+def test_broken_writer_refuses_retry(tmp_path, monkeypatch):
+    import flink_ml_tpu.data.datacache as dc
+
+    writer = DataCacheWriter(str(tmp_path / "c"))
+    writer.append({"x": np.zeros((4, 3), np.float32),
+                   "y": np.zeros((4,), np.int64)})
+
+    # make the second column's write fail mid-append
+    real_open = open
+    calls = {"n": 0}
+
+    def failing_open(path, mode="r", *a, **k):
+        if str(path).endswith(dc._col_filename("y")) and mode == "ab":
+            raise IOError("disk full")
+        return real_open(path, mode, *a, **k)
+
+    lib = dc._LIB
+    try:
+        dc._LIB = None  # force the python write path
+        monkeypatch.setattr("builtins.open", failing_open)
+        with pytest.raises(IOError):
+            writer.append({"x": np.ones((4, 3), np.float32),
+                           "y": np.ones((4,), np.int64)})
+        monkeypatch.undo()
+        with pytest.raises(RuntimeError):  # broken: no silent retry
+            writer.append({"x": np.ones((4, 3), np.float32),
+                           "y": np.ones((4,), np.int64)})
+    finally:
+        dc._LIB = lib

@@ -1,0 +1,75 @@
+"""Pallas kernel tests — run in interpret mode on the CPU mesh (the kernels
+compile natively on TPU; interpret mode is the portable correctness oracle)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_ml_tpu.ops.kmeans_pallas import (
+    kmeans_assign_reduce,
+    kmeans_update_stats,
+    supported,
+)
+
+
+def _problem(n=512, d=16, k=8, seed=0):
+    rng = np.random.default_rng(seed)
+    pts = rng.normal(size=(n, d)).astype(np.float32)
+    cents = pts[:k].copy()
+    mask = np.ones((n,), np.float32)
+    mask[-17:] = 0.0  # padding rows
+    return jnp.asarray(pts), jnp.asarray(mask), jnp.asarray(cents)
+
+
+def _oracle(pts, mask, cents):
+    pts, mask, cents = map(np.asarray, (pts, mask, cents))
+    d2 = ((pts[:, None, :] - cents[None, :, :]) ** 2).sum(-1)
+    assign = d2.argmin(1)
+    oh = np.zeros((pts.shape[0], cents.shape[0]), np.float32)
+    oh[np.arange(pts.shape[0]), assign] = 1
+    oh *= mask[:, None]
+    return assign, oh.T @ pts, oh.sum(0)
+
+
+def test_assign_reduce_matches_oracle():
+    pts, mask, cents = _problem()
+    assign, sums, counts = kmeans_assign_reduce(pts, mask, cents,
+                                                block_n=128, interpret=True)
+    exp_assign, exp_sums, exp_counts = _oracle(pts, mask, cents)
+    np.testing.assert_array_equal(np.asarray(assign), exp_assign)
+    np.testing.assert_allclose(np.asarray(sums), exp_sums, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(counts), exp_counts)
+
+
+def test_update_stats_matches_oracle():
+    pts, mask, cents = _problem()
+    sums, counts = kmeans_update_stats(pts, mask, cents,
+                                       block_n=128, interpret=True)
+    _, exp_sums, exp_counts = _oracle(pts, mask, cents)
+    np.testing.assert_allclose(np.asarray(sums), exp_sums, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(counts), exp_counts, atol=1e-5)
+
+
+def test_mask_zeroes_padding_contribution():
+    pts, mask, cents = _problem()
+    # same points, but with padding rows replaced by huge values that would
+    # corrupt sums if the mask leaked
+    pts_np = np.asarray(pts).copy()
+    pts_np[-17:] = 1e6
+    sums, counts = kmeans_update_stats(jnp.asarray(pts_np), mask, cents,
+                                       block_n=128, interpret=True)
+    assert np.all(np.isfinite(np.asarray(sums)))
+    assert float(np.asarray(counts).sum()) == pytest.approx(512 - 17)
+    assert np.abs(np.asarray(sums)).max() < 1e4  # 1e6 rows never entered
+
+
+def test_block_divisibility_enforced():
+    pts, mask, cents = _problem(n=500)
+    with pytest.raises(ValueError):
+        kmeans_assign_reduce(pts, mask, cents, block_n=128, interpret=True)
+
+
+def test_supported_budget():
+    assert supported(64, 256)
+    assert not supported(4096, 8192)

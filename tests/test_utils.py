@@ -1,0 +1,81 @@
+"""Aux subsystem tests: metrics, config, profiler timer."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.iteration import IterationBodyResult, IterationConfig, iterate
+from flink_ml_tpu.utils.config import (
+    FrameworkConfig,
+    get_config,
+    resolve_cache_dir,
+    set_config,
+)
+from flink_ml_tpu.utils.metrics import (
+    IterationMetricsListener,
+    MetricGroup,
+)
+from flink_ml_tpu.utils.profiler import StepTimer
+
+
+def test_metric_group_hierarchy():
+    root = MetricGroup()
+    root.counter("a").inc(3)
+    sub = root.add_group("epoch")
+    sub.counter("records").inc(100)
+    sub.gauge("rate").set(5.5)
+    snap = root.snapshot()
+    assert snap == {"a": 3, "epoch.records": 100, "epoch.rate": 5.5}
+    # idempotent registration
+    root.counter("a").inc()
+    assert root.snapshot()["a"] == 4
+
+
+def test_iteration_metrics_listener():
+    listener = IterationMetricsListener(records_per_epoch=1000)
+
+    def body(x, e):
+        return IterationBodyResult(x + 1, outputs=x * 2.0)
+
+    res = iterate(body, jnp.asarray(0.0), max_epochs=4,
+                  config=IterationConfig(mode="hosted"),
+                  listeners=[listener])
+    assert len(listener.epoch_seconds) == 4
+    assert listener.epoch_metrics == [0.0, 2.0, 4.0, 6.0]
+    snap = listener.group.snapshot()
+    assert snap["epochs"] == 4
+    assert snap["records"] == 4000
+    assert snap["records_per_sec"] > 0
+    assert snap["total_seconds"] > 0
+
+
+def test_config_env_override(monkeypatch):
+    monkeypatch.setenv("FLINK_ML_TPU_DATA_CACHE_PATH", "/tmp/fmt_cache_test")
+    monkeypatch.setenv("FLINK_ML_TPU_LOG_EVERY_EPOCHS", "7")
+    cfg = FrameworkConfig.from_env()
+    assert cfg.data_cache_path == "/tmp/fmt_cache_test"
+    assert cfg.log_every_epochs == 7
+
+
+def test_resolve_cache_dir(tmp_path, monkeypatch):
+    old = get_config()
+    try:
+        set_config(FrameworkConfig(data_cache_path=str(tmp_path / "c")))
+        path = resolve_cache_dir()
+        assert path == str(tmp_path / "c")
+        assert os.path.isdir(path)
+
+        set_config(FrameworkConfig())  # fallback: fresh tmp dir
+        p1, p2 = resolve_cache_dir(), resolve_cache_dir()
+        assert p1 != p2 and os.path.isdir(p1)
+    finally:
+        set_config(old)
+
+
+def test_step_timer_fences_device_work():
+    t = StepTimer().start()
+    x = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+    elapsed = t.stop(probe=x)
+    assert elapsed > 0
+    assert t.laps == [elapsed]
