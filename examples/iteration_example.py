@@ -1,0 +1,20 @@
+"""The raw iteration API: fused epoch loop with termination criteria."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax.numpy as jnp
+
+from flink_ml_tpu.iteration import (IterationBodyResult, IterationConfig,
+                                    iterate)
+
+# Newton iteration for sqrt(2), terminating when converged
+def body(x, epoch):
+    new_x = 0.5 * (x + 2.0 / x)
+    return IterationBodyResult(feedback=new_x, outputs=new_x,
+                               termination=jnp.abs(new_x - x) > 1e-6)
+
+result = iterate(body, jnp.asarray(1.0), max_epochs=50,
+                 config=IterationConfig(mode="fused"))
+print(f"sqrt(2) = {float(result.state):.8f} in {result.num_epochs} epochs")

@@ -1,0 +1,30 @@
+"""KMeans end-to-end: fit, predict, save/load.
+
+Run: python examples/kmeans_example.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.clustering import KMeans, KMeansModel
+
+rng = np.random.default_rng(0)
+centers = np.array([[0.0, 0.0], [8.0, 8.0], [-8.0, 8.0]])
+points = np.concatenate(
+    [c + rng.normal(scale=0.5, size=(500, 2)) for c in centers])
+table = Table({"features": points})
+
+kmeans = KMeans().set_k(3).set_max_iter(20).set_seed(0)
+model = kmeans.fit(table)
+predictions = model.transform(table)[0]
+print("cluster sizes:", np.bincount(predictions["prediction"]))
+
+model.save("/tmp/kmeans_model")
+reloaded = KMeansModel.load("/tmp/kmeans_model")
+print("reloaded model predicts identically:",
+      np.array_equal(reloaded.transform(table)[0]["prediction"],
+                     predictions["prediction"]))

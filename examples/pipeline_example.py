@@ -1,0 +1,32 @@
+"""Feature pipeline: assemble -> scale -> logistic regression -> evaluate."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+from flink_ml_tpu import Pipeline, Table
+from flink_ml_tpu.models.classification import LogisticRegression
+from flink_ml_tpu.models.evaluation import BinaryClassificationEvaluator
+from flink_ml_tpu.models.feature import StandardScaler, VectorAssembler
+
+rng = np.random.default_rng(1)
+age = rng.uniform(18, 80, size=1000)
+income = rng.normal(50_000, 20_000, size=1000)
+label = ((age / 80 + income / 100_000 + rng.normal(scale=0.2, size=1000)) > 1
+         ).astype(np.int64)
+table = Table({"age": age, "income": income, "label": label})
+
+pipeline = Pipeline([
+    VectorAssembler().set_input_cols("age", "income").set_features_col("raw"),
+    StandardScaler().set_features_col("raw").set_output_col("features"),
+    LogisticRegression().set_max_iter(50).set_learning_rate(0.5),
+])
+model = pipeline.fit(table)
+scored = model.transform(table)[0]
+
+metrics = (BinaryClassificationEvaluator()
+           .set_metrics("areaUnderROC", "accuracy").transform(scored)[0])
+print("AUC: %.3f  accuracy: %.3f"
+      % (metrics["areaUnderROC"][0], metrics["accuracy"][0]))

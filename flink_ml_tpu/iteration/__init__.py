@@ -12,4 +12,4 @@ from .checkpoint import (  # noqa: F401
     load_pytree,
     save_pytree,
 )
-from .core import IterationResult, iterate  # noqa: F401
+from .core import IterationResult, PerEpoch, Replayed, iterate  # noqa: F401

@@ -78,58 +78,161 @@ def _vote_continue(vote: Any) -> bool:
     return bool(jax.device_get(vote))
 
 
+class Replayed:
+    """Marks a bounded input replayed identically every epoch (the analog of
+    ``ReplayableDataStreamList.replay(...)``).  On TPU a replayed input is
+    simply device-resident — replay costs nothing."""
+
+    def __init__(self, value: Any):
+        self.value = value
+
+
+class PerEpoch:
+    """Marks a per-epoch source: a callable ``f(epoch) -> pytree`` or an
+    iterable consumed one item per epoch (the analog of
+    ``ReplayableDataStreamList.notReplay(...)`` / an unbounded stream).
+    Exhaustion of any PerEpoch iterator ends the iteration."""
+
+    def __init__(self, source: Any):
+        self.source = source
+
+
+class _Feed:
+    """One normalized leaf source."""
+
+    def __init__(self, raw: Any):
+        self.static = None
+        self.fn = None
+        self.it: Optional[Iterator] = None
+        if callable(raw):
+            self.fn = raw
+        elif hasattr(raw, "__next__"):
+            self.it = raw
+        elif hasattr(raw, "__iter__") and not isinstance(raw, (dict, tuple,
+                                                              list, str)):
+            # keep the original object reachable for snapshot/restore
+            self.source = raw
+            self.it = iter(raw)
+        else:
+            self.static = raw
+        if not hasattr(self, "source"):
+            self.source = raw
+
+
 class _DataProvider:
     """Adapts the ``data`` argument to a per-epoch feed.
 
-    - None            -> body gets data=None every epoch
-    - pytree          -> same device-resident pytree every epoch (a *replayed*
-                         bounded input, ``ReplayableDataStreamList.replay()``)
-    - callable        -> ``data(epoch) -> pytree`` (non-replayed / generated)
-    - iterator        -> ``next()`` per epoch; exhaustion terminates the
-                         iteration (the bounded end of an unbounded stream)
+    - None                  -> body gets data=None every epoch
+    - pytree of arrays      -> replayed: same device-resident pytree each epoch
+    - callable / iterator   -> per-epoch source (exhaustion = stream end)
+    - Replayed(x)/PerEpoch(s) markers, possibly MIXED one level deep inside a
+      dict/tuple/list — the ``ReplayableDataStreamList`` analog: e.g.
+      ``{"train": Replayed(points), "stream": PerEpoch(reader)}``
     """
 
     def __init__(self, data: Any):
-        self._static = None
-        self._fn = None
-        self._it: Optional[Iterator] = None
         self.exhausted = False
-        if data is None or isinstance(data, (dict, tuple, list)) or hasattr(data, "shape"):
-            self._static = data
-        elif callable(data):
-            self._fn = data
-        elif hasattr(data, "__next__"):
-            self._it = data
-        elif hasattr(data, "__iter__"):
-            self._it = iter(data)
-        else:
-            self._static = data
+        self._container: Optional[type] = None
+        self._keys = None
+        self._feeds = None
+        self._single: Optional[_Feed] = None
+
+        data = self._unwrap(data)
+        if isinstance(data, _Feed):
+            self._single = data
+            return
+        if isinstance(data, dict) and any(
+                isinstance(v, (Replayed, PerEpoch)) for v in data.values()):
+            self._container = dict
+            self._keys = list(data.keys())
+            self._feeds = [self._unwrap(data[k], force=True)
+                           for k in self._keys]
+            return
+        if isinstance(data, (tuple, list)) and any(
+                isinstance(v, (Replayed, PerEpoch)) for v in data):
+            self._container = type(data)
+            self._feeds = [self._unwrap(v, force=True) for v in data]
+            return
+        # plain pytree (or None): replayed static data
+        self._single = _Feed(None)
+        self._single.static = data
+        self._single.source = data
+
+    @staticmethod
+    def _unwrap(value: Any, force: bool = False):
+        if isinstance(value, Replayed):
+            feed = _Feed(None)
+            feed.static = value.value
+            feed.source = value.value
+            return feed
+        if isinstance(value, PerEpoch):
+            return _Feed(value.source)
+        if force:
+            feed = _Feed(None)
+            feed.static = value
+            feed.source = value
+            return feed
+        if value is None or isinstance(value, (dict, tuple, list)) \
+                or hasattr(value, "shape"):
+            return value
+        return _Feed(value)
+
+    def _all_feeds(self):
+        if self._single is not None:
+            return [self._single]
+        return self._feeds
 
     @property
     def is_static(self) -> bool:
-        return self._fn is None and self._it is None
+        return all(f.fn is None and f.it is None for f in self._all_feeds())
 
-    def __call__(self, epoch: int) -> Any:
-        if self._it is not None:
+    def _pull(self, feed: _Feed, epoch: int) -> Any:
+        if feed.it is not None:
             try:
-                return next(self._it)
+                return next(feed.it)
             except StopIteration:
                 self.exhausted = True
                 return None
-        if self._fn is not None:
-            return self._fn(epoch)
-        return self._static
+        if feed.fn is not None:
+            return feed.fn(epoch)
+        return feed.static
+
+    def __call__(self, epoch: int) -> Any:
+        if self._single is not None:
+            return self._pull(self._single, epoch)
+        values = [self._pull(f, epoch) for f in self._feeds]
+        if self.exhausted:
+            return None
+        if self._container is dict:
+            return dict(zip(self._keys, values))
+        return self._container(values)
 
     def snapshot(self) -> Optional[dict]:
-        for src in (self._fn, self._it):
-            if src is not None and hasattr(src, "snapshot"):
-                return src.snapshot()
-        return None
+        # Single-feed caches keep the source's raw snapshot format (what
+        # checkpoints have always stored); multi-feed providers wrap the
+        # per-feed snapshots in an index-keyed envelope.
+        feeds = self._all_feeds()
+        if self._single is not None:
+            src = self._single.source
+            live = self._single.fn is not None or self._single.it is not None
+            return src.snapshot() if live and hasattr(src, "snapshot") else None
+        snaps = {}
+        for i, feed in enumerate(feeds):
+            live = feed.fn is not None or feed.it is not None
+            if live and hasattr(feed.source, "snapshot"):
+                snaps[str(i)] = feed.source.snapshot()
+        return {"__feeds__": snaps} if snaps else None
 
     def restore(self, snap: dict) -> None:
-        for src in (self._fn, self._it):
-            if src is not None and hasattr(src, "restore"):
-                src.restore(snap)
+        if "__feeds__" in snap:
+            for i, feed in enumerate(self._all_feeds()):
+                sub = snap["__feeds__"].get(str(i))
+                if sub is not None and hasattr(feed.source, "restore"):
+                    feed.source.restore(sub)
+            return
+        # raw single-source snapshot (incl. checkpoints from older runs)
+        if self._single is not None and hasattr(self._single.source, "restore"):
+            self._single.source.restore(snap)
 
 
 def _call_body(body: BodyFn, state, epoch, data) -> IterationBodyResult:

@@ -1,0 +1,1 @@
+from .binary_evaluator import BinaryClassificationEvaluator  # noqa: F401

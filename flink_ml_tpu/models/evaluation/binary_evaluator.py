@@ -1,0 +1,66 @@
+"""BinaryClassificationEvaluator — AUC-ROC / AUC-PR / accuracy as an
+AlgoOperator (evaluation is a table -> metrics-table mapping, the Flink ML
+evaluator shape).  The ROC integral is computed on device: one sort + two
+cumulative sums."""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import AlgoOperator
+from ...data.table import Table
+from ...params.param import StringArrayParam
+from ...params.shared import HasLabelCol, HasRawPredictionCol
+
+__all__ = ["BinaryClassificationEvaluator"]
+
+_SUPPORTED = ("areaUnderROC", "areaUnderPR", "accuracy")
+
+
+@jax.jit
+def _binary_metrics(scores, labels):
+    order = jnp.argsort(-scores)  # descending by score
+    y = labels[order]
+    pos = jnp.sum(y)
+    neg = y.shape[0] - pos
+    tp = jnp.cumsum(y)
+    fp = jnp.cumsum(1.0 - y)
+    tpr = tp / jnp.maximum(pos, 1.0)
+    fpr = fp / jnp.maximum(neg, 1.0)
+    precision = tp / jnp.maximum(tp + fp, 1.0)
+    # trapezoidal AUCs with the (0,0) origin prepended
+    auc_roc = jnp.sum((fpr - jnp.concatenate([jnp.zeros(1), fpr[:-1]]))
+                      * (tpr + jnp.concatenate([jnp.zeros(1), tpr[:-1]])) / 2)
+    auc_pr = jnp.sum((tpr - jnp.concatenate([jnp.zeros(1), tpr[:-1]]))
+                     * precision)
+    accuracy = jnp.mean((scores > 0.5) == (labels > 0.5))
+    return auc_roc, auc_pr, accuracy
+
+
+class BinaryClassificationEvaluator(HasLabelCol, HasRawPredictionCol,
+                                    AlgoOperator):
+    METRICS = StringArrayParam(
+        "metricsNames", "Metrics to compute.",
+        default=("areaUnderROC", "areaUnderPR"),
+        validator=lambda v: v is not None and all(m in _SUPPORTED for m in v))
+
+    def set_metrics(self, *names: str):
+        return self.set(BinaryClassificationEvaluator.METRICS, names)
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        scores = np.asarray(table[self.get_raw_prediction_col()], np.float32)
+        labels = np.asarray(table[self.get_label_col()], np.float32)
+        if scores.ndim != 1:
+            raise ValueError("rawPrediction column must be scalar scores")
+        auc_roc, auc_pr, acc = (float(x) for x in
+                                _binary_metrics(jnp.asarray(scores),
+                                                jnp.asarray(labels)))
+        values = {"areaUnderROC": auc_roc, "areaUnderPR": auc_pr,
+                  "accuracy": acc}
+        names = self.get(BinaryClassificationEvaluator.METRICS)
+        return [Table({name: np.asarray([values[name]]) for name in names})]

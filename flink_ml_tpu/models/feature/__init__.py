@@ -1,0 +1,13 @@
+from .encoders import (  # noqa: F401
+    OneHotEncoder,
+    OneHotEncoderModel,
+    StringIndexer,
+    StringIndexerModel,
+    VectorAssembler,
+)
+from .scalers import (  # noqa: F401
+    MinMaxScaler,
+    MinMaxScalerModel,
+    StandardScaler,
+    StandardScalerModel,
+)

@@ -1,0 +1,194 @@
+"""Categorical encoders + column assembly: StringIndexer, OneHotEncoder,
+VectorAssembler — the feature-prep stages that feed the linear family and
+Wide&Deep (string -> index -> one-hot / stacked cat ids)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...api.stage import Estimator, Model, Transformer
+from ...data.table import Table
+from ...params.param import BoolParam, StringParam
+from ...params.shared import HasFeaturesCol, HasInputCols, HasOutputCols
+from ...utils import persist
+
+__all__ = ["StringIndexer", "StringIndexerModel", "OneHotEncoder",
+           "OneHotEncoderModel", "VectorAssembler"]
+
+
+class _ColsParams(HasInputCols, HasOutputCols):
+    """Both-columns mixin shared by the multi-column feature stages."""
+
+
+def _check_cols(stage) -> tuple:
+    in_cols, out_cols = stage.get_input_cols(), stage.get_output_cols()
+    if not in_cols:
+        raise ValueError(f"{type(stage).__name__} requires inputCols")
+    out_cols = out_cols or tuple(f"{c}_out" for c in in_cols)
+    if len(out_cols) != len(in_cols):
+        raise ValueError("inputCols and outputCols lengths differ")
+    return in_cols, out_cols
+
+
+class StringIndexerModel(_ColsParams, Model):
+    """Maps string/any values to dense int ids by fitted vocabulary;
+    unseen values -> len(vocab) (the "keep" policy) or error."""
+
+    HANDLE_INVALID = StringParam(
+        "handleInvalid", "Unseen-value policy.", default="keep",
+        validator=lambda v: v in ("keep", "error"))
+
+    def __init__(self):
+        super().__init__()
+        self._vocab: Dict[str, List] = {}
+
+    def set_model_data(self, *inputs) -> "StringIndexerModel":
+        (t,) = inputs
+        self._vocab = {name: list(t[name]) for name in t.column_names}
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [Table({k: np.asarray(v) for k, v in self._vocab.items()})]
+
+    def vocab_sizes(self) -> List[int]:
+        in_cols, _ = _check_cols(self)
+        return [len(self._vocab[c]) for c in in_cols]
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        in_cols, out_cols = _check_cols(self)
+        policy = self.get(StringIndexerModel.HANDLE_INVALID)
+        out = table
+        for ic, oc in zip(in_cols, out_cols):
+            vocab_arr = np.asarray(self._vocab[ic])
+            column = np.asarray(table[ic]).astype(vocab_arr.dtype, copy=False)
+            # vectorized lookup: searchsorted over the sorted vocab, mapped
+            # back to fitted (frequency-ordered) ids
+            order = np.argsort(vocab_arr, kind="stable")
+            sorted_vocab = vocab_arr[order]
+            pos = np.searchsorted(sorted_vocab, column)
+            pos_clipped = np.minimum(pos, len(vocab_arr) - 1)
+            found = sorted_vocab[pos_clipped] == column
+            if policy == "error" and not found.all():
+                missing = column[~found][0]
+                raise ValueError(f"Unseen value {missing!r} in column {ic!r}")
+            ids = np.where(found, order[pos_clipped], len(vocab_arr)
+                           ).astype(np.int64)
+            out = out.with_column(oc, ids)
+        return [out]
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(
+            path, "model", {k: np.asarray(v) for k, v in self._vocab.items()})
+
+    @classmethod
+    def load(cls, path: str) -> "StringIndexerModel":
+        model = persist.load_stage_param(path)
+        data = persist.load_model_arrays(path, "model")
+        model._vocab = {k: list(v) for k, v in data.items()}
+        return model
+
+
+class StringIndexer(_ColsParams, Estimator[StringIndexerModel]):
+    """Vocabulary = distinct values by descending frequency (ties by value),
+    the common StringIndexer ordering."""
+
+    def fit(self, *inputs) -> StringIndexerModel:
+        (table,) = inputs
+        in_cols, _ = _check_cols(self)
+        model = StringIndexerModel()
+        model.copy_params_from(self)
+        for col in in_cols:
+            values, counts = np.unique(table[col], return_counts=True)
+            order = np.lexsort((values, -counts))
+            model._vocab[col] = [values[i].item() if hasattr(values[i], "item")
+                                 else values[i] for i in order]
+        return model
+
+
+class OneHotEncoderParams(_ColsParams):
+    DROP_LAST = BoolParam("dropLast", "Drop the last category column.",
+                          default=True)
+    HANDLE_INVALID = StringParam(
+        "handleInvalid", "Out-of-range id policy: 'error' raises, 'keep' "
+        "emits an all-zeros row (matches StringIndexer's unseen->len(vocab) "
+        "ids).", default="error",
+        validator=lambda v: v in ("keep", "error"))
+
+
+class OneHotEncoderModel(OneHotEncoderParams, Model):
+    def __init__(self):
+        super().__init__()
+        self._sizes: Dict[str, int] = {}
+
+    def set_model_data(self, *inputs) -> "OneHotEncoderModel":
+        (t,) = inputs
+        self._sizes = {name: int(t[name][0]) for name in t.column_names}
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [Table({k: np.asarray([v]) for k, v in self._sizes.items()})]
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        in_cols, out_cols = _check_cols(self)
+        drop = self.get(OneHotEncoderParams.DROP_LAST)
+        out = table
+        keep = self.get(OneHotEncoderParams.HANDLE_INVALID) == "keep"
+        for ic, oc in zip(in_cols, out_cols):
+            size = self._sizes[ic]
+            ids = np.asarray(table[ic], np.int64)
+            if np.any(ids < 0) or (not keep and np.any(ids >= size)):
+                raise ValueError(f"id out of range [0, {size}) in {ic!r}")
+            width = size - 1 if drop else size
+            hot = np.zeros((len(ids), width), np.float64)
+            in_range = ids < width  # dropped-last and invalid ids -> zeros
+            hot[np.nonzero(in_range)[0], ids[in_range]] = 1.0
+            out = out.with_column(oc, hot)
+        return [out]
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path, {"sizes": self._sizes})
+
+    @classmethod
+    def load(cls, path: str) -> "OneHotEncoderModel":
+        model = persist.load_stage_param(path)
+        meta = persist.load_metadata(path)
+        model._sizes = {k: int(v) for k, v in meta["sizes"].items()}
+        return model
+
+
+class OneHotEncoder(OneHotEncoderParams, Estimator[OneHotEncoderModel]):
+    """Category count per column = max id + 1 over the fit data."""
+
+    def fit(self, *inputs) -> OneHotEncoderModel:
+        (table,) = inputs
+        in_cols, _ = _check_cols(self)
+        model = OneHotEncoderModel()
+        model.copy_params_from(self)
+        for col in in_cols:
+            ids = np.asarray(table[col], np.int64)
+            if ids.min() < 0:
+                raise ValueError(f"negative ids in column {col!r}")
+            model._sizes[col] = int(ids.max()) + 1
+        return model
+
+
+class VectorAssembler(_ColsParams, HasFeaturesCol, Transformer):
+    """Concatenate scalar/vector columns into one dense feature matrix
+    (output column = featuresCol)."""
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        in_cols = self.get_input_cols()
+        if not in_cols:
+            raise ValueError("VectorAssembler requires inputCols")
+        parts = []
+        for col in in_cols:
+            arr = np.asarray(table[col], np.float64)
+            parts.append(arr[:, None] if arr.ndim == 1 else arr)
+        stacked = np.concatenate(parts, axis=1)
+        return [table.with_column(self.get_features_col(), stacked)]

@@ -1,0 +1,146 @@
+"""Feature scalers: StandardScaler and MinMaxScaler.
+
+The reference snapshot ships no feature transformers (its lib is KMeans
+only), but Flink ML's library surface includes them; they're also what make
+the Pipeline API practically usable.  Statistics are computed on device (one
+reduction over the sharded batch), applied as a jitted broadcast op."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import Estimator, Model
+from ...data.table import Table
+from ...linalg import stack_vectors
+from ...params.param import BoolParam, FloatParam
+from ...params.shared import HasFeaturesCol, HasOutputCol
+from ...utils import persist
+
+__all__ = ["StandardScaler", "StandardScalerModel",
+           "MinMaxScaler", "MinMaxScalerModel"]
+
+
+class _HasOutputCol(HasFeaturesCol, HasOutputCol):
+    """features-in / output-out mixin for the scalers."""
+
+
+class StandardScalerParams(_HasOutputCol):
+    WITH_MEAN = BoolParam("withMean", "Center to zero mean.", default=True)
+    WITH_STD = BoolParam("withStd", "Scale to unit variance.", default=True)
+
+
+@jax.jit
+def _standardize(X, mean, scale):
+    return (X - mean) * scale
+
+
+class StandardScalerModel(StandardScalerParams, Model):
+    def __init__(self):
+        super().__init__()
+        self._mean: Optional[np.ndarray] = None
+        self._std: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs) -> "StandardScalerModel":
+        (t,) = inputs
+        self._mean = np.asarray(t["mean"][0], np.float64)
+        self._std = np.asarray(t["std"][0], np.float64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [Table({"mean": self._mean[None], "std": self._std[None]})]
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float32)
+        mean = (self._mean if self.get(StandardScalerParams.WITH_MEAN)
+                else np.zeros_like(self._mean))
+        scale = (1.0 / np.maximum(self._std, 1e-12)
+                 if self.get(StandardScalerParams.WITH_STD)
+                 else np.ones_like(self._std))
+        out = np.asarray(_standardize(X, jnp.asarray(mean, jnp.float32),
+                                      jnp.asarray(scale, jnp.float32)))
+        return [table.with_column(self.get_output_col(), out)]
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(path, "model",
+                                  {"mean": self._mean, "std": self._std})
+
+    @classmethod
+    def load(cls, path: str) -> "StandardScalerModel":
+        model = persist.load_stage_param(path)
+        data = persist.load_model_arrays(path, "model")
+        model._mean, model._std = (data["mean"].astype(np.float64),
+                                   data["std"].astype(np.float64))
+        return model
+
+
+class StandardScaler(StandardScalerParams, Estimator[StandardScalerModel]):
+    def fit(self, *inputs) -> StandardScalerModel:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()])
+        model = StandardScalerModel()
+        model.copy_params_from(self)
+        model._mean = X.mean(axis=0)
+        model._std = X.std(axis=0)
+        return model
+
+
+class MinMaxScalerParams(_HasOutputCol):
+    MIN = FloatParam("min", "Lower bound of the output range.", default=0.0)
+    MAX = FloatParam("max", "Upper bound of the output range.", default=1.0)
+
+
+class MinMaxScalerModel(MinMaxScalerParams, Model):
+    def __init__(self):
+        super().__init__()
+        self._data_min: Optional[np.ndarray] = None
+        self._data_max: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs) -> "MinMaxScalerModel":
+        (t,) = inputs
+        self._data_min = np.asarray(t["min"][0], np.float64)
+        self._data_max = np.asarray(t["max"][0], np.float64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [Table({"min": self._data_min[None],
+                       "max": self._data_max[None]})]
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        lo, hi = self.get(MinMaxScalerParams.MIN), self.get(MinMaxScalerParams.MAX)
+        if hi <= lo:
+            raise ValueError(f"min {lo} must be < max {hi}")
+        X = stack_vectors(table[self.get_features_col()])
+        span = np.maximum(self._data_max - self._data_min, 1e-12)
+        out = (X - self._data_min) / span * (hi - lo) + lo
+        return [table.with_column(self.get_output_col(), out)]
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(path, "model", {"min": self._data_min,
+                                                  "max": self._data_max})
+
+    @classmethod
+    def load(cls, path: str) -> "MinMaxScalerModel":
+        model = persist.load_stage_param(path)
+        data = persist.load_model_arrays(path, "model")
+        model._data_min = data["min"].astype(np.float64)
+        model._data_max = data["max"].astype(np.float64)
+        return model
+
+
+class MinMaxScaler(MinMaxScalerParams, Estimator[MinMaxScalerModel]):
+    def fit(self, *inputs) -> MinMaxScalerModel:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()])
+        model = MinMaxScalerModel()
+        model.copy_params_from(self)
+        model._data_min = X.min(axis=0)
+        model._data_max = X.max(axis=0)
+        return model

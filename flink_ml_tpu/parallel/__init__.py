@@ -13,3 +13,4 @@ from .mesh import (  # noqa: F401
 from . import collectives  # noqa: F401
 from .ring_attention import attention_reference, ring_attention  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
+from . import distributed  # noqa: F401

@@ -13,6 +13,7 @@ from .param import (
     FloatParam,
     IntParam,
     ParamValidators,
+    StringArrayParam,
     StringParam,
 )
 from .with_params import WithParams
@@ -32,6 +33,9 @@ __all__ = [
     "HasElasticNet",
     "HasGlobalBatchSize",
     "HasBatchStrategy",
+    "HasOutputCol",
+    "HasInputCols",
+    "HasOutputCols",
 ]
 
 
@@ -201,3 +205,36 @@ class HasBatchStrategy(WithParams):
 
     def get_batch_strategy(self) -> str:
         return self.get(HasBatchStrategy.BATCH_STRATEGY)
+
+
+class HasOutputCol(WithParams):
+    OUTPUT_COL = StringParam("outputCol", "Output column name.",
+                             default="output")
+
+    def get_output_col(self) -> str:
+        return self.get(HasOutputCol.OUTPUT_COL)
+
+    def set_output_col(self, value: str):
+        return self.set(HasOutputCol.OUTPUT_COL, value)
+
+
+class HasInputCols(WithParams):
+    INPUT_COLS = StringArrayParam("inputCols", "Input column names.",
+                                  default=None)
+
+    def get_input_cols(self):
+        return self.get(HasInputCols.INPUT_COLS)
+
+    def set_input_cols(self, *cols: str):
+        return self.set(HasInputCols.INPUT_COLS, cols)
+
+
+class HasOutputCols(WithParams):
+    OUTPUT_COLS = StringArrayParam("outputCols", "Output column names.",
+                                   default=None)
+
+    def get_output_cols(self):
+        return self.get(HasOutputCols.OUTPUT_COLS)
+
+    def set_output_cols(self, *cols: str):
+        return self.set(HasOutputCols.OUTPUT_COLS, cols)

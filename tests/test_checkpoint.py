@@ -163,3 +163,31 @@ def test_resume_of_terminated_run_does_not_rerun_body(tmp_path):
     assert len(calls) == n_calls  # body not re-executed
     assert float(r2.state) == float(r1.state)
     assert r2.side["termination_reason"] == "criteria"
+
+
+def test_legacy_raw_snapshot_format_restores(tmp_path):
+    # Snapshots written before the multi-feed envelope (raw source dicts)
+    # must still restore the stream cursor.
+    class Src:
+        def __init__(self):
+            self.cursor = 0
+
+        def __call__(self, epoch):
+            v = jnp.asarray(float(self.cursor))
+            self.cursor += 1
+            return v
+
+        def snapshot(self):
+            return {"cursor": self.cursor}
+
+        def restore(self, snap):
+            self.cursor = snap["cursor"]
+
+    from flink_ml_tpu.iteration.core import _DataProvider
+    src = Src()
+    provider = _DataProvider(src)
+    provider(0), provider(1)
+    assert provider.snapshot() == {"cursor": 2}  # raw format preserved
+    fresh = _DataProvider(Src())
+    fresh.restore({"cursor": 2})  # legacy raw snapshot
+    assert fresh._single.source.cursor == 2

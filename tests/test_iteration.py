@@ -216,3 +216,48 @@ def test_tuple_state_never_unpacked():
                   config=IterationConfig(mode="hosted"))
     assert float(res.state[0]) == 3.0
     assert float(res.state[1]) == 8.0
+
+
+def test_mixed_replayed_and_per_epoch_inputs():
+    # ReplayableDataStreamList analog: replayed device data + a live stream,
+    # mixed in one dict (SURVEY §2.2).
+    from flink_ml_tpu.iteration import PerEpoch, Replayed
+
+    replayed = jnp.arange(8, dtype=jnp.float32)   # same every epoch
+    stream = iter([jnp.asarray(1.0), jnp.asarray(2.0), jnp.asarray(3.0)])
+
+    seen = []
+
+    def body(acc, epoch, data):
+        seen.append((float(jnp.sum(data["train"])), float(data["delta"])))
+        return IterationBodyResult(acc + jnp.sum(data["train"]) * data["delta"])
+
+    res = iterate(body, jnp.asarray(0.0),
+                  {"train": Replayed(replayed), "delta": PerEpoch(stream)},
+                  max_epochs=100, config=IterationConfig(mode="hosted",
+                                                         jit=False))
+    assert res.num_epochs == 3
+    assert res.side["termination_reason"] == "stream_end"
+    assert seen == [(28.0, 1.0), (28.0, 2.0), (28.0, 3.0)]
+    assert float(res.state) == 28.0 * 6
+
+
+def test_per_epoch_callable_marker():
+    from flink_ml_tpu.iteration import PerEpoch
+
+    res = iterate(
+        lambda acc, e, d: IterationBodyResult(acc + d["x"]),
+        jnp.asarray(0.0),
+        {"x": PerEpoch(lambda epoch: jnp.asarray(float(epoch)))},
+        max_epochs=4, config=IterationConfig(mode="hosted"))
+    assert float(res.state) == 0 + 1 + 2 + 3
+
+
+def test_replayed_marker_is_fusible():
+    from flink_ml_tpu.iteration import Replayed
+
+    data = {"x": Replayed(jnp.arange(4, dtype=jnp.float32))}
+    res = iterate(lambda s, e, d: IterationBodyResult(s + jnp.sum(d["x"])),
+                  jnp.asarray(0.0), data, max_epochs=3,
+                  config=IterationConfig(mode="fused"))
+    assert float(res.state) == 18.0

@@ -121,3 +121,32 @@ def test_ulysses_rejects_bad_heads():
     q, k, v = _qkv(h=4)  # 4 heads < 8 devices
     with pytest.raises(ValueError):
         ulysses_attention(q, k, v, mesh=mesh, axis="seq")
+
+
+def test_distributed_single_process_degradation():
+    from flink_ml_tpu.parallel import distributed as dist
+
+    dist.initialize()
+    assert dist.is_initialized()
+    info = dist.process_info()
+    assert info.process_count == 1 and info.is_coordinator
+    assert info.global_device_count == 8
+
+    mesh = dist.global_mesh({"data": -1})
+    assert mesh.shape["data"] == 8
+
+    local = {"x": np.arange(16, dtype=np.float32)}
+    global_arr = dist.host_local_to_global(local, mesh, axis="data")
+    assert len(global_arr["x"].sharding.device_set) == 8
+    back = dist.global_to_host_local(global_arr, mesh, axis="data")
+    np.testing.assert_array_equal(back["x"], local["x"])
+
+    dist.barrier()  # no-op single process
+    assert dist.broadcast_from_host0({"v": 3})["v"] == 3
+
+
+def test_hybrid_mesh_single_host():
+    from flink_ml_tpu.parallel import distributed as dist
+
+    mesh = dist.hybrid_mesh({"data": 4, "model": 2})
+    assert mesh.shape == {"dcn": 1, "data": 4, "model": 2}
