@@ -150,12 +150,17 @@ def load_pytree(path: str) -> Tuple[Any, Dict[str, Any]]:
 
 
 class CheckpointConfig:
-    def __init__(self, directory: str, interval: int = 1, max_to_keep: int = 2):
+    def __init__(self, directory: str, interval: int = 1, max_to_keep: int = 2,
+                 async_save: bool = False):
         if interval <= 0:
             raise ValueError("checkpoint interval must be positive")
         self.directory = directory
         self.interval = interval
         self.max_to_keep = max_to_keep
+        # Overlap the device->host fetch + disk write with the next epoch's
+        # compute (the iteration driver snapshots a device-side copy first so
+        # donation can't invalidate the buffers being read).
+        self.async_save = async_save
 
 
 class CheckpointManager:
@@ -168,6 +173,8 @@ class CheckpointManager:
     def __init__(self, config: CheckpointConfig):
         self.config = config
         os.makedirs(config.directory, exist_ok=True)
+        self._pending: Optional["threading.Thread"] = None
+        self._pending_error: Optional[BaseException] = None
 
     def _ckpt_path(self, epoch: int) -> str:
         return os.path.join(self.config.directory, f"ckpt-{epoch:08d}")
@@ -195,7 +202,36 @@ class CheckpointManager:
         self._gc()
         return path
 
+    def save_async(self, epoch: int, state: Any,
+                   extra: Optional[Dict[str, Any]] = None) -> None:
+        """Kick the device->host fetch + write to a background thread.  At
+        most one save is in flight; callers must pass state buffers that the
+        training loop will NOT donate/overwrite (a device-side copy)."""
+        import threading
+
+        self.wait()
+
+        def work():
+            try:
+                self.save(epoch, state, extra)
+            except BaseException as e:  # surfaced on next wait()
+                self._pending_error = e
+
+        self._pending = threading.Thread(target=work, daemon=True)
+        self._pending.start()
+
+    def wait(self) -> None:
+        """Block until the in-flight async save (if any) lands; re-raise its
+        error.  Called before restore and at iteration end."""
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+        if self._pending_error is not None:
+            error, self._pending_error = self._pending_error, None
+            raise error
+
     def restore_latest(self) -> Optional[Tuple[int, Any, Dict[str, Any]]]:
+        self.wait()
         epochs = self.list_epochs()
         if not epochs:
             return None

@@ -407,37 +407,57 @@ def _iterate_hosted(body: BodyFn, initial_state, provider: _DataProvider,
     side: dict = {}
     epoch = start_epoch
     terminated_reason = "max_epochs"
-    while config.max_epochs is None or epoch < config.max_epochs:
-        epoch_data = provider(epoch)
-        if provider.exhausted:
-            terminated_reason = "stream_end"
-            break
-        if per_round and epoch > start_epoch:
-            state = per_round_init()
-        res = step(state, jnp.asarray(epoch, jnp.int32), epoch_data)
-        state = res.feedback
-        if res.outputs is not None:
-            outputs_log.append(res.outputs)
+    try:
+        while config.max_epochs is None or epoch < config.max_epochs:
+            epoch_data = provider(epoch)
+            if provider.exhausted:
+                terminated_reason = "stream_end"
+                break
+            if per_round and epoch > start_epoch:
+                state = per_round_init()
+            res = step(state, jnp.asarray(epoch, jnp.int32), epoch_data)
+            state = res.feedback
+            if res.outputs is not None:
+                outputs_log.append(res.outputs)
 
-        ctx = EpochContext(epoch=epoch, state=state, outputs=res.outputs,
-                           side=side)
-        for listener in listeners:
-            listener.on_epoch_watermark_incremented(epoch, ctx)
+            ctx = EpochContext(epoch=epoch, state=state, outputs=res.outputs,
+                               side=side)
+            for listener in listeners:
+                listener.on_epoch_watermark_incremented(epoch, ctx)
 
-        epoch += 1
-        stop = (res.termination is not None
-                and not _vote_continue(res.termination))
-        if manager is not None and (manager.should_save(epoch) or stop):
-            # The vote travels with the checkpoint: resuming from a
-            # checkpoint of a terminated run must not re-run the body.
-            extra = {"terminated": stop}
-            snap = provider.snapshot()
-            if snap:
-                extra["source_snapshot"] = snap
-            manager.save(epoch, state, extra)
-        if stop:
-            terminated_reason = "criteria"
-            break
+            epoch += 1
+            stop = (res.termination is not None
+                    and not _vote_continue(res.termination))
+            if manager is not None and (manager.should_save(epoch) or stop):
+                # The vote travels with the checkpoint: resuming from a
+                # checkpoint of a terminated run must not re-run the body.
+                extra = {"terminated": stop}
+                snap = provider.snapshot()
+                if snap:
+                    extra["source_snapshot"] = snap
+                if getattr(manager.config, "async_save", False):
+                    # Only copy when the loop donates the live buffers the
+                    # background thread would otherwise read.
+                    to_save = _private_copy(state) if donating else state
+                    manager.save_async(epoch, to_save, extra)
+                else:
+                    manager.save(epoch, state, extra)
+            if stop:
+                terminated_reason = "criteria"
+                break
+    except BaseException:
+        # Land any in-flight async save so the newest checkpoint isn't torn
+        # by interpreter exit; swallow its error — the loop's own exception
+        # is the one the caller must see.
+        if manager is not None:
+            try:
+                manager.wait()
+            except Exception:
+                pass
+        raise
+
+    if manager is not None:
+        manager.wait()  # land any in-flight async save before returning
 
     final_ctx = EpochContext(epoch=epoch, state=state, terminated=True,
                              side=side)

@@ -1,0 +1,32 @@
+"""Algorithm library — estimators, models, feature stages, evaluators."""
+
+from .classification import (  # noqa: F401
+    LinearSVC,
+    LinearSVCModel,
+    LogisticRegression,
+    LogisticRegressionModel,
+    NaiveBayes,
+    NaiveBayesModel,
+    OnlineLogisticRegression,
+    OnlineLogisticRegressionModel,
+)
+from .clustering import (  # noqa: F401
+    KMeans,
+    KMeansModel,
+    OnlineKMeans,
+    OnlineKMeansModel,
+)
+from .evaluation import BinaryClassificationEvaluator  # noqa: F401
+from .feature import (  # noqa: F401
+    MinMaxScaler,
+    MinMaxScalerModel,
+    OneHotEncoder,
+    OneHotEncoderModel,
+    StandardScaler,
+    StandardScalerModel,
+    StringIndexer,
+    StringIndexerModel,
+    VectorAssembler,
+)
+from .recommendation import WideDeep, WideDeepModel  # noqa: F401
+from .regression import LinearRegression, LinearRegressionModel  # noqa: F401

@@ -1,5 +1,6 @@
 from .logisticregression import LogisticRegression, LogisticRegressionModel  # noqa: F401
 from .linearsvc import LinearSVC, LinearSVCModel  # noqa: F401
+from .naivebayes import NaiveBayes, NaiveBayesModel  # noqa: F401
 from .online_logisticregression import (  # noqa: F401
     OnlineLogisticRegression,
     OnlineLogisticRegressionModel,

@@ -1,0 +1,120 @@
+"""Multinomial Naive Bayes.
+
+Part of the early Flink ML 2.x library surface (the reference snapshot ships
+only KMeans, but the lib module is explicitly "the algorithm library" —
+SURVEY §2.8).  TPU-native shape: smoothing-adjusted log-likelihoods are a
+(classes, features) matrix, so scoring a batch is one MXU matmul
+``X @ log_theta.T + log_prior``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import Estimator, Model
+from ...data.table import Table
+from ...linalg import stack_vectors
+from ...params.param import FloatParam, ParamValidators
+from ...params.shared import HasFeaturesCol, HasLabelCol, HasPredictionCol
+from ...utils import persist
+
+__all__ = ["NaiveBayes", "NaiveBayesModel"]
+
+
+class NaiveBayesParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
+    SMOOTHING = FloatParam("smoothing", "Laplace smoothing.", default=1.0,
+                           validator=ParamValidators.gt_eq(0))
+
+    def get_smoothing(self) -> float:
+        return self.get(NaiveBayesParams.SMOOTHING)
+
+    def set_smoothing(self, value: float):
+        return self.set(NaiveBayesParams.SMOOTHING, value)
+
+
+@jax.jit
+def _scores(X, log_theta, log_prior):
+    return X @ log_theta.T + log_prior[None, :]
+
+
+class NaiveBayesModel(NaiveBayesParams, Model):
+    def __init__(self):
+        super().__init__()
+        self._log_theta: Optional[np.ndarray] = None   # (classes, features)
+        self._log_prior: Optional[np.ndarray] = None   # (classes,)
+        self._labels: Optional[np.ndarray] = None      # original label values
+
+    def set_model_data(self, *inputs) -> "NaiveBayesModel":
+        (t,) = inputs
+        self._log_theta = np.asarray(t["logTheta"][0], np.float64)
+        self._log_prior = np.asarray(t["logPrior"][0], np.float64)
+        self._labels = np.asarray(t["labels"][0])
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [Table({"logTheta": self._log_theta[None],
+                       "logPrior": self._log_prior[None],
+                       "labels": self._labels[None]})]
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        if self._log_theta is None:
+            raise RuntimeError("NaiveBayesModel has no model data")
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float32)
+        if np.any(X < 0):
+            raise ValueError("Multinomial NaiveBayes requires non-negative "
+                             "features (counts)")
+        scores = np.asarray(_scores(
+            jnp.asarray(X),
+            jnp.asarray(self._log_theta, jnp.float32),
+            jnp.asarray(self._log_prior, jnp.float32)))
+        pred = self._labels[np.argmax(scores, axis=1)]
+        return [table.with_column(self.get_prediction_col(), pred)]
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(path, "model", {
+            "logTheta": self._log_theta, "logPrior": self._log_prior,
+            "labels": self._labels})
+
+    @classmethod
+    def load(cls, path: str) -> "NaiveBayesModel":
+        model = persist.load_stage_param(path)
+        data = persist.load_model_arrays(path, "model")
+        model._log_theta = data["logTheta"].astype(np.float64)
+        model._log_prior = data["logPrior"].astype(np.float64)
+        model._labels = data["labels"]
+        return model
+
+
+class NaiveBayes(NaiveBayesParams, Estimator[NaiveBayesModel]):
+    def fit(self, *inputs) -> NaiveBayesModel:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()])
+        if np.any(X < 0):
+            raise ValueError("Multinomial NaiveBayes requires non-negative "
+                             "features (counts)")
+        y = np.asarray(table[self.get_label_col()])
+        labels, inverse = np.unique(y, return_inverse=True)
+        smoothing = self.get_smoothing()
+
+        n_classes, n_features = len(labels), X.shape[1]
+        counts = np.zeros((n_classes, n_features))
+        np.add.at(counts, inverse, X)
+        class_counts = np.bincount(inverse, minlength=n_classes)
+
+        theta_num = counts + smoothing
+        theta_den = counts.sum(axis=1, keepdims=True) + smoothing * n_features
+        log_theta = np.log(theta_num) - np.log(theta_den)
+        log_prior = np.log(class_counts) - np.log(class_counts.sum())
+
+        model = NaiveBayesModel()
+        model.copy_params_from(self)
+        model._log_theta = log_theta
+        model._log_prior = log_prior
+        model._labels = labels
+        return model

@@ -1,0 +1,143 @@
+"""OnlineKMeans — streaming mini-batch KMeans.
+
+The unbounded-iteration counterpart of KMeans (Flink ML pairs each bounded
+estimator with an online variant; the capability maps to
+``Iterations.iterateUnboundedStreams``, ``Iterations.java:118-127``).  Each
+epoch consumes one window of the stream and applies a decayed mini-batch
+centroid update
+
+    c_k <- (c_k * n_k * alpha + sum_batch) / (n_k * alpha + count_batch)
+
+where ``alpha`` is the decay factor (alpha=1: running mean over the whole
+stream; alpha=0: each batch fully replaces the statistics).  The update is
+the same fused assign+reduce used by batch KMeans; centroids and per-cluster
+weights stay in HBM between windows.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import Estimator
+from ...data.table import Table
+from ...distance import DistanceMeasure
+from ...iteration import (
+    IterationBodyResult,
+    IterationConfig,
+    IterationListener,
+    iterate,
+)
+from ...linalg import stack_vectors
+from ...params.param import FloatParam, ParamValidators
+from .kmeans import KMeansModel, KMeansParams, select_random_centroids
+
+__all__ = ["OnlineKMeans", "OnlineKMeansModel"]
+
+
+class OnlineKMeansModel(KMeansModel):
+    """KMeansModel + the model version counter of the streaming fit."""
+
+    def __init__(self):
+        super().__init__()
+        self.model_version = 0
+
+    def save(self, path: str) -> None:
+        from ...utils import persist
+
+        self._require_model()
+        persist.save_metadata(self, path, {"modelVersion": self.model_version})
+        persist.save_model_arrays(path, "model",
+                                  {"centroids": self._centroids})
+
+    @classmethod
+    def load(cls, path: str) -> "OnlineKMeansModel":
+        from ...utils import persist
+
+        model = persist.load_stage_param(path)
+        data = persist.load_model_arrays(path, "model")
+        model._centroids = data["centroids"].astype(np.float32)
+        model.model_version = int(
+            persist.load_metadata(path).get("modelVersion", 0))
+        return model
+
+
+class OnlineKMeans(KMeansParams, Estimator[OnlineKMeansModel]):
+    DECAY_FACTOR = FloatParam(
+        "decayFactor", "Forgetting factor for old batch statistics.",
+        default=1.0, validator=ParamValidators.in_range(0.0, 1.0))
+
+    def get_decay_factor(self) -> float:
+        return self.get(OnlineKMeans.DECAY_FACTOR)
+
+    def set_decay_factor(self, v: float):
+        return self.set(OnlineKMeans.DECAY_FACTOR, v)
+
+    def __init__(self):
+        super().__init__()
+        self._initial_centroids: Optional[np.ndarray] = None
+
+    def set_initial_model_data(self, table: Table) -> "OnlineKMeans":
+        self._initial_centroids = np.asarray(table["centroids"][0], np.float32)
+        return self
+
+    def fit(self, *inputs) -> OnlineKMeansModel:
+        """``fit(stream)``: an iterable of Tables (windows).  Returns when
+        the stream ends."""
+        (source,) = inputs
+        k = self.get_k()
+        alpha = self.get_decay_factor()
+        measure = DistanceMeasure.get_instance(self.get_distance_measure())
+        feat = self.get_features_col()
+
+        batches = iter(source) if not isinstance(source, Table) else iter(
+            source.batches(max(k, 256)))
+        first = next(batches, None)
+        if first is None:
+            raise ValueError("OnlineKMeans.fit got an empty stream")
+
+        first_X = stack_vectors(first[feat]).astype(np.float32)
+        if self._initial_centroids is not None:
+            init = self._initial_centroids
+        else:
+            init = select_random_centroids(first_X, k, self.get_seed())
+
+        @jax.jit
+        def update(centroids, weights, X):
+            dists = measure.pairwise(X, centroids)
+            assign = jnp.argmin(dists, axis=1)
+            onehot = jax.nn.one_hot(assign, k, dtype=X.dtype)
+            sums = jnp.einsum("nk,nd->kd", onehot, X)
+            counts = jnp.sum(onehot, axis=0)
+            decayed = weights * alpha
+            denom = decayed + counts
+            new_centroids = jnp.where(
+                counts[:, None] > 0,
+                (centroids * decayed[:, None] + sums)
+                / jnp.maximum(denom, 1e-12)[:, None],
+                centroids)
+            return new_centroids, denom
+
+        def rechained():
+            yield first_X
+            for t in batches:
+                yield stack_vectors(t[feat]).astype(np.float32)
+
+        def body(state, epoch, X):
+            centroids, weights = state
+            new_c, new_w = update(centroids, weights, jnp.asarray(X))
+            return IterationBodyResult((new_c, new_w))
+
+        state0 = (jnp.asarray(init), jnp.zeros((k,), jnp.float32))
+        result = iterate(body, state0, rechained(),
+                         config=IterationConfig(mode="hosted", jit=False))
+
+        centroids = np.asarray(jax.device_get(result.state[0]))
+        model = OnlineKMeansModel()
+        model.copy_params_from(self)
+        model.set_model_data(Table({"centroids": centroids[None]}))
+        model.model_version = result.num_epochs
+        return model

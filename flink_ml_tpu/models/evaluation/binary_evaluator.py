@@ -23,20 +23,28 @@ _SUPPORTED = ("areaUnderROC", "areaUnderPR", "accuracy")
 
 @jax.jit
 def _binary_metrics(scores, labels):
-    order = jnp.argsort(-scores)  # descending by score
+    s_sorted_neg = jnp.sort(-scores)           # ascending in -score = desc
+    order = jnp.argsort(-scores)
     y = labels[order]
     pos = jnp.sum(y)
     neg = y.shape[0] - pos
     tp = jnp.cumsum(y)
     fp = jnp.cumsum(1.0 - y)
-    tpr = tp / jnp.maximum(pos, 1.0)
-    fpr = fp / jnp.maximum(neg, 1.0)
-    precision = tp / jnp.maximum(tp + fp, 1.0)
-    # trapezoidal AUCs with the (0,0) origin prepended
-    auc_roc = jnp.sum((fpr - jnp.concatenate([jnp.zeros(1), fpr[:-1]]))
-                      * (tpr + jnp.concatenate([jnp.zeros(1), tpr[:-1]])) / 2)
-    auc_pr = jnp.sum((tpr - jnp.concatenate([jnp.zeros(1), tpr[:-1]]))
-                     * precision)
+    # Tied scores form ONE ROC/PR point: replace each row's counts with the
+    # counts at the END of its tie group (rightmost equal score).  Diffs
+    # within a group then vanish, so the integrals collapse to the group
+    # boundaries — exact tie handling with static shapes.
+    group_end = jnp.searchsorted(s_sorted_neg, s_sorted_neg,
+                                 side="right") - 1
+    tp_g = tp[group_end]
+    fp_g = fp[group_end]
+    tpr = tp_g / jnp.maximum(pos, 1.0)
+    fpr = fp_g / jnp.maximum(neg, 1.0)
+    precision = tp_g / jnp.maximum(tp_g + fp_g, 1.0)
+    tpr_prev = jnp.concatenate([jnp.zeros(1), tpr[:-1]])
+    fpr_prev = jnp.concatenate([jnp.zeros(1), fpr[:-1]])
+    auc_roc = jnp.sum((fpr - fpr_prev) * (tpr + tpr_prev) / 2)
+    auc_pr = jnp.sum((tpr - tpr_prev) * precision)
     accuracy = jnp.mean((scores > 0.5) == (labels > 0.5))
     return auc_roc, auc_pr, accuracy
 

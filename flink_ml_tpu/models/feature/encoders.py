@@ -63,7 +63,13 @@ class StringIndexerModel(_ColsParams, Model):
         out = table
         for ic, oc in zip(in_cols, out_cols):
             vocab_arr = np.asarray(self._vocab[ic])
-            column = np.asarray(table[ic]).astype(vocab_arr.dtype, copy=False)
+            column = np.asarray(table[ic])
+            # promote BOTH sides to the wider dtype — casting the column to
+            # the vocab's fixed-width string dtype would silently truncate
+            # longer unseen values onto vocab prefixes
+            joint = np.promote_types(vocab_arr.dtype, column.dtype)
+            vocab_arr = vocab_arr.astype(joint, copy=False)
+            column = column.astype(joint, copy=False)
             # vectorized lookup: searchsorted over the sorted vocab, mapped
             # back to fitted (frequency-ordered) ids
             order = np.argsort(vocab_arr, kind="stable")

@@ -81,7 +81,9 @@ def initialize(coordinator_address: Optional[str] = None,
 
 
 def is_initialized() -> bool:
-    return _INITIALIZED or jax.process_count() > 1
+    # Deliberately does NOT probe jax.process_count(): that would initialize
+    # the backend, breaking a later initialize() on multi-host.
+    return _INITIALIZED
 
 
 @dataclass

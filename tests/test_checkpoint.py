@@ -191,3 +191,62 @@ def test_legacy_raw_snapshot_format_restores(tmp_path):
     fresh = _DataProvider(Src())
     fresh.restore({"cursor": 2})  # legacy raw snapshot
     assert fresh._single.source.cursor == 2
+
+
+def test_async_checkpointing_matches_sync(tmp_path):
+    def body(x, epoch):
+        return IterationBodyResult(x * 1.25 + 1.0)
+
+    sync_dir, async_dir = str(tmp_path / "sync"), str(tmp_path / "async")
+    r_sync = iterate(body, jnp.asarray(1.0), max_epochs=8,
+                     config=IterationConfig(mode="hosted"),
+                     checkpoint=CheckpointConfig(sync_dir))
+    r_async = iterate(body, jnp.asarray(1.0), max_epochs=8,
+                      config=IterationConfig(mode="hosted"),
+                      checkpoint=CheckpointConfig(async_dir, async_save=True))
+    assert float(r_sync.state) == float(r_async.state)
+    # both resume identically
+    a = iterate(body, jnp.asarray(1.0), max_epochs=12,
+                config=IterationConfig(mode="hosted"),
+                checkpoint=CheckpointConfig(sync_dir), resume=True)
+    b = iterate(body, jnp.asarray(1.0), max_epochs=12,
+                config=IterationConfig(mode="hosted"),
+                checkpoint=CheckpointConfig(async_dir, async_save=True),
+                resume=True)
+    assert float(a.state) == float(b.state)
+
+
+def test_async_save_error_surfaces(tmp_path):
+    mgr = CheckpointManager(CheckpointConfig(str(tmp_path), async_save=True))
+    mgr.save_async(1, {("bad", "key"): 1})  # unencodable dict key
+    with pytest.raises(TypeError):
+        mgr.wait()
+
+
+def test_elastic_restore_across_mesh_sizes(tmp_path):
+    # The reference REJECTS rescaling (parallelism checkState on restore,
+    # HeadOperator.java:186-201).  Here checkpoints are placement-free host
+    # pytrees: a run checkpointed on the 8-device mesh restores onto a
+    # 4-device mesh and converges to the same state.
+    import jax
+
+    from flink_ml_tpu.parallel.mesh import device_mesh, shard_batch, use_mesh
+
+    data8 = shard_batch(np.arange(32, dtype=np.float32), device_mesh())
+
+    def body(w, epoch, d):
+        return IterationBodyResult(w + jnp.sum(d))
+
+    ckpt = str(tmp_path / "ckpt")
+    iterate(body, jnp.asarray(0.0, jnp.float32), data8, max_epochs=3,
+            config=IterationConfig(mode="hosted"),
+            checkpoint=CheckpointConfig(ckpt))
+
+    # "rescale": resume on a 4-device mesh with re-sharded data
+    mesh4 = device_mesh(devices=jax.devices()[:4])
+    data4 = shard_batch(np.arange(32, dtype=np.float32), mesh4)
+    resumed = iterate(body, jnp.asarray(0.0, jnp.float32), data4,
+                      max_epochs=6, config=IterationConfig(mode="hosted"),
+                      checkpoint=CheckpointConfig(ckpt), resume=True)
+    assert resumed.num_epochs == 6
+    assert float(resumed.state) == 6 * np.arange(32).sum()

@@ -187,3 +187,34 @@ def test_string_indexer_vectorized_large():
     # ids faithfully invert through the vocab
     vocab = np.asarray(model._vocab["c"])
     np.testing.assert_array_equal(vocab[ids], values)
+
+
+def test_auc_tie_handling():
+    # Fully tied scores must give AUC 0.5 regardless of row order.
+    for labels in ([1, 0], [0, 1]):
+        t = Table({"label": np.asarray(labels, np.float64),
+                   "rawPrediction": np.array([0.7, 0.7])})
+        auc = BinaryClassificationEvaluator().transform(t)[0]["areaUnderROC"][0]
+        assert auc == pytest.approx(0.5)
+    # quantized scores vs the tie-aware Mann-Whitney formula
+    rng = np.random.default_rng(1)
+    scores = np.round(rng.uniform(size=300), 1)  # heavy ties
+    labels = (rng.uniform(size=300) < scores).astype(np.float64)
+    t = Table({"label": labels, "rawPrediction": scores})
+    auc = BinaryClassificationEvaluator().transform(t)[0]["areaUnderROC"][0]
+    pos, neg = scores[labels == 1], scores[labels == 0]
+    u = np.mean([(p > neg).mean() + 0.5 * (p == neg).mean() for p in pos])
+    assert auc == pytest.approx(u, abs=1e-6)
+
+
+def test_string_indexer_no_truncation():
+    # An unseen value longer than the fitted dtype width must not be
+    # truncated onto a vocab prefix.
+    train = Table.from_rows([("cat",), ("dog",)], ["w"])
+    model = (StringIndexer().set_input_cols("w").set_output_cols("id")
+             .fit(train))
+    out = model.transform(Table.from_rows([("cats",)], ["w"]))[0]["id"]
+    assert out[0] == 2  # unseen -> len(vocab), NOT id of 'cat'
+    with pytest.raises(ValueError):
+        (model.set("handleInvalid", "error")
+         .transform(Table.from_rows([("cats",)], ["w"])))

@@ -1,0 +1,124 @@
+"""NaiveBayes + OnlineKMeans tests."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.classification.naivebayes import (
+    NaiveBayes,
+    NaiveBayesModel,
+)
+from flink_ml_tpu.models.clustering.online_kmeans import (
+    OnlineKMeans,
+    OnlineKMeansModel,
+)
+
+
+def _count_table(n=600, seed=0):
+    """Two classes with distinct word distributions."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, size=n)
+    theta = np.array([[0.6, 0.2, 0.1, 0.1],
+                      [0.1, 0.1, 0.2, 0.6]])
+    X = np.stack([rng.multinomial(30, theta[c]) for c in y]).astype(np.float64)
+    return Table({"features": X, "label": y})
+
+
+def test_naivebayes_fit_predict():
+    t = _count_table()
+    model = NaiveBayes().fit(t)
+    out = model.transform(t)[0]
+    assert np.mean(out["prediction"] == t["label"]) > 0.95
+
+
+def test_naivebayes_string_labels():
+    t = _count_table(n=200)
+    labels = np.where(np.asarray(t["label"]) == 0, "ham", "spam")
+    t2 = Table({"features": t["features"], "label": labels})
+    model = NaiveBayes().fit(t2)
+    preds = model.transform(t2)[0]["prediction"]
+    assert set(np.unique(preds)) <= {"ham", "spam"}
+    assert np.mean(preds == labels) > 0.95
+
+
+def test_naivebayes_rejects_negative_features():
+    t = Table({"features": np.array([[-1.0, 2.0]]), "label": np.array([0])})
+    with pytest.raises(ValueError):
+        NaiveBayes().fit(t)
+
+
+def test_naivebayes_save_load(tmp_path):
+    t = _count_table(n=200)
+    model = NaiveBayes().set_smoothing(0.5).fit(t)
+    path = str(tmp_path / "nb")
+    model.save(path)
+    loaded = NaiveBayesModel.load(path)
+    np.testing.assert_array_equal(loaded.transform(t)[0]["prediction"],
+                                  model.transform(t)[0]["prediction"])
+    (data,) = model.get_model_data()
+    fresh = NaiveBayesModel().set_model_data(data)
+    np.testing.assert_array_equal(fresh.transform(t)[0]["prediction"],
+                                  model.transform(t)[0]["prediction"])
+
+
+def _cluster_stream(n_batches=40, batch=128, seed=0, drift=0.0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[0.0, 0.0], [10.0, 10.0]])
+    for i in range(n_batches):
+        assign = rng.integers(0, 2, size=batch)
+        pts = centers[assign] + rng.normal(scale=0.5, size=(batch, 2)) \
+            + drift * i
+        yield Table({"features": pts})
+
+
+def test_online_kmeans_converges():
+    model = (OnlineKMeans().set_k(2).set_seed(1)
+             .fit(_cluster_stream()))
+    assert isinstance(model, OnlineKMeansModel)
+    assert model.model_version == 40
+    (data,) = model.get_model_data()
+    centroids = np.sort(np.asarray(data["centroids"][0]), axis=0)
+    np.testing.assert_allclose(centroids, [[0, 0], [10, 10]], atol=0.5)
+
+
+def test_online_kmeans_decay_tracks_drift():
+    # decay < 1 follows drifting clusters; decay = 1 averages all history
+    drift = 0.1
+    tracking = (OnlineKMeans().set_k(2).set_decay_factor(0.2).set_seed(1)
+                .fit(_cluster_stream(drift=drift)))
+    averaging = (OnlineKMeans().set_k(2).set_decay_factor(1.0).set_seed(1)
+                 .fit(_cluster_stream(drift=drift)))
+    final_shift = drift * 39
+    track_c = np.sort(np.asarray(tracking.get_model_data()[0]["centroids"][0]),
+                      axis=0)
+    avg_c = np.sort(np.asarray(averaging.get_model_data()[0]["centroids"][0]),
+                    axis=0)
+    # the tracking model's centroid is closer to the final drifted position
+    track_err = np.abs(track_c[0] - final_shift).max()
+    avg_err = np.abs(avg_c[0] - final_shift).max()
+    assert track_err < avg_err
+
+
+def test_online_kmeans_warm_start_and_predict():
+    init = Table({"centroids": np.array([[[0.0, 0.0], [10.0, 10.0]]])})
+    model = (OnlineKMeans().set_k(2).set_initial_model_data(init)
+             .fit(_cluster_stream(n_batches=5)))
+    pts = Table({"features": np.array([[0.1, 0.1], [9.9, 9.8]])})
+    preds = model.transform(pts)[0]["prediction"]
+    assert preds[0] != preds[1]
+
+
+def test_online_kmeans_empty_stream_rejected():
+    with pytest.raises(ValueError):
+        OnlineKMeans().fit(iter([]))
+
+
+def test_online_kmeans_version_persisted(tmp_path):
+    model = OnlineKMeans().set_k(2).set_seed(1).fit(_cluster_stream(5))
+    assert model.model_version == 5
+    path = str(tmp_path / "okm")
+    model.save(path)
+    loaded = OnlineKMeansModel.load(path)
+    assert loaded.model_version == 5
+    (d1,), (d2,) = model.get_model_data(), loaded.get_model_data()
+    np.testing.assert_allclose(d1["centroids"], d2["centroids"], rtol=1e-6)
