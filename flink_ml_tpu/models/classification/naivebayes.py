@@ -38,6 +38,12 @@ class NaiveBayesParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
 
 @jax.jit
 def _scores(X, log_theta, log_prior):
+    # With smoothing=0, log_theta holds -inf for zero-count features and a
+    # zero count must contribute 0 — but 0 * -inf = nan through the matmul.
+    # Clamping -inf to the most-negative finite float keeps the single MXU
+    # matmul: count 0 contributes exactly 0, while any positive count
+    # overflows back to -inf (the correct "impossible class" score).
+    log_theta = jnp.maximum(log_theta, jnp.finfo(log_theta.dtype).min)
     return X @ log_theta.T + log_prior[None, :]
 
 
@@ -55,15 +61,20 @@ class NaiveBayesModel(NaiveBayesParams, Model):
         self._labels = np.asarray(t["labels"][0])
         return self
 
+    def _require_model(self) -> None:
+        if self._log_theta is None:
+            raise RuntimeError("NaiveBayesModel has no model data; call "
+                               "set_model_data() or fit a NaiveBayes first")
+
     def get_model_data(self) -> List[Table]:
+        self._require_model()
         return [Table({"logTheta": self._log_theta[None],
                        "logPrior": self._log_prior[None],
                        "labels": self._labels[None]})]
 
     def transform(self, *inputs) -> List[Table]:
         (table,) = inputs
-        if self._log_theta is None:
-            raise RuntimeError("NaiveBayesModel has no model data")
+        self._require_model()
         X = stack_vectors(table[self.get_features_col()]).astype(np.float32)
         if np.any(X < 0):
             raise ValueError("Multinomial NaiveBayes requires non-negative "
@@ -76,6 +87,7 @@ class NaiveBayesModel(NaiveBayesParams, Model):
         return [table.with_column(self.get_prediction_col(), pred)]
 
     def save(self, path: str) -> None:
+        self._require_model()
         persist.save_metadata(self, path)
         persist.save_model_arrays(path, "model", {
             "logTheta": self._log_theta, "logPrior": self._log_prior,

@@ -102,6 +102,10 @@ class OnlineKMeans(KMeansParams, Estimator[OnlineKMeansModel]):
         first_X = stack_vectors(first[feat]).astype(np.float32)
         if self._initial_centroids is not None:
             init = self._initial_centroids
+            if init.shape[0] != k:
+                raise ValueError(
+                    f"initial model data has {init.shape[0]} centroids but "
+                    f"k={k}")
         else:
             init = select_random_centroids(first_X, k, self.get_seed())
 

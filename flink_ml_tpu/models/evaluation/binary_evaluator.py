@@ -23,8 +23,8 @@ _SUPPORTED = ("areaUnderROC", "areaUnderPR", "accuracy")
 
 @jax.jit
 def _binary_metrics(scores, labels):
-    s_sorted_neg = jnp.sort(-scores)           # ascending in -score = desc
     order = jnp.argsort(-scores)
+    s_sorted_neg = (-scores)[order]            # ascending in -score = desc
     y = labels[order]
     pos = jnp.sum(y)
     neg = y.shape[0] - pos

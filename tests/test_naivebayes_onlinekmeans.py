@@ -122,3 +122,34 @@ def test_online_kmeans_version_persisted(tmp_path):
     assert loaded.model_version == 5
     (d1,), (d2,) = model.get_model_data(), loaded.get_model_data()
     np.testing.assert_allclose(d1["centroids"], d2["centroids"], rtol=1e-6)
+
+
+def test_naivebayes_zero_smoothing_no_nan():
+    # smoothing=0 yields -inf log-likelihoods for zero-count features; a
+    # zero count in a scoring row must contribute 0, not poison the score
+    # with nan (0 * -inf) and hijack argmax.
+    X = np.array([[5.0, 0.0, 0.0],
+                  [0.0, 5.0, 0.0],
+                  [0.0, 0.0, 5.0]])
+    y = np.array([0, 1, 2])
+    t = Table({"features": X, "label": y})
+    model = NaiveBayes().set_smoothing(0.0).fit(t)
+    pred = np.asarray(model.transform(t)[0]["prediction"])
+    np.testing.assert_array_equal(pred, y)
+
+
+def test_naivebayes_unfitted_model_clear_errors(tmp_path):
+    with pytest.raises(RuntimeError, match="no model data"):
+        NaiveBayesModel().get_model_data()
+    with pytest.raises(RuntimeError, match="no model data"):
+        NaiveBayesModel().save(str(tmp_path / "nb"))
+    assert not (tmp_path / "nb").exists()  # nothing half-written
+
+
+def test_online_kmeans_initial_centroid_count_mismatch():
+    init = Table({"centroids": np.zeros((2, 2), np.float32)[None]})
+    est = OnlineKMeans().set_k(3).set_initial_model_data(init)
+    stream = [Table({"features": np.random.default_rng(0)
+                     .normal(size=(8, 2)).astype(np.float32)})]
+    with pytest.raises(ValueError, match="2 centroids but k=3"):
+        est.fit(stream)
