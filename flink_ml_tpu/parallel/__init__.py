@@ -11,6 +11,18 @@ from .mesh import (  # noqa: F401
     use_mesh,
 )
 from . import collectives  # noqa: F401
+from .moe import (  # noqa: F401
+    EXPERT_AXIS,
+    MoEParams,
+    init_moe,
+    moe_apply,
+    moe_sharding,
+)
+from .pipeline_parallel import (  # noqa: F401
+    PIPE_AXIS,
+    build_pipeline,
+    pipeline_apply,
+)
 from .ring_attention import attention_reference, ring_attention  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
 from . import distributed  # noqa: F401

@@ -1,0 +1,133 @@
+"""Expert parallelism — a routed mixture-of-experts FFN over an ``"expert"``
+mesh axis.
+
+Absent from the reference (its only axis is Flink subtask data parallelism,
+SURVEY §2.10); included so the mesh vocabulary covers ep alongside dp/tp/pp/sp.
+
+TPU-first shape (the GShard/Mesh-TF recipe, not a scatter/gather port):
+routing is expressed as two einsums against a dense 0/1 dispatch tensor
+``(tokens, experts, capacity)``.  Everything is static-shaped — the MXU sees
+three large matmuls — and when tokens are sharded over ``"data"`` while
+expert buffers are sharded over ``"expert"``, the sharding constraint on the
+dispatched activations makes GSPMD insert the canonical all-to-all on ICI.
+Tokens over a full expert's capacity are dropped (their combine weight is 0,
+standard capacity-factor semantics), so shapes never depend on the routing.
+"""
+
+from __future__ import annotations
+
+import math
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["EXPERT_AXIS", "MoEParams", "init_moe", "moe_apply", "moe_sharding"]
+
+EXPERT_AXIS = "expert"
+
+
+class MoEParams(NamedTuple):
+    wg: jax.Array    # (d_model, n_experts) router
+    w_in: jax.Array  # (n_experts, d_model, d_hidden)
+    w_out: jax.Array  # (n_experts, d_hidden, d_model)
+
+
+def init_moe(rng: np.random.Generator, d_model: int, d_hidden: int,
+             n_experts: int) -> MoEParams:
+    scale_in = 1.0 / math.sqrt(d_model)
+    scale_out = 1.0 / math.sqrt(d_hidden)
+    return MoEParams(
+        wg=jnp.asarray(rng.normal(size=(d_model, n_experts)) * scale_in,
+                       jnp.float32),
+        w_in=jnp.asarray(
+            rng.normal(size=(n_experts, d_model, d_hidden)) * scale_in,
+            jnp.float32),
+        w_out=jnp.asarray(
+            rng.normal(size=(n_experts, d_hidden, d_model)) * scale_out,
+            jnp.float32),
+    )
+
+
+def moe_sharding(mesh: Mesh, *, expert_axis: str = EXPERT_AXIS) -> MoEParams:
+    """Shardings placing one expert group per device along ``expert_axis``
+    (router replicated)."""
+    return MoEParams(
+        wg=NamedSharding(mesh, P()),
+        w_in=NamedSharding(mesh, P(expert_axis)),
+        w_out=NamedSharding(mesh, P(expert_axis)),
+    )
+
+
+def moe_apply(params: MoEParams, x: jax.Array, *,
+              capacity_factor: float = 1.25,
+              group_size: Optional[int] = None,
+              mesh: Optional[Mesh] = None,
+              expert_axis: str = EXPERT_AXIS,
+              data_axis: Optional[str] = None) -> jax.Array:
+    """Top-1 routed MoE FFN: ``(tokens, d_model) -> (tokens, d_model)``.
+
+    Call under jit with ``params`` placed per :func:`moe_sharding` and the
+    owning ``mesh`` passed in; with ``mesh=None`` no sharding constraints are
+    applied (single-device / oracle use).
+
+    ``group_size`` bounds the dispatch/combine tensors: routing happens
+    independently within fixed-size token groups (the GShard group dim), so
+    dispatch memory is O(T * group_size * capacity_factor) instead of
+    O(capacity_factor * T^2).  With ``data_axis`` set and more than one
+    group, groups are sharded over the data axis and the dispatched expert
+    buffers over ``expert_axis`` — the layout change between the two is the
+    canonical MoE all-to-all, inserted by GSPMD.
+    """
+
+    def constrain(arr, spec):
+        if mesh is None:
+            return arr
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, spec))
+
+    n_tokens, d_model = x.shape
+    n_experts = params.wg.shape[1]
+    size = group_size or n_tokens
+    if n_tokens % size:
+        raise ValueError(
+            f"tokens {n_tokens} not divisible by group_size={size}")
+    n_groups = n_tokens // size
+    capacity = max(1, int(math.ceil(size / n_experts * capacity_factor)))
+    group_spec = data_axis if (data_axis and n_groups > 1) else None
+
+    xg = x.reshape(n_groups, size, d_model)                     # (G, S, d)
+    # Routing bookkeeping runs in f32 regardless of x.dtype: a bf16 cumsum
+    # is inexact past 256 and would collide queue positions (tokens silently
+    # summed into one capacity slot).
+    gates = jax.nn.softmax(
+        xg.astype(jnp.float32) @ params.wg.astype(jnp.float32), axis=-1)
+    top1 = jnp.argmax(gates, axis=-1)                           # (G, S)
+    gate_val = jnp.take_along_axis(gates, top1[..., None], axis=-1)[..., 0]
+
+    onehot = jax.nn.one_hot(top1, n_experts, dtype=jnp.float32)  # (G, S, E)
+    # Position of each token in its expert's queue; tokens past capacity drop.
+    pos = jnp.cumsum(onehot, axis=1) * onehot - onehot
+    within = (pos < capacity).astype(jnp.float32)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                            dtype=jnp.float32)                  # (G, S, E, C)
+    dispatch = onehot[..., None] * within[..., None] * pos_oh
+    dispatch_x = dispatch.astype(x.dtype)   # exact: 0/1 values
+
+    expert_in = jnp.einsum("gsec,gsd->gecd", dispatch_x, xg)    # (G, E, C, d)
+    expert_in = constrain(
+        expert_in, P(group_spec, expert_axis, None, None))      # all_to_all
+    hidden = jax.nn.gelu(
+        jnp.einsum("gecd,edh->gech", expert_in, params.w_in))
+    expert_out = jnp.einsum("gech,ehd->gecd", hidden, params.w_out)
+    expert_out = constrain(
+        expert_out, P(group_spec, expert_axis, None, None))
+    combine = (dispatch * gate_val[..., None, None]).astype(expert_out.dtype)
+    y = jnp.einsum("gsec,gecd->gsd", combine, expert_out)
+    y = y.reshape(n_tokens, d_model).astype(x.dtype)
+    if data_axis is not None:
+        y = constrain(y, P(data_axis, None))
+    return y

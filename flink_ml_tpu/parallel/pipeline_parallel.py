@@ -1,0 +1,122 @@
+"""Pipeline parallelism — GPipe-style microbatch scheduling over a mesh axis.
+
+The reference has no model parallelism of any kind (its only axis is Flink
+subtask data parallelism, SURVEY §2.10); the mesh API here reserved room for
+model axes (SURVEY §7, `parallel/mesh.py`).  This module fills the "pp" slot:
+layer stages are placed one-per-device along a ``"pipe"`` mesh axis and
+microbatches flow through the ring with ``lax.ppermute`` — every hop is one
+neighbor ICI link, never DCN.
+
+Design (TPU-first, not a port):
+- The schedule is a single ``lax.scan`` of ``n_micro + P - 1`` steps compiled
+  into one XLA program: no host round-trips between microbatches, and XLA
+  overlaps the ppermute with the next step's stage compute.
+- The whole thing is differentiable: ``jax.grad`` through the scan+ppermute
+  yields the reverse-order backward pipeline automatically — no hand-written
+  1F1B schedule is needed for correctness (it costs one extra activation
+  stash per in-flight microbatch, the usual GPipe memory shape).
+- Stages must be shape-homogeneous (each maps ``(mb, d) -> (mb, d)``), the
+  standard condition for ring pipelining.
+
+Composes with the other axes: batch dims can stay sharded over ``"data"``
+while stages split over ``"pipe"`` (tested on the 8-device CPU mesh).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .collectives import ppermute_ring, shard_map_fn
+
+__all__ = ["PIPE_AXIS", "pipeline_apply", "build_pipeline"]
+
+PIPE_AXIS = "pipe"
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any, xs: jax.Array, *,
+                   axis: str = PIPE_AXIS) -> jax.Array:
+    """Run a P-stage pipeline over microbatches.  **Call inside shard_map**
+    (or use :func:`build_pipeline` which wraps this).
+
+    Per-device view: ``stage_params`` is THIS device's stage parameters,
+    ``xs`` is the full ``(n_micro, mb, ...)`` microbatch stack (stage 0 reads
+    it; other stages receive activations from their ring predecessor).
+    Returns the ``(n_micro, mb, ...)`` outputs of the LAST stage on every
+    device (combined with a masked psum).
+    """
+    n_stages = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    n_micro = xs.shape[0]
+    n_steps = n_micro + n_stages - 1
+
+    def step(carry, t):
+        act, outs = carry
+        # Stage 0 injects microbatch t (clamped in the drain phase where no
+        # new work enters); later stages consume the ring-permuted activation.
+        mb_in = lax.dynamic_index_in_dim(
+            xs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        inp = jnp.where(idx == 0, mb_in, act)
+        y = stage_fn(stage_params, inp)
+        # The last stage finishes microbatch t-(P-1) at step t.
+        o = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        cur = lax.dynamic_index_in_dim(outs, o, 0, keepdims=False)
+        write = jnp.logical_and(idx == n_stages - 1, t >= n_stages - 1)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(write, y, cur), o, 0)
+        act = ppermute_ring(y, axis)
+        return (act, outs), None
+
+    act0 = jnp.zeros(xs.shape[1:], xs.dtype)
+    outs0 = jnp.zeros_like(xs)
+    (_, outs), _ = lax.scan(step, (act0, outs0),
+                            jnp.arange(n_steps, dtype=jnp.int32))
+    # Only the last stage holds real outputs (everyone else still has the
+    # zeros init); the psum both selects them and replicates across the axis.
+    return lax.psum(jnp.where(idx == n_stages - 1, outs, 0.0), axis)
+
+
+def build_pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   mesh: Mesh, *, n_micro: int, axis: str = PIPE_AXIS,
+                   data_axis: Optional[str] = None) -> Callable:
+    """Wrap :func:`pipeline_apply` into a jitted batch-level function.
+
+    ``fn(stacked_params, batch) -> out`` where ``stacked_params`` has a
+    leading stage dimension of size ``mesh.shape[axis]`` on every leaf and
+    ``batch`` is ``(B, ...)`` with ``B`` divisible by ``n_micro``.  With
+    ``data_axis`` set, the microbatch dim stays sharded over it (dp x pp).
+    """
+    if axis not in mesh.shape:
+        raise ValueError(f"Mesh has no axis {axis!r}; axes: {list(mesh.shape)}")
+    n_stages = int(mesh.shape[axis])
+
+    param_spec = P(axis)
+    xs_spec = P(None, data_axis) if data_axis else P(None)
+
+    @partial(shard_map_fn, mesh=mesh,
+             in_specs=(param_spec, xs_spec), out_specs=xs_spec)
+    def sharded(stacked_params, xs):
+        # shard_map leaves a leading stage dim of 1 on every param leaf.
+        local = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+        return pipeline_apply(stage_fn, local, xs, axis=axis)
+
+    @jax.jit
+    def fn(stacked_params, batch):
+        b = batch.shape[0]
+        if b % n_micro:
+            raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
+        leaf = jax.tree_util.tree_leaves(stacked_params)[0]
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"params leading dim {leaf.shape[0]} != pipe axis {n_stages}")
+        xs = batch.reshape(n_micro, b // n_micro, *batch.shape[1:])
+        out = sharded(stacked_params, xs)
+        return out.reshape(b, *batch.shape[1:])
+
+    return fn
