@@ -1,1 +1,2 @@
+from .prefetch import prefetch_to_device  # noqa: F401
 from .table import Table  # noqa: F401

@@ -1,0 +1,83 @@
+"""Host->device prefetch — the feed that keeps the MXU from waiting on IO.
+
+The reference streams records through Flink's network stack with built-in
+backpressure (SURVEY §2.10); on TPU the analog problem is keeping the device
+fed: ``device_put`` of batch N+1 (and the host-side read/decode behind it)
+must overlap the jitted step on batch N, or every step pays
+HBM-transfer + disk latency serially.
+
+``prefetch_to_device`` wraps any host-batch iterator with a bounded
+background thread: the thread pulls host batches (hitting the data cache's
+fadvise readahead, `data/datacache.py`), schedules the async ``device_put``,
+and parks the in-flight device buffers in a depth-bounded queue — classic
+double buffering at ``depth=2``, deeper if decode jitter demands it.  The
+bound is the backpressure: the reader never runs more than ``depth`` batches
+ahead of the consumer, so host RAM stays flat on out-of-core epochs.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import jax
+
+__all__ = ["prefetch_to_device"]
+
+_END = object()
+
+
+def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
+                       sharding: Optional[Any] = None,
+                       transform: Optional[Callable[[Any], Any]] = None
+                       ) -> Iterator[Any]:
+    """Iterate device-resident copies of ``batches``, staying ``depth``
+    batches ahead of the consumer.
+
+    ``sharding`` (e.g. a ``NamedSharding`` or a pytree of them matching the
+    batch structure) is passed to ``device_put``; ``transform`` runs on the
+    host thread before the transfer (decode/pad/astype — keeps that work off
+    the consumer thread too).
+
+    Exceptions raised by the source iterator or the transform are re-raised
+    at the consuming ``next()`` call.
+    """
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1, got {depth}")
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for batch in batches:
+                if stop.is_set():
+                    return
+                if transform is not None:
+                    batch = transform(batch)
+                batch = (jax.device_put(batch, sharding)
+                         if sharding is not None else jax.device_put(batch))
+                while not stop.is_set():
+                    try:
+                        q.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+            q.put(_END)
+        except BaseException as exc:  # noqa: BLE001 — re-raised at consumer
+            q.put(exc)
+
+    thread = threading.Thread(target=worker, daemon=True,
+                              name="flink-ml-tpu-prefetch")
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()

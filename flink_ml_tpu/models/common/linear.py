@@ -28,7 +28,7 @@ from ...params.shared import (
 )
 from ...utils import persist
 from .losses import LOSSES
-from .sgd import LinearState, SGDConfig, sgd_fit
+from .sgd import LinearState, SGDConfig, sgd_fit, sgd_fit_outofcore
 
 __all__ = ["LinearEstimatorParams", "LinearModelBase", "LinearEstimatorBase"]
 
@@ -142,17 +142,40 @@ class LinearEstimatorBase(LinearEstimatorParams, Estimator):
                    if weight_col else None)
 
         state, loss_log = sgd_fit(
-            LOSSES[self.loss_name], X, y, weights,
-            SGDConfig(
-                learning_rate=self.get_learning_rate(),
-                reg=self.get_reg(),
-                elastic_net=self.get_elastic_net(),
-                global_batch_size=self.get_global_batch_size(),
-                max_epochs=self.get_max_iter(),
-                tol=self.get_tol(),
-                seed=self.get_seed(),
-            ))
+            LOSSES[self.loss_name], X, y, weights, self._sgd_config())
 
+        model = self.model_cls()
+        model.copy_params_from(self)
+        model._state = state
+        model._loss_log = loss_log
+        return model
+
+    def _sgd_config(self) -> SGDConfig:
+        return SGDConfig(
+            learning_rate=self.get_learning_rate(),
+            reg=self.get_reg(),
+            elastic_net=self.get_elastic_net(),
+            global_batch_size=self.get_global_batch_size(),
+            max_epochs=self.get_max_iter(),
+            tol=self.get_tol(),
+            seed=self.get_seed(),
+        )
+
+    def fit_outofcore(self, make_reader, *, num_features: int, mesh=None):
+        """Out-of-core ``fit``: the dataset streams from ``make_reader()``
+        (a fresh per-epoch iterator of host batch dicts, e.g. a re-seeked
+        ``DataCacheReader``) instead of living in RAM/HBM — the
+        Criteo-scale input path (BASELINE.md north star).  Column names
+        follow this estimator's params (featuresCol/labelCol/weightCol).
+        globalBatchSize and seed are inert here: the reader owns batch size
+        and ordering (shuffle when writing the cache or vary segment order
+        per epoch)."""
+        state, loss_log = sgd_fit_outofcore(
+            LOSSES[self.loss_name], make_reader,
+            num_features=num_features, config=self._sgd_config(), mesh=mesh,
+            features_key=self.get_features_col(),
+            label_key=self.get_label_col(),
+            weight_key=self.get_weight_col() or None)
         model = self.model_cls()
         model.copy_params_from(self)
         model._state = state

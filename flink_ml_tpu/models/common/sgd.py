@@ -23,11 +23,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ...data.prefetch import prefetch_to_device
 from ...iteration import IterationBodyResult, IterationConfig, iterate
 from ...parallel.mesh import default_mesh, replicate
 
-__all__ = ["SGDConfig", "sgd_fit", "LinearState", "plan_epoch_layout",
-           "prepare_epoch_tensor"]
+__all__ = ["SGDConfig", "sgd_fit", "sgd_fit_outofcore", "LinearState",
+           "plan_epoch_layout", "prepare_epoch_tensor"]
 
 LossFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
 
@@ -102,33 +103,14 @@ def sgd_fit(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
     y = jax.device_put(y, batch_sharded)
     w = jax.device_put(w, batch_sharded)
 
-    lr = config.learning_rate
-    reg, alpha = config.reg, config.elastic_net
-    l2 = reg * (1.0 - alpha)
-    l1 = reg * alpha
-
-    def objective(params, xb, yb, wb):
-        margin = xb @ params["w"] + params["b"]
-        return loss_fn(margin, yb, wb) + 0.5 * l2 * jnp.sum(
-            jnp.square(params["w"]))
-
-    grad_fn = jax.value_and_grad(objective)
+    update = _linear_update(loss_fn, config)
 
     def epoch_body(state, epoch, data):
         Xd, yd, wd = data
         params, prev_loss, loss_log = state
 
         def batch_step(params, batch_idx):
-            value, grads = grad_fn(params,
-                                   Xd[batch_idx], yd[batch_idx], wd[batch_idx])
-            new_w = params["w"] - lr * grads["w"]
-            if l1 > 0:
-                # proximal soft-threshold for the l1 part
-                new_w = jnp.sign(new_w) * jnp.maximum(
-                    jnp.abs(new_w) - lr * l1, 0.0)
-            new_b = params["b"] - (lr * grads["b"]
-                                   if config.fit_intercept else 0.0)
-            return {"w": new_w, "b": new_b}, value
+            return update(params, Xd[batch_idx], yd[batch_idx], wd[batch_idx])
 
         params, losses = jax.lax.scan(
             batch_step, params, jnp.arange(steps, dtype=jnp.int32))
@@ -156,5 +138,122 @@ def sgd_fit(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
     params, _final_loss, loss_buf = result.state
     params = jax.device_get(params)
     loss_log = list(np.asarray(jax.device_get(loss_buf))[:result.num_epochs])
+    return LinearState(np.asarray(params["w"], np.float64),
+                       float(params["b"])), loss_log
+
+
+def _linear_update(loss_fn: LossFn, config: SGDConfig):
+    """THE single-batch update — l2-regularized gradient step + l1 proximal
+    soft-threshold — shared by the fused (sgd_fit) and streaming
+    (sgd_fit_outofcore) paths so the two can never diverge.  Unjitted;
+    callers place it inside their own compiled program."""
+    lr = config.learning_rate
+    reg, alpha = config.reg, config.elastic_net
+    l2 = reg * (1.0 - alpha)
+    l1 = reg * alpha
+
+    def objective(params, xb, yb, wb):
+        margin = xb @ params["w"] + params["b"]
+        return loss_fn(margin, yb, wb) + 0.5 * l2 * jnp.sum(
+            jnp.square(params["w"]))
+
+    grad_fn = jax.value_and_grad(objective)
+
+    def update(params, xb, yb, wb):
+        value, grads = grad_fn(params, xb, yb, wb)
+        new_w = params["w"] - lr * grads["w"]
+        if l1 > 0:
+            # proximal soft-threshold for the l1 part
+            new_w = jnp.sign(new_w) * jnp.maximum(
+                jnp.abs(new_w) - lr * l1, 0.0)
+        new_b = params["b"] - (lr * grads["b"]
+                               if config.fit_intercept else 0.0)
+        return {"w": new_w, "b": new_b}, value
+
+    return update
+
+
+def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
+                      num_features: int, config: SGDConfig, mesh=None,
+                      features_key: str = "features",
+                      label_key: str = "label",
+                      weight_key: Optional[str] = None,
+                      prefetch_depth: int = 2
+                      ) -> Tuple[LinearState, list]:
+    """Out-of-core variant of :func:`sgd_fit`: the dataset never has to fit
+    in host RAM or HBM (the Criteo-1TB shape, BASELINE.md north star).
+
+    ``make_reader()`` is called once per epoch and must return a fresh
+    iterator of host batch dicts with fixed row count per batch (e.g.
+    ``DataCacheReader(..., batch_rows=B)`` re-seeked to 0 — its fadvise
+    readahead covers the disk side).  Batches are padded to the first
+    batch's row count (padding rows carry weight 0), transferred via
+    :func:`prefetch_to_device` so the host read/decode and the HBM transfer
+    of batch N+1 overlap the jitted step on batch N, and consumed by one
+    compiled update program — static shapes, zero recompiles across the
+    epoch.
+
+    Unlike :func:`sgd_fit`, the READER owns the data layout:
+    ``config.global_batch_size`` and ``config.seed`` are inert here — batch
+    size is the reader's ``batch_rows`` and any shuffling must happen in the
+    reader (e.g. shuffle when writing the cache, or shuffle segment order
+    per epoch).
+    """
+    mesh = mesh or default_mesh()
+    n_dev = int(mesh.shape["data"])
+    update = _linear_update(loss_fn, config)
+    batch_step = jax.jit(update, donate_argnums=0)
+
+    x_sh = NamedSharding(mesh, P("data", None))
+    v_sh = NamedSharding(mesh, P("data"))
+    sharding = (x_sh, v_sh, v_sh)
+    batch_rows: list = []   # fixed after first batch
+
+    def to_host_triplet(batch):
+        X = np.asarray(batch[features_key], np.float32)
+        y = np.asarray(batch[label_key], np.float32)
+        w = (np.asarray(batch[weight_key], np.float32) if weight_key
+             else np.ones((X.shape[0],), np.float32))
+        if not batch_rows:
+            rows = X.shape[0]
+            rows += (-rows) % n_dev   # data-axis divisibility
+            batch_rows.append(rows)
+        rows = batch_rows[0]
+        if X.shape[0] > rows:
+            raise ValueError(
+                f"reader produced a growing batch ({X.shape[0]} rows after "
+                f"{rows}); fixed-size batches are required")
+        if X.shape[0] < rows:       # final partial batch: pad, weight 0
+            pad = rows - X.shape[0]
+            X = np.concatenate([X, np.zeros((pad, X.shape[1]), X.dtype)])
+            y = np.concatenate([y, np.zeros((pad,), y.dtype)])
+            w = np.concatenate([w, np.zeros((pad,), w.dtype)])
+        return X, y, w
+
+    params = replicate(
+        {"w": jnp.zeros((num_features,), jnp.float32),
+         "b": jnp.zeros((), jnp.float32)}, mesh)
+    loss_log: list = []
+    prev_loss = float("inf")
+    add = jax.jit(jnp.add)
+    for _epoch in range(config.max_epochs):
+        # Running on-device sum: memory stays flat over millions of batches
+        # (a list of live per-batch scalars would grow O(n_batches)).
+        loss_sum = None
+        n_batches = 0
+        for xb, yb, wb in prefetch_to_device(
+                make_reader(), depth=prefetch_depth,
+                transform=to_host_triplet, sharding=sharding):
+            params, value = batch_step(params, xb, yb, wb)
+            loss_sum = value if loss_sum is None else add(loss_sum, value)
+            n_batches += 1
+        if loss_sum is None:
+            raise ValueError("make_reader() returned an empty epoch")
+        epoch_loss = float(jax.device_get(loss_sum)) / n_batches
+        loss_log.append(epoch_loss)
+        if config.tol > 0 and abs(prev_loss - epoch_loss) <= config.tol:
+            break
+        prev_loss = epoch_loss
+    params = jax.device_get(params)
     return LinearState(np.asarray(params["w"], np.float64),
                        float(params["b"])), loss_log

@@ -1,0 +1,158 @@
+"""Out-of-core training path: host->device prefetch + streaming SGD from the
+data cache (the Criteo-scale input shape, BASELINE.md north star).  Runs on
+the virtual 8-device mesh like everything else."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_ml_tpu.data.datacache import DataCacheReader, DataCacheWriter
+from flink_ml_tpu.data.prefetch import prefetch_to_device
+from flink_ml_tpu.models.classification.logisticregression import (
+    LogisticRegression,
+)
+from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+from flink_ml_tpu.models.common.losses import logistic_loss
+from flink_ml_tpu.data.table import Table
+
+
+# ------------------------------------------------------------- prefetch
+
+
+def test_prefetch_preserves_order_and_values():
+    batches = [np.full((4,), i, np.float32) for i in range(10)]
+    out = list(prefetch_to_device(iter(batches), depth=2))
+    assert len(out) == 10
+    for i, b in enumerate(out):
+        assert isinstance(b, jax.Array)
+        np.testing.assert_array_equal(np.asarray(b), batches[i])
+
+
+def test_prefetch_transform_runs_on_worker_thread():
+    main = threading.get_ident()
+    seen = []
+
+    def transform(b):
+        seen.append(threading.get_ident())
+        return b * 2
+
+    out = list(prefetch_to_device(iter([np.ones(2), np.ones(2)]),
+                                  transform=transform))
+    assert all(t != main for t in seen)
+    np.testing.assert_array_equal(np.asarray(out[0]), [2.0, 2.0])
+
+
+def test_prefetch_propagates_source_exception():
+    def bad_source():
+        yield np.ones(2)
+        raise RuntimeError("disk on fire")
+
+    it = prefetch_to_device(bad_source(), depth=1)
+    next(it)
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        next(it)
+
+
+def test_prefetch_depth_validated():
+    with pytest.raises(ValueError, match="depth"):
+        list(prefetch_to_device(iter([]), depth=0))
+
+
+def test_prefetch_applies_sharding():
+    from flink_ml_tpu.parallel.mesh import device_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = device_mesh({"data": 8})
+    sh = NamedSharding(mesh, P("data"))
+    (out,) = list(prefetch_to_device(iter([np.arange(16, dtype=np.float32)]),
+                                     sharding=sh))
+    assert out.sharding == sh
+
+
+def test_prefetch_early_abandon_does_not_hang():
+    it = prefetch_to_device((np.ones(2) for _ in range(1000)), depth=2)
+    next(it)
+    it.close()  # generator close must stop the worker
+
+
+# -------------------------------------------------------- streaming SGD
+
+
+def _write_lr_cache(tmp_path, n=4096, d=16, seed=0):
+    """Linearly-separable data cached on disk; returns (dir, true_w)."""
+    rng = np.random.default_rng(seed)
+    true_w = rng.normal(size=(d,))
+    cache = str(tmp_path / "cache")
+    writer = DataCacheWriter(cache, segment_rows=1024)
+    for start in range(0, n, 512):
+        X = rng.normal(size=(512, d)).astype(np.float32)
+        y = (X @ true_w > 0).astype(np.float32)
+        writer.append({"features": X, "label": y})
+    writer.finish()
+    return cache, true_w
+
+
+def test_sgd_outofcore_converges(tmp_path):
+    cache, true_w = _write_lr_cache(tmp_path)
+
+    def make_reader():
+        return iter(DataCacheReader(cache, batch_rows=256))
+
+    state, loss_log = sgd_fit_outofcore(
+        logistic_loss, make_reader, num_features=16,
+        config=SGDConfig(learning_rate=0.5, max_epochs=8, tol=0.0))
+    assert len(loss_log) == 8
+    assert loss_log[-1] < loss_log[0] * 0.5
+    # direction of the recovered separator matches the generator
+    cos = (state.coefficients @ true_w) / (
+        np.linalg.norm(state.coefficients) * np.linalg.norm(true_w))
+    assert cos > 0.97
+
+
+def test_sgd_outofcore_partial_final_batch(tmp_path):
+    cache, _ = _write_lr_cache(tmp_path, n=4096)
+
+    def make_reader():
+        # 4096 % 384 != 0 -> final partial batch exercises padding
+        return iter(DataCacheReader(cache, batch_rows=384))
+
+    state, loss_log = sgd_fit_outofcore(
+        logistic_loss, make_reader, num_features=16,
+        config=SGDConfig(learning_rate=0.5, max_epochs=3, tol=0.0))
+    assert np.all(np.isfinite(state.coefficients))
+    assert loss_log[-1] < loss_log[0]
+
+
+def test_sgd_outofcore_empty_reader_rejected():
+    with pytest.raises(ValueError, match="empty epoch"):
+        sgd_fit_outofcore(
+            logistic_loss, lambda: iter([]), num_features=4,
+            config=SGDConfig(max_epochs=2))
+
+
+def test_estimator_fit_outofcore_matches_inmemory_quality(tmp_path):
+    cache, _ = _write_lr_cache(tmp_path, n=2048)
+    reader = DataCacheReader(cache, batch_rows=256)
+    # materialize for the in-memory comparison + eval table
+    batches = list(reader)
+    X = np.concatenate([b["features"] for b in batches])
+    y = np.concatenate([b["label"] for b in batches])
+    table = Table({"features": X, "label": y})
+
+    est = (LogisticRegression().set_learning_rate(0.5).set_max_iter(6)
+           .set_tol(0.0))
+    model_stream = est.fit_outofcore(
+        lambda: iter(DataCacheReader(cache, batch_rows=256)),
+        num_features=16)
+    model_mem = est.fit(table)
+
+    def acc(model):
+        pred = np.asarray(model.transform(table)[0]["prediction"])
+        return np.mean(pred == y)
+
+    a_stream, a_mem = acc(model_stream), acc(model_mem)
+    assert a_stream > 0.95
+    assert abs(a_stream - a_mem) < 0.03
