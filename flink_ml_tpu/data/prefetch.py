@@ -49,6 +49,16 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
     q: queue.Queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
 
+    def put_or_abandon(item) -> None:
+        """Stop-aware put: never parks forever if the consumer walked away
+        (an untimed put here would leak the thread + queued device buffers)."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
     def worker():
         try:
             for batch in batches:
@@ -58,15 +68,10 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
                     batch = transform(batch)
                 batch = (jax.device_put(batch, sharding)
                          if sharding is not None else jax.device_put(batch))
-                while not stop.is_set():
-                    try:
-                        q.put(batch, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-            q.put(_END)
+                put_or_abandon(batch)
+            put_or_abandon(_END)
         except BaseException as exc:  # noqa: BLE001 — re-raised at consumer
-            q.put(exc)
+            put_or_abandon(exc)
 
     thread = threading.Thread(target=worker, daemon=True,
                               name="flink-ml-tpu-prefetch")

@@ -66,10 +66,12 @@ class KMeansParams(KMeansModelParams, HasSeed, HasMaxIter):
         return self.set(KMeansParams.K, value)
 
 
-def _prepare_points(points: np.ndarray, mesh) -> tuple:
-    """Host -> device: pad rows to the data-axis multiple (mask marks real
-    rows) and shard the batch dim."""
-    padded, mask = pad_rows_with_mask(points, int(mesh.shape["data"]))
+def _prepare_points(points: np.ndarray, mesh,
+                    row_multiple: int = 1, fill: str = "first_row") -> tuple:
+    """Host -> device: pad rows to a multiple of the data-axis size (and of
+    ``row_multiple`` per shard; mask marks real rows), shard the batch dim."""
+    multiple = int(mesh.shape["data"]) * row_multiple
+    padded, mask = pad_rows_with_mask(points, multiple, fill=fill)
     sharding = data_sharding(mesh)
     return jax.device_put(padded, sharding), jax.device_put(mask, sharding)
 
@@ -113,6 +115,62 @@ def kmeans_epoch_step(measure: DistanceMeasure, k: int):
     return body
 
 
+def kmeans_epoch_step_pallas(k: int, mesh=None, *, block_n: int = 8192,
+                             tie_policy: str = "fast",
+                             interpret: bool = False):
+    """One Lloyd's iteration on the fused Pallas kernel
+    (``ops/kmeans_pallas.py``): score/one-hot tiles stay in VMEM, HBM traffic
+    drops ~12x vs the XLA expansion (~3.5x measured step speedup on v5e).
+
+    Requires zero-filled padding (``fill="zero"``) with the per-shard row
+    count a multiple of ``block_n``; euclidean metric only.  With a
+    multi-device ``mesh``, per-shard partial sums meet in one ICI psum."""
+    from ...ops import kmeans_pallas as kp
+
+    sharded = mesh is not None and int(mesh.shape.get("data", 1)) > 1
+
+    def body(centroids, epoch, data):
+        points, mask = data
+        if sharded:
+            sums, counts = kp.update_stats_sharded(
+                points, centroids, mesh, block_n=block_n,
+                tie_policy=tie_policy, interpret=interpret)
+        else:
+            sums, counts = kp.kmeans_update_stats(
+                points, centroids, block_n=block_n, tie_policy=tie_policy,
+                interpret=interpret)
+        n_pad = points.shape[0] - jnp.sum(mask)
+        counts = kp.pad_correction(counts, centroids, n_pad,
+                                   tie_policy=tie_policy)[:, None]
+        # No clamp-to-1 here: "split" ties legally produce fractional counts
+        # in (0, 1), which must divide as-is.
+        safe = jnp.where(counts > 0, counts, 1.0)
+        new_centroids = jnp.where(counts > 0, sums / safe, centroids)
+        return IterationBodyResult(feedback=new_centroids)
+
+    return body
+
+
+# Pallas engages only above this row count — below it the XLA path is within
+# noise and avoids kernel constraints (zero-fill, block divisibility).
+_PALLAS_MIN_ROWS = 65536
+
+
+def _plan_fit_impl(n: int, d: int, k: int, measure: DistanceMeasure,
+                   mesh) -> tuple:
+    """Pick (impl, block_n) for the fit loop.  Pallas requires TPU backend,
+    euclidean metric, and a viable block size."""
+    from ...ops import kmeans_pallas as kp
+
+    if (jax.default_backend() != "tpu" or measure.name != "euclidean"
+            or n < _PALLAS_MIN_ROWS):
+        return "xla", None
+    # Padding rounds the per-shard row count up to the block (n=None), so
+    # any supported block size works; pick_block_n takes the largest.
+    bn = kp.pick_block_n(None, d, k)
+    return ("pallas", bn) if bn is not None else ("xla", None)
+
+
 class KMeans(KMeansParams, Estimator["KMeansModel"]):
     """Estimator: Lloyd's algorithm for ``maxIter`` rounds
     (termination parity with ``TerminateOnMaxIterationNum``,
@@ -128,11 +186,19 @@ class KMeans(KMeansParams, Estimator["KMeansModel"]):
             np.float32)
         init = select_random_centroids(host_points, k, self.get_seed())
 
-        points, mask = _prepare_points(host_points, mesh)
+        impl, block_n = _plan_fit_impl(host_points.shape[0],
+                                       host_points.shape[1], k, measure, mesh)
+        if impl == "pallas":
+            points, mask = _prepare_points(host_points, mesh,
+                                           row_multiple=block_n, fill="zero")
+            body = kmeans_epoch_step_pallas(k, mesh, block_n=block_n)
+        else:
+            points, mask = _prepare_points(host_points, mesh)
+            body = kmeans_epoch_step(measure, k)
         init_dev = replicate(init, mesh)
 
         result = iterate(
-            kmeans_epoch_step(measure, k),
+            body,
             init_dev,
             (points, mask),
             max_epochs=self.get_max_iter(),

@@ -32,3 +32,12 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture
+def cpu_mesh_8():
+    """All 8 virtual CPU devices on one ``data`` axis (the MiniCluster
+    analog)."""
+    from flink_ml_tpu.parallel.mesh import device_mesh
+
+    return device_mesh({"data": 8})

@@ -142,3 +142,102 @@ def test_manhattan_distance_measure():
     model = (KMeans().set_distance_measure("manhattan").set_max_iter(10)
              .fit(_table()))
     assert _clusters(model.transform(_table())[0]) == EXPECTED
+
+
+def test_pallas_epoch_step_matches_xla_step():
+    # The fused-kernel body (interpret mode) must reproduce the XLA body on
+    # zero-padded data, for both tie policies.
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.distance import DistanceMeasure
+    from flink_ml_tpu.models.clustering.kmeans import (
+        kmeans_epoch_step,
+        kmeans_epoch_step_pallas,
+    )
+
+    rng = np.random.default_rng(3)
+    pts = rng.normal(size=(256 - 11, 4)).astype(np.float32)
+    padded = np.concatenate(
+        [pts, np.zeros((11, 4), np.float32)]).astype(np.float32)
+    mask = np.concatenate([np.ones(len(pts)), np.zeros(11)]).astype(np.float32)
+    cents = pts[:5].copy()
+    data = (jnp.asarray(padded), jnp.asarray(mask))
+
+    xla_body = kmeans_epoch_step(DistanceMeasure.get_instance("euclidean"), 5)
+    expected = np.asarray(xla_body(jnp.asarray(cents), 0, data).feedback)
+    for tie_policy in ("fast", "split"):
+        body = kmeans_epoch_step_pallas(5, block_n=128, tie_policy=tie_policy,
+                                        interpret=True)
+        got = np.asarray(body(jnp.asarray(cents), 0, data).feedback)
+        np.testing.assert_allclose(got, expected, atol=1e-4)
+
+
+def test_pallas_epoch_step_sharded_matches(cpu_mesh_8):
+    import jax
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.models.clustering.kmeans import (
+        _prepare_points,
+        kmeans_epoch_step_pallas,
+    )
+    from flink_ml_tpu.parallel.mesh import replicate
+
+    rng = np.random.default_rng(4)
+    pts = rng.normal(size=(1000, 4)).astype(np.float32)
+    points, mask = _prepare_points(pts, cpu_mesh_8, row_multiple=128,
+                                   fill="zero")
+    assert points.shape[0] == 1024
+    cents = replicate(pts[:5].copy(), cpu_mesh_8)
+
+    single = kmeans_epoch_step_pallas(5, block_n=128, interpret=True)
+    sharded = kmeans_epoch_step_pallas(5, cpu_mesh_8, block_n=128,
+                                       interpret=True)
+    expected = np.asarray(single(jnp.asarray(pts[:5].copy()), 0,
+                                 (jnp.asarray(np.asarray(points)),
+                                  jnp.asarray(np.asarray(mask)))).feedback)
+    got = np.asarray(
+        jax.jit(lambda c, d: sharded(c, 0, d).feedback)(cents, (points, mask)))
+    np.testing.assert_allclose(got, expected, atol=1e-4)
+
+
+def test_plan_fit_impl_gates():
+    import jax
+
+    from flink_ml_tpu.distance import DistanceMeasure
+    from flink_ml_tpu.models.clustering import kmeans as km
+    from flink_ml_tpu.parallel.mesh import default_mesh
+
+    mesh = default_mesh()
+    euclid = DistanceMeasure.get_instance("euclidean")
+    cosine = DistanceMeasure.get_instance("cosine")
+    if jax.default_backend() == "tpu":  # pragma: no cover - CPU suite
+        assert km._plan_fit_impl(1 << 20, 64, 256, euclid, mesh)[0] == "pallas"
+    # CPU backend always plans XLA
+    else:
+        assert km._plan_fit_impl(1 << 20, 64, 256, euclid, mesh)[0] == "xla"
+    # small n / non-euclidean never plan pallas regardless of backend
+    assert km._plan_fit_impl(100, 64, 256, euclid, mesh)[0] == "xla"
+    assert km._plan_fit_impl(1 << 20, 64, 256, cosine, mesh)[0] == "xla"
+
+
+def test_pallas_step_fractional_split_counts_divide_exactly():
+    # A cluster whose total "split" count is fractional (< 1) must divide by
+    # the fractional count, not a clamp-to-1 (regression: centroid scaled by
+    # its count).
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.models.clustering.kmeans import kmeans_epoch_step_pallas
+
+    p = np.zeros((128, 4), np.float32)
+    p[0] = [2.0, 0.0, 0.0, 0.0]
+    p[1:] = [40.0, 0.0, 0.0, 0.0]  # rest land on the far centroid
+    dup = np.array([[2.0, 0.0, 0.0, 1.0], [2.0, 0.0, 0.0, -1.0]], np.float32)
+    cents = jnp.asarray(np.concatenate([dup, [[40.0, 0, 0, 0]]]))
+    mask = jnp.asarray(np.ones(128, np.float32))
+    body = kmeans_epoch_step_pallas(3, block_n=128, tie_policy="split",
+                                    interpret=True)
+    new = np.asarray(body(cents, 0, (jnp.asarray(p), mask)).feedback)
+    # p[0] ties between the duplicate pair -> each gets count 0.5, sum 0.5*p0;
+    # the mean must still be exactly p0.
+    np.testing.assert_allclose(new[0], p[0], atol=1e-5)
+    np.testing.assert_allclose(new[1], p[0], atol=1e-5)

@@ -9,67 +9,156 @@ import pytest
 from flink_ml_tpu.ops.kmeans_pallas import (
     kmeans_assign_reduce,
     kmeans_update_stats,
+    pad_correction,
+    pick_block_n,
     supported,
+    update_stats_sharded,
 )
 
 
-def _problem(n=512, d=16, k=8, seed=0):
+def _problem(n=512, d=16, k=8, n_pad=17, seed=0):
+    """Points with ``n_pad`` trailing all-zero padding rows (the maskless
+    kernel contract)."""
     rng = np.random.default_rng(seed)
     pts = rng.normal(size=(n, d)).astype(np.float32)
+    pts[-n_pad:] = 0.0
     cents = pts[:k].copy()
-    mask = np.ones((n,), np.float32)
-    mask[-17:] = 0.0  # padding rows
-    return jnp.asarray(pts), jnp.asarray(mask), jnp.asarray(cents)
+    return jnp.asarray(pts), jnp.asarray(cents), n_pad
 
 
-def _oracle(pts, mask, cents):
-    pts, mask, cents = map(np.asarray, (pts, mask, cents))
+def _oracle(pts, cents, n_pad):
+    """Numpy Lloyd's statistics over the real (non-padding) rows only."""
+    pts = np.asarray(pts)[: pts.shape[0] - n_pad]
+    cents = np.asarray(cents)
     d2 = ((pts[:, None, :] - cents[None, :, :]) ** 2).sum(-1)
     assign = d2.argmin(1)
     oh = np.zeros((pts.shape[0], cents.shape[0]), np.float32)
     oh[np.arange(pts.shape[0]), assign] = 1
-    oh *= mask[:, None]
     return assign, oh.T @ pts, oh.sum(0)
 
 
+def _corrected_stats(pts, cents, n_pad, **kw):
+    sums, counts = kmeans_update_stats(pts, cents, interpret=True, **kw)
+    counts = pad_correction(counts, cents, n_pad)
+    return sums, counts
+
+
+def test_update_stats_matches_oracle():
+    pts, cents, n_pad = _problem()
+    _, exp_sums, exp_counts = _oracle(pts, cents, n_pad)
+    for tie_policy in ("fast", "split"):
+        sums, counts = _corrected_stats(pts, cents, n_pad, block_n=128,
+                                        tie_policy=tie_policy)
+        np.testing.assert_allclose(np.asarray(sums), exp_sums, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(counts), exp_counts, atol=1e-5)
+
+
+def test_update_stats_bf16_dots_conserve_mass():
+    # bf16 scores may flip boundary assignments vs the f32 oracle, so check
+    # the invariants instead: with "split" ties every real row contributes
+    # exactly once, so counts and coordinate mass are conserved.
+    pts, cents, n_pad = _problem()
+    sums, counts = _corrected_stats(pts, cents, n_pad, block_n=128,
+                                    tie_policy="split",
+                                    compute_dtype=jnp.bfloat16)
+    np.testing.assert_allclose(np.asarray(counts).sum(), 512 - n_pad,
+                               atol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(sums).sum(0),
+        np.asarray(pts)[: 512 - n_pad].sum(0), atol=0.3)
+
+
 def test_assign_reduce_matches_oracle():
-    pts, mask, cents = _problem()
-    assign, sums, counts = kmeans_assign_reduce(pts, mask, cents,
-                                                block_n=128, interpret=True)
-    exp_assign, exp_sums, exp_counts = _oracle(pts, mask, cents)
-    np.testing.assert_array_equal(np.asarray(assign), exp_assign)
+    pts, cents, n_pad = _problem()
+    assign, sums, counts = kmeans_assign_reduce(pts, cents, block_n=128,
+                                                interpret=True)
+    counts = pad_correction(counts, cents, n_pad)
+    exp_assign, exp_sums, exp_counts = _oracle(pts, cents, n_pad)
+    np.testing.assert_array_equal(np.asarray(assign)[: 512 - n_pad],
+                                  exp_assign)
     np.testing.assert_allclose(np.asarray(sums), exp_sums, atol=1e-3)
     np.testing.assert_allclose(np.asarray(counts), exp_counts)
 
 
-def test_update_stats_matches_oracle():
-    pts, mask, cents = _problem()
-    sums, counts = kmeans_update_stats(pts, mask, cents,
-                                       block_n=128, interpret=True)
-    _, exp_sums, exp_counts = _oracle(pts, mask, cents)
-    np.testing.assert_allclose(np.asarray(sums), exp_sums, atol=1e-3)
-    np.testing.assert_allclose(np.asarray(counts), exp_counts, atol=1e-5)
+def test_split_ties_fractional():
+    # Two identical centroids: "split" halves each point between them,
+    # "fast" double-counts — both leave the centroid *means* identical.
+    rng = np.random.default_rng(1)
+    pts = rng.normal(size=(128, 8)).astype(np.float32)
+    cents = np.stack([pts[0], pts[0]])  # exact duplicates -> every row ties
+    split_sums, split_counts = kmeans_update_stats(
+        jnp.asarray(pts), jnp.asarray(cents), block_n=128,
+        tie_policy="split", interpret=True)
+    fast_sums, fast_counts = kmeans_update_stats(
+        jnp.asarray(pts), jnp.asarray(cents), block_n=128,
+        tie_policy="fast", interpret=True)
+    np.testing.assert_allclose(np.asarray(split_counts).sum(), 128)
+    np.testing.assert_allclose(np.asarray(fast_counts).sum(), 256)
+    for sums, counts in ((split_sums, split_counts), (fast_sums, fast_counts)):
+        means = np.asarray(sums) / np.asarray(counts)[:, None]
+        np.testing.assert_allclose(means[0], means[1], rtol=1e-5)
+        np.testing.assert_allclose(means[0], pts.mean(0), rtol=1e-4)
 
 
-def test_mask_zeroes_padding_contribution():
-    pts, mask, cents = _problem()
-    # same points, but with padding rows replaced by huge values that would
-    # corrupt sums if the mask leaked
-    pts_np = np.asarray(pts).copy()
-    pts_np[-17:] = 1e6
-    sums, counts = kmeans_update_stats(jnp.asarray(pts_np), mask, cents,
-                                       block_n=128, interpret=True)
-    assert np.all(np.isfinite(np.asarray(sums)))
-    assert float(np.asarray(counts).sum()) == pytest.approx(512 - 17)
-    assert np.abs(np.asarray(sums)).max() < 1e4  # 1e6 rows never entered
+def test_update_stats_sharded_matches_single(cpu_mesh_8):
+    pts, cents, n_pad = _problem(n=1024, d=16, k=8)
+    sharded_sums, sharded_counts = update_stats_sharded(
+        pts, cents, cpu_mesh_8, block_n=128, interpret=True)
+    sums, counts = kmeans_update_stats(pts, cents, block_n=128,
+                                       interpret=True)
+    np.testing.assert_allclose(np.asarray(sharded_sums), np.asarray(sums),
+                               atol=1e-3)
+    np.testing.assert_allclose(np.asarray(sharded_counts), np.asarray(counts),
+                               atol=1e-5)
+
+
+def test_pad_correction_only_touches_nearest_to_origin():
+    cents = jnp.asarray(np.array([[3.0, 0.0], [0.5, 0.5], [2.0, 2.0]],
+                                 np.float32))
+    counts = jnp.asarray(np.array([10.0, 20.0, 30.0], np.float32))
+    out = np.asarray(pad_correction(counts, cents, 7))
+    np.testing.assert_allclose(out, [10.0, 13.0, 30.0])
+
+
+def test_pad_correction_exact_under_min_norm_ties():
+    # Two centroids tie for minimal norm (duplicated init): the kernel counts
+    # padding on BOTH under "fast" and half-each under "split"; the
+    # correction must mirror that, not subtract from the first only.
+    rng = np.random.default_rng(5)
+    n, n_pad = 128, 32
+    pts = rng.normal(loc=5.0, size=(n, 8)).astype(np.float32)
+    pts[-n_pad:] = 0.0
+    dup = pts[0] * 0.01  # small-norm duplicate pair
+    cents = jnp.asarray(np.stack([dup, dup, pts[1], pts[2]]))
+    exp_counts = _oracle(jnp.asarray(pts), cents, n_pad)[2]
+    for tie_policy, scale in (("fast", 2.0), ("split", 1.0)):
+        _, counts = kmeans_update_stats(jnp.asarray(pts), cents, block_n=128,
+                                        tie_policy=tie_policy, interpret=True)
+        counts = np.asarray(pad_correction(counts, cents, n_pad,
+                                           tie_policy=tie_policy))
+        # real rows tie on the duplicate pair too, under the same policy
+        np.testing.assert_allclose(counts[2:], exp_counts[2:], atol=1e-4)
+        np.testing.assert_allclose(counts[:2].sum(),
+                                   scale * exp_counts[:2].sum(), atol=1e-3)
+        assert (counts >= -1e-4).all()
 
 
 def test_block_divisibility_enforced():
-    pts, mask, cents = _problem(n=500)
+    pts, cents, _ = _problem(n=500, n_pad=3)
     with pytest.raises(ValueError):
-        kmeans_assign_reduce(pts, mask, cents, block_n=128, interpret=True)
+        kmeans_update_stats(pts, cents, block_n=128, interpret=True)
 
 
-def test_supported_budget():
+def test_bad_tie_policy_rejected():
+    pts, cents, _ = _problem()
+    with pytest.raises(ValueError):
+        kmeans_update_stats(pts, cents, block_n=128, tie_policy="nope",
+                            interpret=True)
+
+
+def test_supported_budget_and_block_pick():
     assert supported(64, 256)
     assert not supported(4096, 8192)
+    assert pick_block_n(1_048_576, 64, 256) == 8192
+    assert pick_block_n(640, 16, 8) == 128
+    assert pick_block_n(100, 16, 8) is None
