@@ -121,8 +121,12 @@ class NaiveBayes(NaiveBayesParams, Estimator[NaiveBayesModel]):
 
         theta_num = counts + smoothing
         theta_den = counts.sum(axis=1, keepdims=True) + smoothing * n_features
-        log_theta = np.log(theta_num) - np.log(theta_den)
-        log_prior = np.log(class_counts) - np.log(class_counts.sum())
+        with np.errstate(divide="ignore"):
+            # smoothing=0 legitimately yields log(0) = -inf: an unseen
+            # feature/class pair has exactly zero likelihood, and -inf scores
+            # propagate correctly through the argmax (tested).
+            log_theta = np.log(theta_num) - np.log(theta_den)
+            log_prior = np.log(class_counts) - np.log(class_counts.sum())
 
         model = NaiveBayesModel()
         model.copy_params_from(self)

@@ -1,1 +1,2 @@
+from .als import ALS, ALSModel, ALSModelParams, ALSParams  # noqa: F401
 from .widedeep import WideDeep, WideDeepModel, WideDeepParams  # noqa: F401

@@ -1,4 +1,8 @@
 from .kmeans_pallas import (  # noqa: F401
     kmeans_assign_reduce,
     kmeans_update_stats,
+    pad_correction,
+    pick_block_n,
+    supported,
+    update_stats_sharded,
 )

@@ -231,14 +231,27 @@ def pad_correction(counts: jnp.ndarray, centroids: jnp.ndarray,
     landed on the centroid(s) with the smallest norm, added nothing to
     ``sums``, and ``n_pad`` to those clusters' counts.
 
-    Mirrors the kernel's own tie handling so the fix stays exact even when
-    several centroids tie for minimal norm (e.g. duplicated init centroids):
-    "fast" counted the padding fully on *every* tied centroid, "split"
-    fractionally across them."""
+    ``tie_policy`` must name the policy of the kernel that produced
+    ``counts``, so the fix stays exact even when several centroids tie for
+    minimal norm (e.g. duplicated init centroids):
+
+    - ``"fast"``   — :func:`kmeans_update_stats` counted padding fully on
+      *every* tied centroid
+    - ``"split"``  — fractionally across the tied centroids
+    - ``"argmin"`` — :func:`kmeans_assign_reduce` counted it on the first
+      tied index only (first-index argmin semantics)
+    """
     c2 = jnp.sum(centroids * centroids, axis=1)
-    tied = (c2 <= jnp.min(c2)).astype(counts.dtype)
-    if tie_policy == "split":
-        tied = tied / jnp.sum(tied)
+    if tie_policy == "argmin":
+        tied = jax.nn.one_hot(jnp.argmin(c2), counts.shape[0],
+                              dtype=counts.dtype)
+    elif tie_policy in ("fast", "split"):
+        tied = (c2 <= jnp.min(c2)).astype(counts.dtype)
+        if tie_policy == "split":
+            tied = tied / jnp.sum(tied)
+    else:
+        raise ValueError(f"tie_policy must be 'fast', 'split' or 'argmin', "
+                         f"got {tie_policy!r}")
     return counts - n_pad * tied
 
 

@@ -30,7 +30,7 @@ def pad_rows_with_mask(arr, multiple: int,
     if remainder == 0 or n == 0:
         return arr, mask
     pad = multiple - remainder
-    if fill == "zero" or n == 0:
+    if fill == "zero":
         filler = np.zeros((pad,) + arr.shape[1:], dtype=arr.dtype)
     else:
         filler = np.repeat(arr[:1], pad, axis=0)

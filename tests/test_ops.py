@@ -72,7 +72,7 @@ def test_assign_reduce_matches_oracle():
     pts, cents, n_pad = _problem()
     assign, sums, counts = kmeans_assign_reduce(pts, cents, block_n=128,
                                                 interpret=True)
-    counts = pad_correction(counts, cents, n_pad)
+    counts = pad_correction(counts, cents, n_pad, tie_policy="argmin")
     exp_assign, exp_sums, exp_counts = _oracle(pts, cents, n_pad)
     np.testing.assert_array_equal(np.asarray(assign)[: 512 - n_pad],
                                   exp_assign)
@@ -141,6 +141,15 @@ def test_pad_correction_exact_under_min_norm_ties():
         np.testing.assert_allclose(counts[:2].sum(),
                                    scale * exp_counts[:2].sum(), atol=1e-3)
         assert (counts >= -1e-4).all()
+    # argmin kernel under the same min-norm tie: correction must subtract
+    # from the FIRST tied index only (regression: 'fast' correction after
+    # the argmin kernel drove counts negative)
+    _, _, counts = kmeans_assign_reduce(jnp.asarray(pts), cents, block_n=128,
+                                        interpret=True)
+    counts = np.asarray(pad_correction(counts, cents, n_pad,
+                                       tie_policy="argmin"))
+    np.testing.assert_allclose(counts[2:], exp_counts[2:], atol=1e-4)
+    assert (counts >= -1e-4).all()
 
 
 def test_block_divisibility_enforced():
