@@ -5,3 +5,8 @@ from .online_logisticregression import (  # noqa: F401
     OnlineLogisticRegression,
     OnlineLogisticRegressionModel,
 )
+from .softmaxregression import (  # noqa: F401
+    SoftmaxRegression,
+    SoftmaxRegressionModel,
+)
+from .knn import KNNClassifier, KNNClassifierModel  # noqa: F401

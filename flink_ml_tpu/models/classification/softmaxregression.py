@@ -1,0 +1,166 @@
+"""SoftmaxRegression — multinomial logistic regression.
+
+Part of the Flink ML 2.x library line (the reference snapshot ships only
+KMeans; its binary LogisticRegression sibling here generalizes to K classes).
+Reuses the fused mini-batch SGD core (``models/common/sgd.py``) verbatim:
+the scores are one MXU matmul ``X @ W + b`` with ``W`` a (features, classes)
+matrix, the loss is weighted cross-entropy, the gradient psum over the mesh's
+data axis is inserted by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import Estimator, Model
+from ...data.table import Table
+from ...linalg import stack_vectors
+from ...models.common.losses import _weighted_mean
+from ...models.common.sgd import SGDConfig, sgd_fit_params
+from ...params.shared import (
+    HasFeaturesCol,
+    HasGlobalBatchSize,
+    HasLabelCol,
+    HasLearningRate,
+    HasMaxIter,
+    HasPredictionCol,
+    HasRawPredictionCol,
+    HasRegParam,
+    HasSeed,
+    HasTol,
+    HasWeightCol,
+)
+from ...utils import persist
+
+__all__ = ["SoftmaxRegression", "SoftmaxRegressionModel"]
+
+
+def softmax_xent_loss(scores, labels, weights):
+    """Weighted cross-entropy; ``labels`` arrive as f32 class ids (the SGD
+    epoch tensor's dtype) and are cast back to indices here."""
+    logp = jax.nn.log_softmax(scores, axis=-1)
+    idx = labels.astype(jnp.int32)
+    nll = -jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+    return _weighted_mean(nll, weights)
+
+
+class SoftmaxRegressionModelParams(HasFeaturesCol, HasPredictionCol,
+                                   HasRawPredictionCol):
+    pass
+
+
+class SoftmaxRegressionParams(SoftmaxRegressionModelParams, HasLabelCol,
+                              HasWeightCol, HasMaxIter, HasLearningRate,
+                              HasRegParam, HasGlobalBatchSize, HasTol,
+                              HasSeed):
+    pass
+
+
+@jax.jit
+def _jit_probs(X, W, b):
+    return jax.nn.softmax(X @ W + b, axis=-1)
+
+
+class SoftmaxRegressionModel(SoftmaxRegressionModelParams, Model):
+    """Prediction = original label value of the argmax class; the raw
+    prediction column holds the full per-class probability vectors."""
+
+    def __init__(self):
+        super().__init__()
+        self._weights: Optional[np.ndarray] = None   # (features, classes)
+        self._bias: Optional[np.ndarray] = None      # (classes,)
+        self._labels: Optional[np.ndarray] = None    # original label values
+
+    def set_model_data(self, *inputs) -> "SoftmaxRegressionModel":
+        (t,) = inputs
+        self._weights = np.asarray(t["coefficients"][0], np.float64)
+        self._bias = np.asarray(t["intercepts"][0], np.float64)
+        self._labels = np.asarray(t["labels"][0])
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require_model()
+        return [Table({"coefficients": self._weights[None],
+                       "intercepts": self._bias[None],
+                       "labels": self._labels[None]})]
+
+    def _require_model(self) -> None:
+        if self._weights is None:
+            raise RuntimeError(
+                "SoftmaxRegressionModel has no model data; call "
+                "set_model_data() or fit a SoftmaxRegression first")
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        self._require_model()
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float32)
+        probs = np.asarray(_jit_probs(
+            jnp.asarray(X), jnp.asarray(self._weights, jnp.float32),
+            jnp.asarray(self._bias, jnp.float32)))
+        pred = self._labels[np.argmax(probs, axis=1)]
+        out = table.with_column(self.get_prediction_col(), pred)
+        return [out.with_column(self.get_raw_prediction_col(),
+                                probs.astype(np.float64))]
+
+    def save(self, path: str) -> None:
+        self._require_model()
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(path, "model", {
+            "coefficients": self._weights, "intercepts": self._bias,
+            "labels": self._labels})
+
+    @classmethod
+    def load(cls, path: str) -> "SoftmaxRegressionModel":
+        model = persist.load_stage_param(path)
+        data = persist.load_model_arrays(path, "model")
+        model._weights = data["coefficients"].astype(np.float64)
+        model._bias = data["intercepts"].astype(np.float64)
+        model._labels = data["labels"]
+        return model
+
+
+class SoftmaxRegression(SoftmaxRegressionParams,
+                        Estimator[SoftmaxRegressionModel]):
+    def fit(self, *inputs) -> SoftmaxRegressionModel:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float32)
+        y_raw = np.asarray(table[self.get_label_col()])
+        labels, y = np.unique(y_raw, return_inverse=True)
+        if len(labels) < 2:
+            raise ValueError("SoftmaxRegression requires >= 2 distinct "
+                             f"label values, got {len(labels)}")
+        sample_w = (np.asarray(table[self.get_weight_col()], np.float64)
+                    if self.get_weight_col() else None)
+
+        d, c = X.shape[1], len(labels)
+        config = SGDConfig(
+            learning_rate=self.get_learning_rate(),
+            reg=self.get_reg(),
+            global_batch_size=self.get_global_batch_size(),
+            max_epochs=self.get_max_iter(),
+            tol=self.get_tol(),
+            seed=self.get_seed(),
+        )
+        params, _ = sgd_fit_params(
+            softmax_xent_loss, X, y.astype(np.float64), sample_w, config,
+            init_params={"w": jnp.zeros((d, c), jnp.float32),
+                         "b": jnp.zeros((c,), jnp.float32)})
+
+        model = SoftmaxRegressionModel()
+        model.copy_params_from(self)
+        model.set_model_data(Table({
+            "coefficients": np.asarray(params["w"], np.float64)[None],
+            "intercepts": np.asarray(params["b"], np.float64)[None],
+            "labels": labels[None]}))
+        return model
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "SoftmaxRegression":
+        return persist.load_stage_param(path)

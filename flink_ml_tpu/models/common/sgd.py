@@ -27,8 +27,9 @@ from ...data.prefetch import prefetch_to_device
 from ...iteration import IterationBodyResult, IterationConfig, iterate
 from ...parallel.mesh import default_mesh, replicate
 
-__all__ = ["SGDConfig", "sgd_fit", "sgd_fit_outofcore", "LinearState",
-           "plan_epoch_layout", "prepare_epoch_tensor"]
+__all__ = ["SGDConfig", "sgd_fit", "sgd_fit_params",
+           "sgd_fit_outofcore", "LinearState", "plan_epoch_layout",
+           "prepare_epoch_tensor"]
 
 LossFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
 
@@ -85,9 +86,26 @@ def sgd_fit(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
     ``reg * ((1-alpha)/2 ||w||^2 + alpha ||w||_1)`` with the l1 part applied
     via proximal soft-thresholding after each step.
     """
+    d = features.shape[1]
+    init_params = {"w": jnp.zeros((d,), jnp.float32),
+                   "b": jnp.zeros((), jnp.float32)}
+    params, loss_log = sgd_fit_params(loss_fn, features, labels, weights,
+                                      config, mesh, init_params=init_params)
+    return LinearState(np.asarray(params["w"], np.float64),
+                       float(params["b"])), loss_log
+
+
+def sgd_fit_params(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
+                   weights: Optional[np.ndarray], config: SGDConfig,
+                   mesh=None, *, init_params) -> Tuple[dict, list]:
+    """Generic core behind :func:`sgd_fit`: trains any ``{"w", "b"}`` param
+    pytree whose score is ``x @ w + b`` (vector w for the binary/regression
+    family, a (d, classes) matrix for softmax).  ``loss_fn(scores, labels,
+    weights)`` defines the objective; labels ride the epoch tensor as f32
+    (exact for class ids < 2^24 — cast back inside the loss)."""
     mesh = mesh or default_mesh()
     n_dev = int(mesh.shape["data"])
-    n, d = features.shape
+    n = features.shape[0]
     steps, batch, perm = plan_epoch_layout(
         n, config.global_batch_size, n_dev, config.seed)
 
@@ -124,10 +142,8 @@ def sgd_fit(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
         return IterationBodyResult(
             feedback=(params, epoch_loss, loss_log), termination=termination)
 
-    init_params = replicate(
-        {"w": jnp.zeros((d,), jnp.float32), "b": jnp.zeros((), jnp.float32)},
-        mesh)
-    init_state = (init_params, jnp.asarray(jnp.inf, jnp.float32),
+    init_state = (replicate(init_params, mesh),
+                  jnp.asarray(jnp.inf, jnp.float32),
                   jnp.full((config.max_epochs,), jnp.nan, jnp.float32))
 
     result = iterate(
@@ -138,8 +154,7 @@ def sgd_fit(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
     params, _final_loss, loss_buf = result.state
     params = jax.device_get(params)
     loss_log = list(np.asarray(jax.device_get(loss_buf))[:result.num_epochs])
-    return LinearState(np.asarray(params["w"], np.float64),
-                       float(params["b"])), loss_log
+    return params, loss_log
 
 
 def _linear_update(loss_fn: LossFn, config: SGDConfig):

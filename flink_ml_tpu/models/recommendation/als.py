@@ -143,7 +143,10 @@ def _normal_equations(factors, group_idx, other_idx, ratings, weights,
             conf_m1 = alpha * jnp.abs(r) * w              # c - 1, weighted
             A = A.at[g].add(conf_m1[:, None, None]
                             * y[:, :, None] * y[:, None, :])
-            b = b.at[g].add(((1.0 + conf_m1) * w)[:, None] * y)
+            # weighted Hu/Koren b-term: w * (1 + alpha|r|) * y = (w + conf_m1)
+            # * y — NOT (1 + conf_m1) * w, which would square fractional
+            # weights relative to the A term above.
+            b = b.at[g].add((w + conf_m1)[:, None] * y)
         else:
             A = A.at[g].add(w[:, None, None] * y[:, :, None] * y[:, None, :])
             b = b.at[g].add((w * r)[:, None] * y)

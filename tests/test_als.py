@@ -160,3 +160,26 @@ def test_empty_ratings_rejected():
                    "rating": np.asarray([], np.float64)})
     with pytest.raises(ValueError, match="at least one rating"):
         ALS().fit(table)
+
+
+def test_implicit_fractional_weights_consistent():
+    # The implicit normal equations must weight A and b consistently:
+    # duplicating a rating must equal doubling its weight.
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.models.recommendation.als import _solve_side
+
+    rng = np.random.default_rng(0)
+    V = jnp.asarray(rng.normal(size=(3, 2)).astype(np.float32))
+    prev = jnp.asarray(np.zeros((2, 2), np.float32))
+    u = jnp.asarray([0, 0, 1], jnp.int32)
+    i = jnp.asarray([0, 1, 2], jnp.int32)
+    r = jnp.asarray([1.0, 2.0, 1.5], jnp.float32)
+    dup = _solve_side(prev, V, jnp.concatenate([u, u[:1]]),
+                      jnp.concatenate([i, i[:1]]),
+                      jnp.concatenate([r, r[:1]]),
+                      jnp.ones(4, jnp.float32), 2, 0.1, True, 2.0)
+    wt = _solve_side(prev, V, u, i, r,
+                     jnp.asarray([2.0, 1.0, 1.0], jnp.float32), 2, 0.1,
+                     True, 2.0)
+    np.testing.assert_allclose(np.asarray(dup), np.asarray(wt), atol=1e-5)

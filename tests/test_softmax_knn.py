@@ -1,0 +1,151 @@
+"""SoftmaxRegression (multinomial LR) and KNNClassifier tests."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.classification import (
+    KNNClassifier,
+    KNNClassifierModel,
+    SoftmaxRegression,
+    SoftmaxRegressionModel,
+)
+
+
+def _three_blobs(n_per=60, d=5, seed=0, scale=4.0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=scale, size=(3, d))
+    X = np.concatenate([centers[i] + rng.normal(size=(n_per, d))
+                        for i in range(3)]).astype(np.float32)
+    y = np.repeat([10, 20, 30], n_per)  # non-contiguous label values
+    return Table({"features": X, "label": y}), X, y
+
+
+# ---------------------------------------------------------------- softmax --
+
+def test_softmax_learns_three_classes():
+    table, X, y = _three_blobs()
+    model = (SoftmaxRegression().set_max_iter(60).set_learning_rate(0.3)
+             .set_global_batch_size(64).set_seed(0).fit(table))
+    out = model.transform(table)[0]
+    pred = np.asarray(out["prediction"])
+    assert (pred == y).mean() > 0.95
+    probs = np.asarray(out["rawPrediction"])
+    assert probs.shape == (len(y), 3)
+    np.testing.assert_allclose(probs.sum(1), 1.0, atol=1e-5)
+    # prediction = argmax of the raw probabilities, mapped to label values
+    np.testing.assert_array_equal(np.array([10, 20, 30])[probs.argmax(1)],
+                                  pred)
+
+
+def test_softmax_single_class_rejected():
+    table = Table({"features": np.zeros((4, 2), np.float32),
+                   "label": np.ones(4)})
+    with pytest.raises(ValueError, match="distinct label"):
+        SoftmaxRegression().fit(table)
+
+
+def test_softmax_save_load_round_trip(tmp_path):
+    table, X, y = _three_blobs(n_per=30)
+    model = SoftmaxRegression().set_max_iter(20).fit(table)
+    p1 = np.asarray(model.transform(table)[0]["prediction"])
+    model.save(str(tmp_path / "m"))
+    re = SoftmaxRegressionModel.load(str(tmp_path / "m"))
+    p2 = np.asarray(re.transform(table)[0]["prediction"])
+    np.testing.assert_array_equal(p1, p2)
+
+
+def test_softmax_sample_weights_shift_boundary():
+    # all weight on class 10 rows -> model heavily favors class 10
+    table, X, y = _three_blobs(n_per=20, scale=0.5, seed=3)
+    w = np.where(y == 10, 100.0, 0.01)
+    weighted = Table({"features": X, "label": y, "w": w})
+    model = (SoftmaxRegression().set_weight_col("w").set_max_iter(40)
+             .set_learning_rate(0.5).fit(weighted))
+    pred = np.asarray(model.transform(weighted)[0]["prediction"])
+    assert (pred == 10).mean() > 0.8
+
+
+def test_softmax_binary_agrees_with_logistic_family_shape():
+    table, X, y = _three_blobs()
+    two = Table({"features": X[y != 30], "label": y[y != 30]})
+    model = SoftmaxRegression().set_max_iter(40).fit(two)
+    pred = np.asarray(model.transform(two)[0]["prediction"])
+    assert set(np.unique(pred)) <= {10, 20}
+    assert (pred == np.asarray(two["label"])).mean() > 0.95
+
+
+# -------------------------------------------------------------------- knn --
+
+def test_knn_classifies_blobs():
+    table, X, y = _three_blobs()
+    model = KNNClassifier().set_k(5).fit(table)
+    pred = np.asarray(model.transform(table)[0]["prediction"])
+    assert (pred == y).mean() > 0.95
+
+
+def test_knn_k1_memorizes_training_set():
+    table, X, y = _three_blobs(n_per=25)
+    model = KNNClassifier().set_k(1).fit(table)
+    pred = np.asarray(model.transform(table)[0]["prediction"])
+    np.testing.assert_array_equal(pred, y)
+
+
+def test_knn_k_larger_than_train_clamped():
+    table = Table({"features": np.asarray([[0.0], [1.0], [1.1]], np.float32),
+                   "label": np.asarray([0, 1, 1])})
+    model = KNNClassifier().set_k(100).fit(table)
+    pred = np.asarray(model.transform(Table(
+        {"features": np.asarray([[0.9]], np.float32)}))[0]["prediction"])
+    assert pred[0] == 1  # majority of the whole (clamped) train set
+
+
+def test_knn_chunking_boundary():
+    # query count not a multiple of the chunk: padded rows must be dropped
+    from flink_ml_tpu.models.classification import knn as knn_mod
+    old = knn_mod._QUERY_CHUNK
+    knn_mod._QUERY_CHUNK = 8
+    try:
+        table, X, y = _three_blobs(n_per=7)  # 21 rows: 2 chunks + remainder
+        model = KNNClassifier().set_k(3).fit(table)
+        pred = np.asarray(model.transform(table)[0]["prediction"])
+        assert len(pred) == 21
+        assert (pred == y).mean() > 0.9
+    finally:
+        knn_mod._QUERY_CHUNK = old
+
+
+def test_knn_save_load_round_trip(tmp_path):
+    table, X, y = _three_blobs(n_per=10)
+    model = KNNClassifier().set_k(3).fit(table)
+    p1 = np.asarray(model.transform(table)[0]["prediction"])
+    model.save(str(tmp_path / "m"))
+    re = KNNClassifierModel.load(str(tmp_path / "m"))
+    p2 = np.asarray(re.transform(table)[0]["prediction"])
+    np.testing.assert_array_equal(p1, p2)
+    assert re.get_k() == 3
+
+
+def test_knn_model_data_round_trip():
+    table, X, y = _three_blobs(n_per=5)
+    model = KNNClassifier().set_k(3).fit(table)
+    rebuilt = KNNClassifierModel().set_model_data(*model.get_model_data())
+    rebuilt.copy_params_from(model)
+    p1 = np.asarray(model.transform(table)[0]["prediction"])
+    p2 = np.asarray(rebuilt.transform(table)[0]["prediction"])
+    np.testing.assert_array_equal(p1, p2)
+
+
+def test_knn_manhattan_metric():
+    table, X, y = _three_blobs()
+    model = (KNNClassifier().set_distance_measure("manhattan").set_k(5)
+             .fit(table))
+    pred = np.asarray(model.transform(table)[0]["prediction"])
+    assert (pred == y).mean() > 0.9
+
+
+def test_knn_empty_train_rejected():
+    table = Table({"features": np.zeros((0, 2), np.float32),
+                   "label": np.zeros((0,))})
+    with pytest.raises(ValueError):
+        KNNClassifier().fit(table)
