@@ -11,3 +11,11 @@ from .scalers import (  # noqa: F401
     StandardScaler,
     StandardScalerModel,
 )
+from .transforms import (  # noqa: F401
+    Binarizer,
+    Bucketizer,
+    Imputer,
+    ImputerModel,
+    Normalizer,
+    PolynomialExpansion,
+)

@@ -1,0 +1,282 @@
+"""Stateless-ish feature transformers: Bucketizer, Binarizer, Normalizer,
+PolynomialExpansion, and the fitted Imputer.
+
+All are members of the Flink ML 2.x feature-engineering surface (the
+reference snapshot ships no feature transformers — its lib is KMeans only —
+but the library line includes them; SURVEY §2.8 frames the lib module as
+"the algorithm library").  Pure AlgoOperator-style Transformers do their
+work in one jitted vector op; Imputer is an Estimator (it learns the fill
+statistics).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import Estimator, Model, Transformer
+from ...data.table import Table
+from ...linalg import stack_vectors
+from ...params.param import (
+    DoubleArrayParam,
+    FloatParam,
+    IntParam,
+    ParamValidators,
+    StringParam,
+)
+from ...params.shared import HasFeaturesCol, HasOutputCol
+from ...utils import persist
+
+__all__ = [
+    "Binarizer",
+    "Bucketizer",
+    "Imputer",
+    "ImputerModel",
+    "Normalizer",
+    "PolynomialExpansion",
+]
+
+
+class _InOutParams(HasFeaturesCol, HasOutputCol):
+    pass
+
+
+class _SimpleTransformer(_InOutParams, Transformer):
+    """Shared save/load + column plumbing for the stateless transformers."""
+
+    def _apply(self, X: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float32)
+        return [table.with_column(self.get_output_col(), self._apply(X))]
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+
+    @classmethod
+    def load(cls, path: str):
+        return persist.load_stage_param(path)
+
+
+class Binarizer(_SimpleTransformer):
+    """x -> 1.0 if x > threshold else 0.0, elementwise."""
+
+    THRESHOLD = FloatParam("threshold", "Binarization threshold.",
+                           default=0.0)
+
+    def get_threshold(self) -> float:
+        return self.get(Binarizer.THRESHOLD)
+
+    def set_threshold(self, value: float):
+        return self.set(Binarizer.THRESHOLD, value)
+
+    def _apply(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray(_binarize(jnp.asarray(X), self.get_threshold()))
+
+
+@jax.jit
+def _binarize(X, threshold):
+    return (X > threshold).astype(jnp.float32)
+
+
+class Bucketizer(_SimpleTransformer):
+    """Map each value to the index of its half-open split interval
+    ``[splits[i], splits[i+1])``; values outside the outer splits clip into
+    the first/last bucket.  One ``searchsorted`` per column batch."""
+
+    SPLITS = DoubleArrayParam(
+        "splits", "Strictly increasing bucket boundaries (>= 3 values).",
+        default=None, validator=ParamValidators.not_null())
+
+    def get_splits(self):
+        return self.get(Bucketizer.SPLITS)
+
+    def set_splits(self, *values: float):
+        vals = values[0] if len(values) == 1 and not np.isscalar(values[0]) \
+            else values
+        return self.set(Bucketizer.SPLITS, tuple(float(v) for v in vals))
+
+    def _apply(self, X: np.ndarray) -> np.ndarray:
+        splits = np.asarray(self.get_splits(), np.float64)
+        if len(splits) < 3:
+            raise ValueError("Bucketizer needs >= 3 split values "
+                             f"(got {len(splits)})")
+        if not np.all(np.diff(splits) > 0):
+            raise ValueError("Bucketizer splits must be strictly increasing")
+        idx = np.searchsorted(splits, X, side="right") - 1
+        return np.clip(idx, 0, len(splits) - 2).astype(np.float64)
+
+
+class Normalizer(_SimpleTransformer):
+    """Scale each row to unit p-norm."""
+
+    P = FloatParam("p", "Norm order.", default=2.0,
+                   validator=ParamValidators.gt_eq(1.0))
+
+    def get_p(self) -> float:
+        return self.get(Normalizer.P)
+
+    def set_p(self, value: float):
+        return self.set(Normalizer.P, value)
+
+    def _apply(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray(_normalize(jnp.asarray(X), self.get_p()))
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _normalize(X, p):
+    # |x|**inf over/underflows into a constant 1.0 norm, so inf-norm needs
+    # its own branch (p is a static python float here).
+    if np.isinf(p):
+        norm = jnp.max(jnp.abs(X), axis=-1, keepdims=True)
+    else:
+        norm = jnp.sum(jnp.abs(X) ** p, axis=-1, keepdims=True) ** (1.0 / p)
+    return X / jnp.maximum(norm, 1e-12)
+
+
+class PolynomialExpansion(_SimpleTransformer):
+    """Expand features into all monomials up to ``degree`` (without the
+    constant term), depth-first by variable index: for (x, y), degree 2 ->
+    [x, x^2, xy, y, y^2]."""
+
+    DEGREE = IntParam("degree", "Polynomial degree.", default=2,
+                      validator=ParamValidators.gt_eq(1))
+
+    def get_degree(self) -> int:
+        return self.get(PolynomialExpansion.DEGREE)
+
+    def set_degree(self, value: int):
+        return self.set(PolynomialExpansion.DEGREE, value)
+
+    def _apply(self, X: np.ndarray) -> np.ndarray:
+        degree = self.get_degree()
+        d = X.shape[1]
+        exponents: List[np.ndarray] = []
+
+        def expand(prefix, remaining, start):
+            for j in range(start, d):
+                e = prefix.copy()
+                e[j] += 1
+                exponents.append(e.copy())
+                if remaining > 1:
+                    expand(e, remaining - 1, j)
+
+        expand(np.zeros(d, np.int64), degree, 0)
+        expo = np.stack(exponents)                      # (n_terms, d)
+        return np.asarray(_poly_apply(jnp.asarray(X),
+                                      jnp.asarray(expo, jnp.float32)))
+
+
+@jax.jit
+def _poly_apply(X, expo):
+    # (n, 1, d) ** (terms, d) -> product over d: one fused power/reduce
+    return jnp.prod(X[:, None, :] ** expo[None, :, :], axis=-1)
+
+
+class ImputerParams(_InOutParams):
+    STRATEGY = StringParam(
+        "strategy", "Fill statistic.", default="mean",
+        validator=ParamValidators.in_array(["mean", "median", "most_frequent"]))
+    MISSING_VALUE = FloatParam(
+        "missingValue", "Placeholder for missing entries (NaN always counts "
+        "as missing).", default=float("nan"))
+
+    def get_strategy(self) -> str:
+        return self.get(ImputerParams.STRATEGY)
+
+    def set_strategy(self, value: str):
+        return self.set(ImputerParams.STRATEGY, value)
+
+    def get_missing_value(self) -> float:
+        return self.get(ImputerParams.MISSING_VALUE)
+
+    def set_missing_value(self, value: float):
+        return self.set(ImputerParams.MISSING_VALUE, value)
+
+
+def _missing_mask(X: np.ndarray, missing: float) -> np.ndarray:
+    mask = np.isnan(X)
+    if not np.isnan(missing):
+        mask |= X == missing
+    return mask
+
+
+class ImputerModel(ImputerParams, Model):
+    def __init__(self):
+        super().__init__()
+        self._fill: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs) -> "ImputerModel":
+        (t,) = inputs
+        self._fill = np.asarray(t["fill"][0], np.float64)
+        return self
+
+    def _require_model(self) -> None:
+        if self._fill is None:
+            raise RuntimeError("ImputerModel has no model data; call "
+                               "set_model_data() or fit an Imputer first")
+
+    def get_model_data(self) -> List[Table]:
+        self._require_model()
+        return [Table({"fill": self._fill[None]})]
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        self._require_model()
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
+        mask = _missing_mask(X, self.get_missing_value())
+        out = np.where(mask, self._fill[None, :], X)
+        return [table.with_column(self.get_output_col(), out)]
+
+    def save(self, path: str) -> None:
+        self._require_model()
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(path, "model", {"fill": self._fill})
+
+    @classmethod
+    def load(cls, path: str) -> "ImputerModel":
+        model = persist.load_stage_param(path)
+        model._fill = persist.load_model_arrays(
+            path, "model")["fill"].astype(np.float64)
+        return model
+
+
+class Imputer(ImputerParams, Estimator[ImputerModel]):
+    def fit(self, *inputs) -> ImputerModel:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
+        mask = _missing_mask(X, self.get_missing_value())
+        masked = np.ma.masked_array(X, mask)
+        strategy = self.get_strategy()
+        if strategy == "mean":
+            fill = masked.mean(axis=0)
+        elif strategy == "median":
+            fill = np.ma.median(masked, axis=0)
+        else:  # most_frequent
+            fill = np.empty(X.shape[1])
+            for j in range(X.shape[1]):
+                col = X[~mask[:, j], j]
+                if len(col) == 0:
+                    fill[j] = 0.0
+                    continue
+                vals, counts = np.unique(col, return_counts=True)
+                fill[j] = vals[np.argmax(counts)]
+        fill = np.asarray(np.ma.filled(fill, 0.0), np.float64)
+
+        model = ImputerModel()
+        model.copy_params_from(self)
+        model._fill = fill
+        return model
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "Imputer":
+        return persist.load_stage_param(path)

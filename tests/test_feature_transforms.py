@@ -1,0 +1,142 @@
+"""Bucketizer / Binarizer / Normalizer / PolynomialExpansion / Imputer."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature import (
+    Binarizer,
+    Bucketizer,
+    Imputer,
+    ImputerModel,
+    Normalizer,
+    PolynomialExpansion,
+)
+
+
+def _t(X):
+    return Table({"features": np.asarray(X, np.float64)})
+
+
+def test_binarizer():
+    out = (Binarizer().set_threshold(0.5)
+           .transform(_t([[0.2, 0.6], [0.5, 1.0]]))[0])
+    np.testing.assert_array_equal(np.asarray(out["output"]),
+                                  [[0.0, 1.0], [0.0, 1.0]])
+
+
+def test_bucketizer_boundaries_and_clipping():
+    b = Bucketizer().set_splits(0.0, 1.0, 2.0, 3.0)
+    out = b.transform(_t([[-5.0, 0.0], [0.99, 1.0], [2.5, 99.0]]))[0]
+    np.testing.assert_array_equal(np.asarray(out["output"]),
+                                  [[0, 0], [0, 1], [2, 2]])
+
+
+def test_bucketizer_validates_splits():
+    with pytest.raises(ValueError, match="increasing"):
+        Bucketizer().set_splits(0.0, 2.0, 1.0).transform(_t([[0.5]]))
+    with pytest.raises(ValueError, match=">= 3"):
+        Bucketizer().set_splits(0.0, 1.0).transform(_t([[0.5]]))
+
+
+def test_normalizer_l2_and_l1():
+    X = [[3.0, 4.0]]
+    out2 = Normalizer().transform(_t(X))[0]
+    np.testing.assert_allclose(np.asarray(out2["output"]), [[0.6, 0.8]],
+                               atol=1e-6)
+    out1 = Normalizer().set_p(1.0).transform(_t(X))[0]
+    np.testing.assert_allclose(np.asarray(out1["output"]),
+                               [[3 / 7, 4 / 7]], atol=1e-6)
+
+
+def test_normalizer_zero_row_stays_finite():
+    out = Normalizer().transform(_t([[0.0, 0.0]]))[0]
+    assert np.isfinite(np.asarray(out["output"])).all()
+
+
+def test_polynomial_expansion_degree2_order():
+    out = (PolynomialExpansion().set_degree(2)
+           .transform(_t([[2.0, 3.0]]))[0])
+    # depth-first by variable index: [x, x^2, xy, y, y^2]
+    np.testing.assert_allclose(np.asarray(out["output"]),
+                               [[2.0, 4.0, 6.0, 3.0, 9.0]], atol=1e-5)
+
+
+def test_polynomial_expansion_degree1_identity():
+    X = [[1.5, -2.0, 0.5]]
+    out = PolynomialExpansion().set_degree(1).transform(_t(X))[0]
+    np.testing.assert_allclose(np.asarray(out["output"]), X, atol=1e-6)
+
+
+def test_imputer_mean_median_mode():
+    X = np.asarray([[1.0, 10.0], [np.nan, 30.0], [3.0, np.nan],
+                    [np.nan, 30.0]])
+    mean = Imputer().fit(_t(X)).transform(_t(X))[0]
+    np.testing.assert_allclose(np.asarray(mean["output"])[:, 0],
+                               [1.0, 2.0, 3.0, 2.0])
+    med = Imputer().set_strategy("median").fit(_t(X)).transform(_t(X))[0]
+    np.testing.assert_allclose(np.asarray(med["output"])[:, 0],
+                               [1.0, 2.0, 3.0, 2.0])
+    mode = (Imputer().set_strategy("most_frequent").fit(_t(X))
+            .transform(_t(X))[0])
+    np.testing.assert_allclose(np.asarray(mode["output"])[:, 1],
+                               [10.0, 30.0, 30.0, 30.0])
+
+
+def test_imputer_custom_missing_value():
+    X = np.asarray([[1.0], [-999.0], [3.0]])
+    model = Imputer().set_missing_value(-999.0).fit(_t(X))
+    out = model.transform(_t(X))[0]
+    np.testing.assert_allclose(np.asarray(out["output"])[:, 0],
+                               [1.0, 2.0, 3.0])
+
+
+def test_imputer_save_load(tmp_path):
+    X = np.asarray([[1.0], [np.nan], [3.0]])
+    model = Imputer().fit(_t(X))
+    model.save(str(tmp_path / "m"))
+    re = ImputerModel.load(str(tmp_path / "m"))
+    out = re.transform(_t(X))[0]
+    np.testing.assert_allclose(np.asarray(out["output"])[:, 0],
+                               [1.0, 2.0, 3.0])
+
+
+def test_transformer_save_load_params(tmp_path):
+    b = Bucketizer().set_splits(0.0, 1.0, 2.0)
+    b.save(str(tmp_path / "b"))
+    re = Bucketizer.load(str(tmp_path / "b"))
+    assert tuple(re.get_splits()) == (0.0, 1.0, 2.0)
+    n = Normalizer().set_p(3.0)
+    n.save(str(tmp_path / "n"))
+    assert Normalizer.load(str(tmp_path / "n")).get_p() == 3.0
+
+
+def test_transforms_compose_in_pipeline(tmp_path):
+    from flink_ml_tpu import Pipeline
+
+    X = np.asarray([[1.0, np.nan], [2.0, 8.0], [np.nan, 4.0]])
+    pipe = Pipeline([
+        Imputer().set_output_col("features"),
+        Normalizer().set_output_col("normed").set_features_col("features"),
+    ])
+    pm = pipe.fit(_t(X))
+    out = pm.transform(_t(X))[0]
+    normed = np.asarray(out["normed"])
+    np.testing.assert_allclose(np.linalg.norm(normed, axis=1), 1.0,
+                               atol=1e-5)
+    pm.save(str(tmp_path / "p"))
+    from flink_ml_tpu.api.pipeline import PipelineModel
+    re = PipelineModel.load(str(tmp_path / "p"))
+    np.testing.assert_allclose(np.asarray(re.transform(_t(X))[0]["normed"]),
+                               normed, atol=1e-6)
+
+
+def test_normalizer_inf_norm():
+    out = Normalizer().set_p(float("inf")).transform(_t([[3.0, -4.0]]))[0]
+    np.testing.assert_allclose(np.asarray(out["output"]), [[0.75, -1.0]],
+                               atol=1e-6)
+
+
+def test_imputer_model_without_data_errors():
+    with pytest.raises(RuntimeError, match="no model data"):
+        ImputerModel().transform(_t([[1.0]]))
