@@ -9,6 +9,7 @@ feedback state, ICI collectives for aggregation.
 """
 
 from .api.stage import AlgoOperator, Estimator, Model, Stage, Transformer
+from .api.graph import Graph, GraphBuilder, GraphModel, TableId
 from .api.pipeline import Pipeline, PipelineModel
 from .data.table import Table
 from .linalg import DenseVector, SparseVector, Vectors
@@ -36,6 +37,7 @@ __version__ = "0.1.0"
 __all__ = [
     "AlgoOperator", "Estimator", "Model", "Stage", "Transformer",
     "Pipeline", "PipelineModel", "Table",
+    "Graph", "GraphBuilder", "GraphModel", "TableId",
     "DenseVector", "SparseVector", "Vectors", "DistanceMeasure",
     "Param", "ParamValidators", "WithParams", "InvalidParamError",
     "BoolParam", "IntParam", "LongParam", "FloatParam", "DoubleParam",

@@ -46,22 +46,19 @@ class _InOutParams(HasFeaturesCol, HasOutputCol):
 
 
 class _SimpleTransformer(_InOutParams, Transformer):
-    """Shared save/load + column plumbing for the stateless transformers."""
+    """Shared column plumbing for the stateless transformers (save/load come
+    from the Stage defaults — params-only persistence).  ``_apply`` receives
+    the raw float64 batch: the host-side index transforms (Bucketizer,
+    Binarizer) must compare at full precision; the jitted ones cast to f32
+    themselves."""
 
     def _apply(self, X: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
     def transform(self, *inputs) -> List[Table]:
         (table,) = inputs
-        X = stack_vectors(table[self.get_features_col()]).astype(np.float32)
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
         return [table.with_column(self.get_output_col(), self._apply(X))]
-
-    def save(self, path: str) -> None:
-        persist.save_metadata(self, path)
-
-    @classmethod
-    def load(cls, path: str):
-        return persist.load_stage_param(path)
 
 
 class Binarizer(_SimpleTransformer):
@@ -77,12 +74,8 @@ class Binarizer(_SimpleTransformer):
         return self.set(Binarizer.THRESHOLD, value)
 
     def _apply(self, X: np.ndarray) -> np.ndarray:
-        return np.asarray(_binarize(jnp.asarray(X), self.get_threshold()))
-
-
-@jax.jit
-def _binarize(X, threshold):
-    return (X > threshold).astype(jnp.float32)
+        # pure host comparison: full float64 precision for the threshold
+        return (X > self.get_threshold()).astype(np.float64)
 
 
 class Bucketizer(_SimpleTransformer):
@@ -126,7 +119,8 @@ class Normalizer(_SimpleTransformer):
         return self.set(Normalizer.P, value)
 
     def _apply(self, X: np.ndarray) -> np.ndarray:
-        return np.asarray(_normalize(jnp.asarray(X), self.get_p()))
+        return np.asarray(_normalize(jnp.asarray(X, jnp.float32),
+                                     self.get_p()))
 
 
 @partial(jax.jit, static_argnums=(1,))
@@ -169,7 +163,7 @@ class PolynomialExpansion(_SimpleTransformer):
 
         expand(np.zeros(d, np.int64), degree, 0)
         expo = np.stack(exponents)                      # (n_terms, d)
-        return np.asarray(_poly_apply(jnp.asarray(X),
+        return np.asarray(_poly_apply(jnp.asarray(X, jnp.float32),
                                       jnp.asarray(expo, jnp.float32)))
 
 
@@ -248,6 +242,8 @@ class ImputerModel(ImputerParams, Model):
 
 
 class Imputer(ImputerParams, Estimator[ImputerModel]):
+    """save/load come from the Stage defaults (params-only persistence)."""
+
     def fit(self, *inputs) -> ImputerModel:
         (table,) = inputs
         X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
@@ -273,10 +269,3 @@ class Imputer(ImputerParams, Estimator[ImputerModel]):
         model.copy_params_from(self)
         model._fill = fill
         return model
-
-    def save(self, path: str) -> None:
-        persist.save_metadata(self, path)
-
-    @classmethod
-    def load(cls, path: str) -> "Imputer":
-        return persist.load_stage_param(path)

@@ -140,3 +140,22 @@ def test_normalizer_inf_norm():
 def test_imputer_model_without_data_errors():
     with pytest.raises(RuntimeError, match="no model data"):
         ImputerModel().transform(_t([[1.0]]))
+
+
+def test_bucketizer_binarizer_float64_precision():
+    # boundaries that are NOT float32-representable must still classify
+    # exactly (regression: a float32 downcast merged 2^24 and 2^24+1)
+    big = 16777217.0  # 2^24 + 1
+    out = (Bucketizer().set_splits(0.0, big, 2 * big)
+           .transform(_t([[16777216.0], [big]]))[0])
+    np.testing.assert_array_equal(np.asarray(out["output"]), [[0], [1]])
+    bout = (Binarizer().set_threshold(16777216.5)
+            .transform(_t([[16777216.0], [big]]))[0])
+    np.testing.assert_array_equal(np.asarray(bout["output"]), [[0.0], [1.0]])
+
+
+def test_cross_class_load_rejected(tmp_path):
+    b = Bucketizer().set_splits(0.0, 1.0, 2.0)
+    b.save(str(tmp_path / "b"))
+    with pytest.raises(IOError):
+        Normalizer.load(str(tmp_path / "b"))
