@@ -5,9 +5,17 @@ from .encoders import (  # noqa: F401
     StringIndexerModel,
     VectorAssembler,
 )
+from .online_scaler import (  # noqa: F401
+    OnlineStandardScaler,
+    OnlineStandardScalerModel,
+)
 from .scalers import (  # noqa: F401
+    MaxAbsScaler,
+    MaxAbsScalerModel,
     MinMaxScaler,
     MinMaxScalerModel,
+    RobustScaler,
+    RobustScalerModel,
     StandardScaler,
     StandardScalerModel,
 )

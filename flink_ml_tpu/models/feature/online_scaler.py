@@ -1,0 +1,106 @@
+"""OnlineStandardScaler — streaming mean/variance over table windows.
+
+The online counterpart of StandardScaler (Flink ML 2.x pairs batch feature
+estimators with online variants, the way OnlineKMeans pairs with KMeans).
+
+Numerics: each window's centered statistics (count, mean, M2) are computed
+on device in f32 — centering first keeps f32 adequate — and merged across
+windows on the host in float64 with Chan's parallel-Welford update.  The
+naive E[x^2] - E[x]^2 route in f32 catastrophically cancels for data with
+large means (std 1 at mean 1e4 underflows to 0), which is exactly the
+regime a streaming scaler exists for.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import Estimator
+from ...data.table import Table
+from ...linalg import stack_vectors
+from ...utils import persist
+from .scalers import StandardScalerModel, StandardScalerParams
+
+__all__ = ["OnlineStandardScaler", "OnlineStandardScalerModel"]
+
+
+@jax.jit
+def _window_stats(X):
+    """Per-window (count, mean, M2) with on-device centering."""
+    mean = jnp.mean(X, axis=0)
+    centered = X - mean
+    return jnp.asarray(X.shape[0], jnp.float32), mean, \
+        jnp.sum(centered * centered, axis=0)
+
+
+def _merge(count, mean, m2, wc, wm, wm2):
+    """Chan's parallel Welford merge, float64 on host."""
+    total = count + wc
+    delta = wm - mean
+    new_mean = mean + delta * (wc / total)
+    new_m2 = m2 + wm2 + delta * delta * (count * wc / total)
+    return total, new_mean, new_m2
+
+
+class OnlineStandardScalerModel(StandardScalerModel):
+    """StandardScalerModel + the model version counter of the streaming
+    fit (persisted, mirroring ``OnlineKMeansModel``)."""
+
+    def __init__(self):
+        super().__init__()
+        self.model_version = 0
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path,
+                              {"modelVersion": self.model_version})
+        persist.save_model_arrays(path, "model",
+                                  {"mean": self._mean, "std": self._std})
+
+    @classmethod
+    def load(cls, path: str) -> "OnlineStandardScalerModel":
+        model = persist.load_stage_param(path)
+        data = persist.load_model_arrays(path, "model")
+        model._mean = data["mean"].astype(np.float64)
+        model._std = data["std"].astype(np.float64)
+        model.model_version = int(
+            persist.load_metadata(path).get("modelVersion", 0))
+        return model
+
+
+class OnlineStandardScaler(StandardScalerParams,
+                           Estimator[OnlineStandardScalerModel]):
+    def fit(self, *inputs) -> OnlineStandardScalerModel:
+        """``fit(stream)``: an iterable of Tables (windows), or one Table
+        (consumed as batches).  Returns when the stream ends."""
+        (source,) = inputs
+        feat = self.get_features_col()
+        batches = iter(source) if not isinstance(source, Table) else iter(
+            source.batches(4096))
+
+        count = 0.0
+        mean = None
+        m2 = None
+        versions = 0
+        for t in batches:
+            X = stack_vectors(t[feat]).astype(np.float32)
+            if len(X) == 0:
+                continue
+            wc, wm, wm2 = (np.asarray(v, np.float64)
+                           for v in _window_stats(jnp.asarray(X)))
+            if mean is None:
+                count, mean, m2 = float(wc), wm, wm2
+            else:
+                count, mean, m2 = _merge(count, mean, m2, float(wc), wm, wm2)
+            versions += 1
+        if mean is None:
+            raise ValueError("OnlineStandardScaler.fit got an empty stream")
+
+        model = OnlineStandardScalerModel()
+        model.copy_params_from(self)
+        model.set_model_data(Table({
+            "mean": mean[None],
+            "std": np.sqrt(np.maximum(m2 / count, 0.0))[None]}))
+        model.model_version = versions
+        return model

@@ -21,7 +21,9 @@ from ...params.shared import HasFeaturesCol, HasOutputCol
 from ...utils import persist
 
 __all__ = ["StandardScaler", "StandardScalerModel",
-           "MinMaxScaler", "MinMaxScalerModel"]
+           "MinMaxScaler", "MinMaxScalerModel",
+           "MaxAbsScaler", "MaxAbsScalerModel",
+           "RobustScaler", "RobustScalerModel"]
 
 
 class _HasOutputCol(HasFeaturesCol, HasOutputCol):
@@ -143,4 +145,117 @@ class MinMaxScaler(MinMaxScalerParams, Estimator[MinMaxScalerModel]):
         model.copy_params_from(self)
         model._data_min = X.min(axis=0)
         model._data_max = X.max(axis=0)
+        return model
+
+
+class MaxAbsScalerModel(_HasOutputCol, Model):
+    """Scale columns into [-1, 1] by the per-column max absolute value
+    (preserves sparsity/sign; Flink ML 2.x feature surface)."""
+
+    def __init__(self):
+        super().__init__()
+        self._max_abs: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs) -> "MaxAbsScalerModel":
+        (t,) = inputs
+        self._max_abs = np.asarray(t["maxAbs"][0], np.float64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [Table({"maxAbs": self._max_abs[None]})]
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()])
+        out = X / np.maximum(self._max_abs, 1e-12)
+        return [table.with_column(self.get_output_col(), out)]
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(path, "model", {"maxAbs": self._max_abs})
+
+    @classmethod
+    def load(cls, path: str) -> "MaxAbsScalerModel":
+        model = persist.load_stage_param(path)
+        model._max_abs = persist.load_model_arrays(
+            path, "model")["maxAbs"].astype(np.float64)
+        return model
+
+
+class MaxAbsScaler(_HasOutputCol, Estimator[MaxAbsScalerModel]):
+    def fit(self, *inputs) -> MaxAbsScalerModel:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()])
+        model = MaxAbsScalerModel()
+        model.copy_params_from(self)
+        model._max_abs = np.abs(X).max(axis=0)
+        return model
+
+
+class RobustScalerParams(_HasOutputCol):
+    LOWER = FloatParam("lower", "Lower quantile of the scaling range.",
+                       default=25.0)
+    UPPER = FloatParam("upper", "Upper quantile of the scaling range.",
+                       default=75.0)
+    WITH_CENTERING = BoolParam("withCentering", "Subtract the median.",
+                               default=True)
+    WITH_SCALING = BoolParam("withScaling", "Divide by the quantile range.",
+                             default=True)
+
+
+class RobustScalerModel(RobustScalerParams, Model):
+    """Median/IQR scaling — outlier-robust standardization."""
+
+    def __init__(self):
+        super().__init__()
+        self._median: Optional[np.ndarray] = None
+        self._range: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs) -> "RobustScalerModel":
+        (t,) = inputs
+        self._median = np.asarray(t["median"][0], np.float64)
+        self._range = np.asarray(t["range"][0], np.float64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [Table({"median": self._median[None],
+                       "range": self._range[None]})]
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
+        if self.get(RobustScalerParams.WITH_CENTERING):
+            X = X - self._median
+        if self.get(RobustScalerParams.WITH_SCALING):
+            X = X / np.maximum(self._range, 1e-12)
+        return [table.with_column(self.get_output_col(), X)]
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(path, "model", {"median": self._median,
+                                                  "range": self._range})
+
+    @classmethod
+    def load(cls, path: str) -> "RobustScalerModel":
+        model = persist.load_stage_param(path)
+        data = persist.load_model_arrays(path, "model")
+        model._median = data["median"].astype(np.float64)
+        model._range = data["range"].astype(np.float64)
+        return model
+
+
+class RobustScaler(RobustScalerParams, Estimator[RobustScalerModel]):
+    def fit(self, *inputs) -> RobustScalerModel:
+        (table,) = inputs
+        lo = self.get(RobustScalerParams.LOWER)
+        hi = self.get(RobustScalerParams.UPPER)
+        if not 0.0 <= lo < hi <= 100.0:
+            raise ValueError(f"need 0 <= lower < upper <= 100, "
+                             f"got ({lo}, {hi})")
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
+        model = RobustScalerModel()
+        model.copy_params_from(self)
+        model._median = np.median(X, axis=0)
+        q_lo, q_hi = np.percentile(X, [lo, hi], axis=0)
+        model._range = q_hi - q_lo
         return model

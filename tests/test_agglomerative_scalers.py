@@ -1,0 +1,202 @@
+"""AgglomerativeClustering + MaxAbs/Robust/OnlineStandard scalers."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.clustering import AgglomerativeClustering
+from flink_ml_tpu.models.feature import (
+    MaxAbsScaler,
+    MaxAbsScalerModel,
+    OnlineStandardScaler,
+    RobustScaler,
+    RobustScalerModel,
+    StandardScaler,
+)
+
+
+def _blobs(n_per=30, seed=0, spread=8.0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=spread, size=(3, 4))
+    X = np.concatenate([centers[i] + rng.normal(size=(n_per, 4), scale=0.5)
+                        for i in range(3)])
+    y = np.repeat([0, 1, 2], n_per)
+    return Table({"features": X}), y
+
+
+def _cluster_sets(labels, y):
+    return {frozenset(np.nonzero(labels == c)[0].tolist())
+            for c in np.unique(labels)} == \
+           {frozenset(np.nonzero(y == c)[0].tolist())
+            for c in np.unique(y)}
+
+
+@pytest.mark.parametrize("linkage", ["ward", "complete", "average", "single"])
+def test_agglomerative_recovers_blobs(linkage):
+    table, y = _blobs()
+    out = (AgglomerativeClustering().set_num_clusters(3)
+           .set_linkage(linkage).transform(table)[0])
+    labels = np.asarray(out["prediction"])
+    assert len(np.unique(labels)) == 3
+    assert _cluster_sets(labels, y)
+
+
+def test_agglomerative_k1_and_kn():
+    table, _ = _blobs(n_per=4)
+    one = (AgglomerativeClustering().set_num_clusters(1)
+           .transform(table)[0])
+    assert set(np.asarray(one["prediction"]).tolist()) == {0}
+    n = len(table)
+    all_sep = (AgglomerativeClustering().set_num_clusters(n)
+               .transform(table)[0])
+    assert len(set(np.asarray(all_sep["prediction"]).tolist())) == n
+
+
+def test_agglomerative_ward_requires_euclidean():
+    table, _ = _blobs(n_per=3)
+    with pytest.raises(ValueError, match="euclidean"):
+        (AgglomerativeClustering().set_distance_measure("manhattan")
+         .transform(table))
+
+
+def test_agglomerative_row_guard():
+    from flink_ml_tpu.models.clustering import agglomerative as agg
+    old = agg._MAX_ROWS
+    agg._MAX_ROWS = 10
+    try:
+        table, _ = _blobs(n_per=30)
+        with pytest.raises(ValueError, match="O\\(n\\^2\\)"):
+            AgglomerativeClustering().transform(table)
+    finally:
+        agg._MAX_ROWS = old
+
+
+def test_agglomerative_labels_ordered_by_first_appearance():
+    X = np.asarray([[0.0], [100.0], [0.1], [100.1]])
+    out = (AgglomerativeClustering().set_num_clusters(2)
+           .transform(Table({"features": X}))[0])
+    np.testing.assert_array_equal(np.asarray(out["prediction"]),
+                                  [0, 1, 0, 1])
+
+
+def test_max_abs_scaler(tmp_path):
+    X = np.asarray([[2.0, -8.0], [-4.0, 4.0]])
+    model = MaxAbsScaler().fit(Table({"features": X}))
+    out = model.transform(Table({"features": X}))[0]
+    np.testing.assert_allclose(np.asarray(out["output"]),
+                               [[0.5, -1.0], [-1.0, 0.5]])
+    model.save(str(tmp_path / "m"))
+    re = MaxAbsScalerModel.load(str(tmp_path / "m"))
+    np.testing.assert_allclose(
+        np.asarray(re.transform(Table({"features": X}))[0]["output"]),
+        np.asarray(out["output"]))
+
+
+def test_robust_scaler_ignores_outliers(tmp_path):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 2))
+    X[:5] = 1e6  # gross outliers
+    model = RobustScaler().fit(Table({"features": X}))
+    out = np.asarray(model.transform(Table({"features": X}))[0]["output"])
+    # inliers stay O(1) despite the outliers
+    assert np.abs(out[5:]).max() < 10.0
+    model.save(str(tmp_path / "m"))
+    re = RobustScalerModel.load(str(tmp_path / "m"))
+    np.testing.assert_allclose(
+        np.asarray(re.transform(Table({"features": X}))[0]["output"]), out)
+
+
+def test_robust_scaler_validates_quantiles():
+    with pytest.raises(ValueError, match="lower < upper"):
+        (RobustScaler().set(RobustScaler.LOWER, 80.0)
+         .set(RobustScaler.UPPER, 20.0)
+         .fit(Table({"features": np.zeros((3, 1))})))
+
+
+def test_online_standard_scaler_matches_batch():
+    rng = np.random.default_rng(1)
+    X = rng.normal(loc=3.0, scale=2.0, size=(1000, 3))
+    batch_model = StandardScaler().fit(Table({"features": X}))
+    windows = [Table({"features": X[i:i + 100]}) for i in range(0, 1000, 100)]
+    online_model = OnlineStandardScaler().fit(iter(windows))
+    t = Table({"features": X[:50]})
+    np.testing.assert_allclose(
+        np.asarray(online_model.transform(t)[0]["output"]),
+        np.asarray(batch_model.transform(t)[0]["output"]), atol=1e-3)
+    assert online_model.model_version == 10
+
+
+def test_online_standard_scaler_empty_stream_rejected():
+    with pytest.raises(ValueError, match="empty stream"):
+        OnlineStandardScaler().fit(iter([]))
+
+
+def test_online_scaler_large_mean_no_cancellation():
+    # regression: f32 E[x^2]-E[x]^2 collapses std to 0 at mean 1e4
+    rng = np.random.default_rng(2)
+    X = rng.normal(loc=1e4, scale=1.0, size=(5000, 2))
+    windows = [Table({"features": X[i:i + 500]}) for i in range(0, 5000, 500)]
+    model = OnlineStandardScaler().fit(iter(windows))
+    std = np.asarray(model.get_model_data()[0]["std"][0])
+    np.testing.assert_allclose(std, 1.0, rtol=0.05)
+
+
+def test_online_scaler_model_version_persists(tmp_path):
+    from flink_ml_tpu.models.feature import OnlineStandardScalerModel
+
+    X = np.random.default_rng(0).normal(size=(100, 2))
+    windows = [Table({"features": X[i:i + 25]}) for i in range(0, 100, 25)]
+    model = OnlineStandardScaler().fit(iter(windows))
+    assert model.model_version == 4
+    model.save(str(tmp_path / "m"))
+    re = OnlineStandardScalerModel.load(str(tmp_path / "m"))
+    assert re.model_version == 4
+    np.testing.assert_allclose(
+        np.asarray(re.transform(Table({"features": X}))[0]["output"]),
+        np.asarray(model.transform(Table({"features": X}))[0]["output"]))
+
+
+def test_agglomerative_k_exceeds_n_rejected():
+    table, _ = _blobs(n_per=2)
+    with pytest.raises(ValueError, match="exceeds"):
+        AgglomerativeClustering().set_num_clusters(100).transform(table)
+
+
+def test_agglomerative_matches_bruteforce_loop():
+    # NN-index maintenance must agree with the O(n^3) reference merge loop
+    from flink_ml_tpu.models.clustering.agglomerative import _merge_loop
+
+    def brute(D, k, linkage):
+        n = D.shape[0]
+        D = D.copy(); np.fill_diagonal(D, np.inf)
+        active = np.ones(n, bool); size = np.ones(n)
+        parent = np.arange(n)
+        for _ in range(n - k):
+            masked = np.where(np.outer(active, active), D, np.inf)
+            np.fill_diagonal(masked, np.inf)
+            i, j = divmod(int(np.argmin(masked)), n)
+            if j < i: i, j = j, i
+            di, dj = D[i], D[j]
+            if linkage == "single": new = np.minimum(di, dj)
+            elif linkage == "complete": new = np.maximum(di, dj)
+            elif linkage == "average":
+                new = (size[i]*di + size[j]*dj) / (size[i]+size[j])
+            else:
+                sk = size; tot = size[i]+size[j]+sk
+                new = ((size[i]+sk)*di + (size[j]+sk)*dj - sk*D[i,j]) / tot
+            new[~active] = np.inf; new[i] = np.inf
+            D[i,:] = new; D[:,i] = new; D[j,:] = np.inf; D[:,j] = np.inf
+            active[j] = False; size[i] += size[j]; parent[j] = i
+        def find(i):
+            while parent[i] != i: i = parent[i]
+            return i
+        roots = np.array([find(i) for i in range(n)])
+        return np.unique(roots, return_inverse=True)[1]
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(40, 3))
+    D = ((X[:, None] - X[None, :]) ** 2).sum(-1)
+    for linkage in ("single", "complete", "average", "ward"):
+        got = _merge_loop(D, 5, linkage)
+        exp = brute(D, 5, linkage)
+        np.testing.assert_array_equal(got, exp, err_msg=linkage)
