@@ -52,6 +52,14 @@ class DistanceMeasure:
         jnp-traceable so they inline into jitted estimator steps."""
         raise NotImplementedError
 
+    # -- host float64 form --------------------------------------------------
+    def pairwise_host64(self, points, centroids) -> np.ndarray:
+        """Full-precision host pairwise matrix.  For consumers whose results
+        are precision-critical (e.g. hierarchical merge ordering): the f32
+        ||x||^2 - 2xy device expansion catastrophically cancels for data far
+        from the origin."""
+        raise NotImplementedError
+
 
 @register_distance_measure("euclidean")
 class EuclideanDistanceMeasure(DistanceMeasure):
@@ -68,6 +76,15 @@ class EuclideanDistanceMeasure(DistanceMeasure):
         sq = jnp.maximum(p2 - 2.0 * cross + c2, 0.0)
         return jnp.sqrt(sq)
 
+    def pairwise_host64(self, points, centroids) -> np.ndarray:
+        p = np.asarray(points, np.float64)
+        c = np.asarray(centroids, np.float64)
+        # same expansion, but f64: cancellation error ~1e-16 relative, fine
+        # for any practical coordinate magnitude
+        sq = ((p * p).sum(1)[:, None] - 2.0 * (p @ c.T)
+              + (c * c).sum(1)[None, :])
+        return np.sqrt(np.maximum(sq, 0.0))
+
 
 @register_distance_measure("cosine")
 class CosineDistanceMeasure(DistanceMeasure):
@@ -76,6 +93,13 @@ class CosineDistanceMeasure(DistanceMeasure):
         cn = centroids / (jnp.linalg.norm(centroids, axis=-1, keepdims=True) + 1e-12)
         return 1.0 - jnp.dot(pn, cn.T, preferred_element_type=jnp.float32)
 
+    def pairwise_host64(self, points, centroids) -> np.ndarray:
+        p = np.asarray(points, np.float64)
+        c = np.asarray(centroids, np.float64)
+        pn = p / (np.linalg.norm(p, axis=-1, keepdims=True) + 1e-12)
+        cn = c / (np.linalg.norm(c, axis=-1, keepdims=True) + 1e-12)
+        return 1.0 - pn @ cn.T
+
 
 @register_distance_measure("manhattan")
 class ManhattanDistanceMeasure(DistanceMeasure):
@@ -83,3 +107,13 @@ class ManhattanDistanceMeasure(DistanceMeasure):
         # (n, 1, d) - (1, k, d) — fine for moderate k; KMeans default metric
         # is euclidean which avoids the broadcast blow-up.
         return jnp.sum(jnp.abs(points[:, None, :] - centroids[None, :, :]), axis=-1)
+
+    def pairwise_host64(self, points, centroids) -> np.ndarray:
+        p = np.asarray(points, np.float64)
+        c = np.asarray(centroids, np.float64)
+        out = np.empty((len(p), len(c)))
+        chunk = max(1, (1 << 24) // max(len(c) * p.shape[1], 1))
+        for s0 in range(0, len(p), chunk):  # bound the (chunk, k, d) temp
+            out[s0:s0 + chunk] = np.abs(
+                p[s0:s0 + chunk, None, :] - c[None, :, :]).sum(-1)
+        return out

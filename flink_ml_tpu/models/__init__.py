@@ -1,6 +1,8 @@
 """Algorithm library — estimators, models, feature stages, evaluators."""
 
 from .classification import (  # noqa: F401
+    KNNClassifier,
+    KNNClassifierModel,
     LinearSVC,
     LinearSVCModel,
     LogisticRegression,
@@ -9,24 +11,43 @@ from .classification import (  # noqa: F401
     NaiveBayesModel,
     OnlineLogisticRegression,
     OnlineLogisticRegressionModel,
+    SoftmaxRegression,
+    SoftmaxRegressionModel,
 )
 from .clustering import (  # noqa: F401
+    AgglomerativeClustering,
     KMeans,
     KMeansModel,
     OnlineKMeans,
     OnlineKMeansModel,
 )
-from .evaluation import BinaryClassificationEvaluator  # noqa: F401
+from .evaluation import (  # noqa: F401
+    BinaryClassificationEvaluator,
+    MulticlassClassificationEvaluator,
+)
 from .feature import (  # noqa: F401
+    Binarizer,
+    Bucketizer,
+    Imputer,
+    ImputerModel,
+    MaxAbsScaler,
+    MaxAbsScalerModel,
     MinMaxScaler,
     MinMaxScalerModel,
+    Normalizer,
     OneHotEncoder,
     OneHotEncoderModel,
+    OnlineStandardScaler,
+    OnlineStandardScalerModel,
+    PolynomialExpansion,
+    RobustScaler,
+    RobustScalerModel,
     StandardScaler,
     StandardScalerModel,
     StringIndexer,
     StringIndexerModel,
     VectorAssembler,
 )
-from .recommendation import WideDeep, WideDeepModel  # noqa: F401
+from .recommendation import ALS, ALSModel, WideDeep, WideDeepModel  # noqa: F401
+from .stats import ChiSqTest  # noqa: F401
 from .regression import LinearRegression, LinearRegressionModel  # noqa: F401

@@ -1,1 +1,4 @@
 from .binary_evaluator import BinaryClassificationEvaluator  # noqa: F401
+from .multiclass_evaluator import (  # noqa: F401
+    MulticlassClassificationEvaluator,
+)

@@ -3,18 +3,17 @@
 The online counterpart of StandardScaler (Flink ML 2.x pairs batch feature
 estimators with online variants, the way OnlineKMeans pairs with KMeans).
 
-Numerics: each window's centered statistics (count, mean, M2) are computed
-on device in f32 — centering first keeps f32 adequate — and merged across
-windows on the host in float64 with Chan's parallel-Welford update.  The
+Numerics: per-window centered statistics (count, mean, M2) merge across
+windows with Chan's parallel-Welford update, all in host float64.  The
 naive E[x^2] - E[x]^2 route in f32 catastrophically cancels for data with
 large means (std 1 at mean 1e4 underflows to 0), which is exactly the
-regime a streaming scaler exists for.
+regime a streaming scaler exists for.  The stats are pure host numpy: a
+mean/M2 pass is PCIe-transfer-bound, and windows vary in length, so a
+jitted version would recompile per distinct window size for no gain.
 """
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ...api.stage import Estimator
@@ -26,13 +25,12 @@ from .scalers import StandardScalerModel, StandardScalerParams
 __all__ = ["OnlineStandardScaler", "OnlineStandardScalerModel"]
 
 
-@jax.jit
-def _window_stats(X):
-    """Per-window (count, mean, M2) with on-device centering."""
-    mean = jnp.mean(X, axis=0)
+def _window_stats(X: np.ndarray):
+    """Per-window (count, mean, M2), centered, float64."""
+    X = np.asarray(X, np.float64)
+    mean = X.mean(axis=0)
     centered = X - mean
-    return jnp.asarray(X.shape[0], jnp.float32), mean, \
-        jnp.sum(centered * centered, axis=0)
+    return float(X.shape[0]), mean, (centered * centered).sum(axis=0)
 
 
 def _merge(count, mean, m2, wc, wm, wm2):
@@ -60,10 +58,9 @@ class OnlineStandardScalerModel(StandardScalerModel):
 
     @classmethod
     def load(cls, path: str) -> "OnlineStandardScalerModel":
-        model = persist.load_stage_param(path)
-        data = persist.load_model_arrays(path, "model")
-        model._mean = data["mean"].astype(np.float64)
-        model._std = data["std"].astype(np.float64)
+        # array restore delegates to the parent (one source of truth for the
+        # on-disk layout); only the version counter is ours
+        model = super().load(path)
         model.model_version = int(
             persist.load_metadata(path).get("modelVersion", 0))
         return model
@@ -84,15 +81,14 @@ class OnlineStandardScaler(StandardScalerParams,
         m2 = None
         versions = 0
         for t in batches:
-            X = stack_vectors(t[feat]).astype(np.float32)
+            X = stack_vectors(t[feat])
             if len(X) == 0:
                 continue
-            wc, wm, wm2 = (np.asarray(v, np.float64)
-                           for v in _window_stats(jnp.asarray(X)))
+            wc, wm, wm2 = _window_stats(X)
             if mean is None:
-                count, mean, m2 = float(wc), wm, wm2
+                count, mean, m2 = wc, wm, wm2
             else:
-                count, mean, m2 = _merge(count, mean, m2, float(wc), wm, wm2)
+                count, mean, m2 = _merge(count, mean, m2, wc, wm, wm2)
             versions += 1
         if mean is None:
             raise ValueError("OnlineStandardScaler.fit got an empty stream")

@@ -1,0 +1,1 @@
+from .chisqtest import ChiSqTest  # noqa: F401

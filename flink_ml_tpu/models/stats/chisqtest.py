@@ -1,0 +1,86 @@
+"""ChiSqTest — Pearson's chi-squared independence test, feature vs label.
+
+Member of the Flink ML 2.x stats surface.  AlgoOperator: one output row per
+feature column with (pValue, degreesOfFreedom, statistic).
+
+TPU-native shape: for each categorical feature, the contingency table is a
+one-hot^T @ one-hot MXU matmul over the batch; the p-value is the
+regularized upper incomplete gamma ``Q(df/2, x/2)``
+(``jax.scipy.special.gammaincc``) evaluated on device.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import AlgoOperator
+from ...data.table import Table
+from ...linalg import stack_vectors
+from ...params.shared import HasFeaturesCol, HasLabelCol
+
+__all__ = ["ChiSqTest"]
+
+
+@jax.jit
+def _chi2_from_contingency(table):
+    """(r, c) observed counts -> (statistic, dof)."""
+    total = jnp.sum(table)
+    row = jnp.sum(table, axis=1, keepdims=True)
+    col = jnp.sum(table, axis=0, keepdims=True)
+    expected = row * col / jnp.maximum(total, 1.0)
+    # cells with zero expectation contribute nothing (their observed is 0
+    # too, since a zero row/col sum forces zero observed)
+    diff = table - expected
+    stat = jnp.sum(jnp.where(expected > 0, diff * diff
+                             / jnp.maximum(expected, 1e-12), 0.0))
+    r_eff = jnp.sum(jnp.any(table > 0, axis=1))
+    c_eff = jnp.sum(jnp.any(table > 0, axis=0))
+    dof = jnp.maximum((r_eff - 1) * (c_eff - 1), 0)
+    return stat, dof
+
+
+@jax.jit
+def _p_value(stat, dof):
+    """Survival function of chi^2_dof at stat: Q(dof/2, stat/2)."""
+    return jnp.where(dof > 0,
+                     jax.scipy.special.gammaincc(
+                         jnp.maximum(dof, 1) / 2.0, stat / 2.0),
+                     1.0)
+
+
+class ChiSqTest(HasFeaturesCol, HasLabelCol, AlgoOperator):
+    """transform(table) -> one Table with a row per feature column:
+    (featureIndex, pValue, degreesOfFreedom, statistic).  Features and label
+    must be categorical (their distinct values index the contingency
+    table)."""
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()])
+        y_raw = np.asarray(table[self.get_label_col()])
+        _, y = np.unique(y_raw, return_inverse=True)
+        n_label = int(y.max()) + 1 if len(y) else 0
+        y_hot = jax.nn.one_hot(jnp.asarray(y), n_label, dtype=jnp.float32)
+
+        stats, dofs, ps = [], [], []
+        for j in range(X.shape[1]):
+            _, xj = np.unique(X[:, j], return_inverse=True)
+            n_feat = int(xj.max()) + 1 if len(xj) else 0
+            x_hot = jax.nn.one_hot(jnp.asarray(xj), n_feat,
+                                   dtype=jnp.float32)
+            contingency = x_hot.T @ y_hot                  # (r, c) MXU
+            stat, dof = _chi2_from_contingency(contingency)
+            stats.append(float(stat))
+            dofs.append(int(dof))
+            ps.append(float(_p_value(stat, dof)))
+
+        return [Table({
+            "featureIndex": np.arange(X.shape[1], dtype=np.int64),
+            "pValue": np.asarray(ps, np.float64),
+            "degreesOfFreedom": np.asarray(dofs, np.int64),
+            "statistic": np.asarray(stats, np.float64),
+        })]

@@ -200,3 +200,35 @@ def test_agglomerative_matches_bruteforce_loop():
         got = _merge_loop(D, 5, linkage)
         exp = brute(D, 5, linkage)
         np.testing.assert_array_equal(got, exp, err_msg=linkage)
+
+
+def test_agglomerative_far_from_origin_precision():
+    # regression: the f32 ||x||^2 - 2xy device expansion collapsed
+    # within-blob distances to 0 for data at coordinates ~1000
+    rng = np.random.default_rng(7)
+    centers = np.asarray([[1000.0, 1000.0], [1000.7, 1000.0],
+                          [1000.0, 1000.7]])
+    X = np.concatenate([c + rng.normal(scale=0.02, size=(20, 2))
+                        for c in centers])
+    y = np.repeat([0, 1, 2], 20)
+    for linkage in ("single", "average"):
+        out = (AgglomerativeClustering().set_num_clusters(3)
+               .set_linkage(linkage).transform(Table({"features": X}))[0])
+        assert _cluster_sets(np.asarray(out["prediction"]), y), linkage
+
+
+def test_pairwise_host64_matches_device_small():
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.distance import DistanceMeasure
+
+    rng = np.random.default_rng(0)
+    p = rng.normal(size=(10, 3))
+    c = rng.normal(size=(4, 3))
+    for name in ("euclidean", "cosine", "manhattan"):
+        m = DistanceMeasure.get_instance(name)
+        np.testing.assert_allclose(
+            m.pairwise_host64(p, c),
+            np.asarray(m.pairwise(jnp.asarray(p, jnp.float32),
+                                  jnp.asarray(c, jnp.float32))),
+            atol=1e-4)

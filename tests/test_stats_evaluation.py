@@ -1,0 +1,79 @@
+"""ChiSqTest + MulticlassClassificationEvaluator."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.evaluation import MulticlassClassificationEvaluator
+from flink_ml_tpu.models.stats import ChiSqTest
+
+
+def test_chisq_independent_vs_dependent():
+    rng = np.random.default_rng(0)
+    n = 2000
+    y = rng.integers(0, 2, n)
+    dependent = y.copy()
+    dependent[rng.random(n) < 0.05] ^= 1        # strongly associated
+    independent = rng.integers(0, 2, n)          # unrelated
+    X = np.stack([dependent, independent], axis=1).astype(np.float64)
+    out = ChiSqTest().transform(Table({"features": X, "label": y}))[0]
+    p = np.asarray(out["pValue"])
+    assert p[0] < 1e-10          # dependent column: reject independence
+    assert p[1] > 0.01           # independent column: no evidence
+    np.testing.assert_array_equal(np.asarray(out["degreesOfFreedom"]),
+                                  [1, 1])
+
+
+def test_chisq_matches_scipy_formula():
+    # hand-checkable 2x2: observed [[10, 20], [20, 10]]
+    x = np.repeat([0, 0, 1, 1], [10, 20, 20, 10])
+    y = np.tile([0, 1], 30)[:60]
+    y = np.concatenate([np.zeros(10), np.ones(20), np.zeros(20), np.ones(10)])
+    out = ChiSqTest().transform(Table({
+        "features": x[:, None].astype(np.float64), "label": y}))[0]
+    stat = float(np.asarray(out["statistic"])[0])
+    # chi2 = sum (O-E)^2/E with E=15 everywhere: 4 * 25/15 = 6.6667
+    assert stat == pytest.approx(20 / 3, rel=1e-5)
+    p = float(np.asarray(out["pValue"])[0])
+    assert p == pytest.approx(0.00982, abs=2e-4)  # 1 - chi2.cdf(6.667, 1)
+
+
+def test_chisq_multi_level_dof():
+    rng = np.random.default_rng(1)
+    X = rng.integers(0, 4, size=(500, 1)).astype(np.float64)
+    y = rng.integers(0, 3, 500)
+    out = ChiSqTest().transform(Table({"features": X, "label": y}))[0]
+    assert int(np.asarray(out["degreesOfFreedom"])[0]) == (4 - 1) * (3 - 1)
+
+
+def test_multiclass_evaluator_perfect_and_known():
+    y = np.asarray([0, 0, 1, 1, 2, 2])
+    perfect = (MulticlassClassificationEvaluator()
+               .set_metrics("accuracy", "weightedFMeasure")
+               .transform(Table({"label": y, "prediction": y}))[0])
+    assert float(np.asarray(perfect["accuracy"])[0]) == 1.0
+    assert float(np.asarray(perfect["weightedFMeasure"])[0]) == 1.0
+
+    pred = np.asarray([0, 1, 1, 1, 2, 0])  # 4/6 correct
+    out = (MulticlassClassificationEvaluator()
+           .set_metrics("accuracy", "weightedPrecision", "weightedRecall")
+           .transform(Table({"label": y, "prediction": pred}))[0])
+    assert float(np.asarray(out["accuracy"])[0]) == pytest.approx(4 / 6)
+    # recall per class: 1/2, 2/2, 1/2 -> weighted = (0.5+1+0.5)/3
+    assert float(np.asarray(out["weightedRecall"])[0]) == pytest.approx(2 / 3)
+
+
+def test_multiclass_evaluator_prediction_outside_label_space():
+    y = np.asarray([0, 0, 1])
+    pred = np.asarray([0, 7, 1])  # class 7 never appears in labels
+    out = (MulticlassClassificationEvaluator().set_metrics("accuracy")
+           .transform(Table({"label": y, "prediction": pred}))[0])
+    assert float(np.asarray(out["accuracy"])[0]) == pytest.approx(2 / 3)
+
+
+def test_multiclass_evaluator_string_labels():
+    y = np.asarray(["cat", "dog", "cat"])
+    pred = np.asarray(["cat", "dog", "dog"])
+    out = (MulticlassClassificationEvaluator().set_metrics("accuracy")
+           .transform(Table({"label": y, "prediction": pred}))[0])
+    assert float(np.asarray(out["accuracy"])[0]) == pytest.approx(2 / 3)
