@@ -1,6 +1,8 @@
 """Algorithm library — estimators, models, feature stages, evaluators."""
 
 from .classification import (  # noqa: F401
+    GBTClassifier,
+    GBTClassifierModel,
     KNNClassifier,
     KNNClassifierModel,
     LinearSVC,
@@ -50,4 +52,9 @@ from .feature import (  # noqa: F401
 )
 from .recommendation import ALS, ALSModel, WideDeep, WideDeepModel  # noqa: F401
 from .stats import ChiSqTest  # noqa: F401
-from .regression import LinearRegression, LinearRegressionModel  # noqa: F401
+from .regression import (  # noqa: F401
+    GBTRegressor,
+    GBTRegressorModel,
+    LinearRegression,
+    LinearRegressionModel,
+)

@@ -10,3 +10,4 @@ from .softmaxregression import (  # noqa: F401
     SoftmaxRegressionModel,
 )
 from .knn import KNNClassifier, KNNClassifierModel  # noqa: F401
+from .gbtclassifier import GBTClassifier, GBTClassifierModel  # noqa: F401

@@ -1,0 +1,79 @@
+"""GBTClassifier — binary gradient-boosted trees, logistic loss.
+
+Member of the later Flink ML 2.x library line.  See
+``models/common/gbt.py`` for the TPU-native histogram trainer.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ...data.table import Table
+from ...utils import persist
+from ..common.gbt_stage import GBTEstimatorBase, GBTModelBase
+
+__all__ = ["GBTClassifier", "GBTClassifierModel"]
+
+
+def _sigmoid(x: np.ndarray) -> np.ndarray:
+    return 0.5 * (1.0 + np.tanh(0.5 * x))
+
+
+class GBTClassifierModel(GBTModelBase):
+    def __init__(self):
+        super().__init__()
+        self._labels = np.asarray([0.0, 1.0])
+
+    # -- model data: forest table + label-mapping table ---------------------
+    def set_model_data(self, *inputs) -> "GBTClassifierModel":
+        forest_t, labels_t = inputs
+        super().set_model_data(forest_t)
+        self._labels = np.asarray(labels_t["labels"])
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return super().get_model_data() + [Table({"labels": self._labels})]
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        self._require_model()
+        margins = self._margins(table)
+        probs = _sigmoid(margins)
+        pred = self._labels[(probs > 0.5).astype(np.int64)]
+        out = table.with_column(self.get_prediction_col(), pred)
+        return [out.with_column("rawPrediction", probs)]
+
+    def save(self, path: str) -> None:
+        super().save(path)
+        persist.save_model_arrays(path, "labels", {"labels": self._labels})
+
+    @classmethod
+    def load(cls, path: str) -> "GBTClassifierModel":
+        model = super().load(path)
+        model._labels = persist.load_model_arrays(path, "labels")["labels"]
+        return model
+
+
+class GBTClassifier(GBTEstimatorBase):
+    model_cls = GBTClassifierModel
+
+    def _prepare_labels(self, y_raw: np.ndarray) -> np.ndarray:
+        labels, y = np.unique(y_raw, return_inverse=True)
+        if len(labels) != 2:
+            raise ValueError(
+                f"GBTClassifier is binary; got {len(labels)} label values")
+        self._label_values = labels
+        return y.astype(np.float64)
+
+    def _grad_hess(self, y, pred):
+        p = _sigmoid(pred)
+        return p - y, np.maximum(p * (1.0 - p), 1e-12)
+
+    def _base_score(self, y) -> float:
+        p = np.clip(y.mean(), 1e-6, 1 - 1e-6)
+        return float(np.log(p / (1.0 - p)))
+
+    def _finalize_model(self, model, table) -> None:
+        model._labels = self._label_values

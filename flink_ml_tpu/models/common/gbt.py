@@ -1,0 +1,265 @@
+"""Histogram-based gradient-boosted trees — the shared trainer.
+
+Member of the later Flink ML 2.x library line (GBTClassifier/GBTRegressor).
+CPU GBT implementations walk rows per node; the TPU-native formulation is
+the histogram method with everything vectorized over rows:
+
+- **Binning** (host, once): per-feature quantile bins -> int32 bin ids.
+- **Histograms** (device): per level, one ``segment_sum`` over the flattened
+  ``(node, feature, bin)`` key accumulates (grad, hess, count) for ALL nodes
+  and features at once — the analog of the keyed shuffle+reduce a dataflow
+  engine would run, fused on-chip.
+- **Split finding** (device): cumulative sums over bins give every candidate
+  split's left/right (G, H); the XGBoost gain
+  ``G_L^2/(H_L+l) + G_R^2/(H_R+l) - G^2/(H+l)`` is argmaxed per node.
+- **Routing** (device): rows step to ``2*node+1 (+1)`` by comparing their
+  bin to the split threshold — no gather-scatter trees, just arrays.
+
+Trees are complete binary arrays (node i's children are 2i+1/2i+2), so one
+jitted ``build_level`` per depth serves every tree; the boosting loop runs
+hosted (each tree depends on the previous residuals).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["GBTConfig", "bin_features", "train_forest", "predict_forest",
+           "Forest"]
+
+
+@dataclass
+class GBTConfig:
+    num_trees: int = 20
+    max_depth: int = 4            # levels of internal nodes
+    learning_rate: float = 0.1
+    max_bins: int = 64
+    reg_lambda: float = 1.0
+    min_child_weight: float = 1e-3
+
+
+@dataclass
+class Forest:
+    """(trees, nodes) arrays; node i's children are 2i+1 / 2i+2."""
+
+    feature: np.ndarray       # (T, n_nodes) int32, -1 for leaf
+    threshold: np.ndarray     # (T, n_nodes) int32 bin id: go left if <= thr
+    value: np.ndarray         # (T, n_nodes) f32 leaf value
+    bin_edges: np.ndarray     # (d, max_bins - 1) f64 quantile edges
+    base_score: float
+    learning_rate: float
+
+
+def bin_features(X: np.ndarray, max_bins: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Quantile binning on host: (binned int32 (n, d), edges (d, bins-1))."""
+    n, d = X.shape
+    edges = np.empty((d, max_bins - 1))
+    binned = np.empty((n, d), np.int32)
+    qs = np.linspace(0, 1, max_bins + 1)[1:-1]
+    for j in range(d):
+        e = np.quantile(X[:, j], qs)
+        # strictly increasing edges (duplicates collapse constant regions)
+        edges[j] = e
+        binned[:, j] = np.searchsorted(e, X[:, j], side="left")
+    return binned, edges
+
+
+def apply_bins(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    binned = np.empty(X.shape, np.int32)
+    for j in range(X.shape[1]):
+        binned[:, j] = np.searchsorted(edges[j], X[:, j], side="left")
+    return binned
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "d", "bins", "reg_lambda",
+                                   "min_child_weight"))
+def _build_level(binned, node_ids, grad, hess, n_nodes: int,
+                 d: int, bins: int, reg_lambda: float,
+                 min_child_weight: float):
+    """One tree level for all ``n_nodes`` nodes at once.
+
+    Returns (feature (n_nodes,), threshold (n_nodes,), gain (n_nodes,),
+    new_node_ids (n,)).  ``node_ids`` are level-local in [0, n_nodes) with
+    -1 marking rows already settled in a leaf.
+    """
+    n = binned.shape[0]
+    live = node_ids >= 0
+    safe_node = jnp.where(live, node_ids, 0)
+
+    # (node, feature, bin) -> flat key; dead rows land in a scratch key 0
+    # with zero weights
+    keys = (safe_node[:, None] * (d * bins)
+            + jnp.arange(d, dtype=jnp.int32)[None, :] * bins
+            + binned)                                           # (n, d)
+    w = live.astype(grad.dtype)
+    seg = n_nodes * d * bins
+    flat = keys.reshape(-1)
+    g_hist = jax.ops.segment_sum((grad * w)[:, None].repeat(d, 1).reshape(-1),
+                                 flat, seg)
+    h_hist = jax.ops.segment_sum((hess * w)[:, None].repeat(d, 1).reshape(-1),
+                                 flat, seg)
+    g_hist = g_hist.reshape(n_nodes, d, bins)
+    h_hist = h_hist.reshape(n_nodes, d, bins)
+
+    g_tot = jnp.sum(g_hist, axis=(1, 2)) / d                    # per node
+    h_tot = jnp.sum(h_hist, axis=(1, 2)) / d
+
+    # candidate split at bin b: left = bins <= b (cumsum), right = rest
+    g_left = jnp.cumsum(g_hist, axis=2)
+    h_left = jnp.cumsum(h_hist, axis=2)
+    g_right = g_tot[:, None, None] - g_left
+    h_right = h_tot[:, None, None] - h_left
+
+    def score(g, h):
+        return g * g / (h + reg_lambda)
+
+    gain = (score(g_left, h_left) + score(g_right, h_right)
+            - score(g_tot, h_tot)[:, None, None])               # (nodes,d,bins)
+    viable = ((h_left >= min_child_weight)
+              & (h_right >= min_child_weight))
+    gain = jnp.where(viable, gain, -jnp.inf)
+    # never split on the last bin (empty right side by construction)
+    gain = gain.at[:, :, -1].set(-jnp.inf)
+
+    flat_gain = gain.reshape(n_nodes, d * bins)
+    best = jnp.argmax(flat_gain, axis=1)
+    best_gain = jnp.take_along_axis(flat_gain, best[:, None], 1)[:, 0]
+    best_feature = (best // bins).astype(jnp.int32)
+    best_bin = (best % bins).astype(jnp.int32)
+
+    # route rows: live rows whose node split go to 2*node (+1 for right) in
+    # the next level's local numbering
+    row_bin = jnp.take_along_axis(binned, best_feature[safe_node][:, None],
+                                  1)[:, 0]
+    goes_right = row_bin > best_bin[safe_node]
+    node_split = best_gain[safe_node] > 0
+    new_ids = jnp.where(live & node_split,
+                        2 * safe_node + goes_right.astype(jnp.int32), -1)
+    return best_feature, best_bin, best_gain, new_ids
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "reg_lambda"))
+def _leaf_values(node_ids, grad, hess, n_nodes: int, reg_lambda: float):
+    """Newton leaf weights -G/(H+lambda) for every level-local node."""
+    live = node_ids >= 0
+    safe = jnp.where(live, node_ids, 0)
+    w = live.astype(grad.dtype)
+    g = jax.ops.segment_sum(grad * w, safe, n_nodes)
+    h = jax.ops.segment_sum(hess * w, safe, n_nodes)
+    return -g / (h + reg_lambda)
+
+
+def train_forest(X: np.ndarray, y: np.ndarray,
+                 grad_hess: Callable[[np.ndarray, np.ndarray],
+                                     Tuple[np.ndarray, np.ndarray]],
+                 base_score: float, config: GBTConfig) -> Forest:
+    """Boost ``num_trees`` trees against ``grad_hess(y, pred)``."""
+    n, d = X.shape
+    bins = config.max_bins
+    binned_host, edges = bin_features(X, bins)
+    binned = jnp.asarray(binned_host)
+    depth = config.max_depth
+    n_nodes_total = 2 ** (depth + 1) - 1
+
+    features = np.full((config.num_trees, n_nodes_total), -1, np.int32)
+    thresholds = np.zeros((config.num_trees, n_nodes_total), np.int32)
+    values = np.zeros((config.num_trees, n_nodes_total), np.float32)
+
+    pred = np.full((n,), base_score, np.float64)
+    for t in range(config.num_trees):
+        g, h = grad_hess(y, pred)
+        g = jnp.asarray(g, jnp.float32)
+        h = jnp.asarray(h, jnp.float32)
+        node_ids = jnp.zeros((n,), jnp.int32)
+
+        level_feature: List[np.ndarray] = []
+        level_bin: List[np.ndarray] = []
+        level_gain: List[np.ndarray] = []
+        level_ids = [node_ids]
+        for level in range(depth):
+            n_nodes = 2 ** level
+            f, b, gain, node_ids = _build_level(
+                binned, node_ids, g, h, n_nodes, d, bins,
+                config.reg_lambda, config.min_child_weight)
+            level_feature.append(np.asarray(f))
+            level_bin.append(np.asarray(b))
+            level_gain.append(np.asarray(gain))
+            level_ids.append(node_ids)
+
+        # assemble the tree: internal nodes that actually split get
+        # (feature, threshold); everything else becomes a leaf holding the
+        # Newton value of the rows that stopped there
+        base = 0
+        for level in range(depth):
+            n_nodes = 2 ** level
+            gain = level_gain[level]
+            split = gain > 0
+            features[t, base:base + n_nodes] = np.where(
+                split, level_feature[level], -1)
+            thresholds[t, base:base + n_nodes] = level_bin[level]
+            # leaf value for rows that STOP at this level (their node did
+            # not split): computed from the ids entering the level
+            vals = np.asarray(_leaf_values(level_ids[level], g, h, n_nodes,
+                                           config.reg_lambda))
+            values[t, base:base + n_nodes] = np.where(split, 0.0, vals)
+            base += n_nodes
+        # deepest level: always leaves
+        n_nodes = 2 ** depth
+        vals = np.asarray(_leaf_values(level_ids[depth], g, h, n_nodes,
+                                       config.reg_lambda))
+        values[t, base:base + n_nodes] = vals
+
+        # in-sample update reuses the DEVICE binned copy — _predict_tree
+        # on binned_host would re-upload the full matrix once per tree
+        pred = pred + config.learning_rate * np.asarray(_predict_tree_jit(
+            binned, jnp.asarray(features[t]), jnp.asarray(thresholds[t]),
+            jnp.asarray(values[t]), depth), np.float64)
+
+    return Forest(features, thresholds, values, edges, base_score,
+                  config.learning_rate)
+
+
+def _predict_tree(binned: np.ndarray, feature: np.ndarray,
+                  threshold: np.ndarray, value: np.ndarray,
+                  depth: int) -> np.ndarray:
+    return np.asarray(_predict_tree_jit(
+        jnp.asarray(binned), jnp.asarray(feature), jnp.asarray(threshold),
+        jnp.asarray(value), depth))
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def _predict_tree_jit(binned, feature, threshold, value, depth: int):
+    n = binned.shape[0]
+    node = jnp.zeros((n,), jnp.int32)       # global complete-tree index
+    out = jnp.zeros((n,), jnp.float32)
+    settled = jnp.zeros((n,), bool)
+    for _ in range(depth + 1):
+        feat = feature[node]
+        is_leaf = feat < 0
+        newly = is_leaf & ~settled
+        out = jnp.where(newly, value[node], out)
+        settled = settled | is_leaf
+        row_bin = jnp.take_along_axis(binned, jnp.maximum(feat, 0)[:, None],
+                                      1)[:, 0]
+        child = 2 * node + 1 + (row_bin > threshold[node]).astype(jnp.int32)
+        node = jnp.where(settled, node, jnp.minimum(child,
+                                                    feature.shape[0] - 1))
+    return out
+
+
+def predict_forest(X: np.ndarray, forest: Forest) -> np.ndarray:
+    """Sum of tree outputs, margin scale."""
+    binned = apply_bins(X, forest.bin_edges)
+    depth = int(np.log2(forest.feature.shape[1] + 1)) - 1
+    pred = np.full((len(X),), forest.base_score, np.float64)
+    for t in range(forest.feature.shape[0]):
+        pred += forest.learning_rate * _predict_tree(
+            binned, forest.feature[t], forest.threshold[t],
+            forest.value[t], depth)
+    return pred

@@ -1,0 +1,170 @@
+"""Shared Estimator/Model plumbing for GBTClassifier / GBTRegressor."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ...api.stage import Estimator, Model
+from ...data.table import Table
+from ...linalg import stack_vectors
+from ...params.param import FloatParam, IntParam, ParamValidators
+from ...params.shared import (
+    HasFeaturesCol,
+    HasLabelCol,
+    HasLearningRate,
+    HasMaxIter,
+    HasPredictionCol,
+)
+from ...utils import persist
+from .gbt import Forest, GBTConfig, predict_forest, train_forest
+
+__all__ = ["GBTParams", "GBTModelBase", "GBTEstimatorBase"]
+
+
+class GBTModelParams(HasFeaturesCol, HasPredictionCol):
+    pass
+
+
+class GBTParams(GBTModelParams, HasLabelCol, HasMaxIter, HasLearningRate):
+    """``maxIter`` = number of trees (the boosting iterations);
+    ``learningRate`` = shrinkage.  No seed: training is fully deterministic
+    (no row/feature subsampling yet)."""
+
+    REG_LAMBDA = FloatParam(
+        "regLambda", "Leaf L2 regularization (XGBoost lambda).", default=1.0,
+        validator=ParamValidators.gt_eq(0))
+
+    def get_reg_lambda(self) -> float:
+        return self.get(GBTParams.REG_LAMBDA)
+
+    def set_reg_lambda(self, value: float):
+        return self.set(GBTParams.REG_LAMBDA, value)
+
+    MAX_DEPTH = IntParam("maxDepth", "Tree depth (internal levels).",
+                         default=4, validator=ParamValidators.in_range(1, 12))
+    MAX_BINS = IntParam("maxBins", "Histogram bins per feature.", default=64,
+                        validator=ParamValidators.in_range(2, 256))
+    MIN_CHILD_WEIGHT = FloatParam(
+        "minChildWeight", "Minimum hessian sum per child.", default=1e-3,
+        validator=ParamValidators.gt_eq(0))
+
+    def get_max_depth(self) -> int:
+        return self.get(GBTParams.MAX_DEPTH)
+
+    def set_max_depth(self, value: int):
+        return self.set(GBTParams.MAX_DEPTH, value)
+
+    def get_max_bins(self) -> int:
+        return self.get(GBTParams.MAX_BINS)
+
+    def set_max_bins(self, value: int):
+        return self.set(GBTParams.MAX_BINS, value)
+
+
+class GBTModelBase(GBTModelParams, Model):
+    """Holds the Forest arrays; subclasses map margins to predictions."""
+
+    def __init__(self):
+        super().__init__()
+        self._forest: Optional[Forest] = None
+
+    def _margins(self, table: Table) -> np.ndarray:
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
+        return predict_forest(X, self._forest)
+
+    def _require_model(self) -> None:
+        if self._forest is None:
+            raise RuntimeError(
+                f"{type(self).__name__} has no model data; call "
+                "set_model_data() or fit the estimator first")
+
+    # -- model data ---------------------------------------------------------
+    def set_model_data(self, *inputs) -> "GBTModelBase":
+        (t,) = inputs
+        self._forest = Forest(
+            feature=np.asarray(t["feature"], np.int32),
+            threshold=np.asarray(t["threshold"], np.int32),
+            value=np.asarray(t["value"], np.float32),
+            bin_edges=np.asarray(t["binEdges"][0], np.float64),
+            base_score=float(np.asarray(t["baseScore"])[0]),
+            learning_rate=float(np.asarray(t["learningRate"])[0]),
+        )
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require_model()
+        f = self._forest
+        n_trees = f.feature.shape[0]
+        return [Table({
+            "feature": f.feature, "threshold": f.threshold, "value": f.value,
+            "binEdges": np.broadcast_to(
+                f.bin_edges[None], (n_trees,) + f.bin_edges.shape).copy(),
+            "baseScore": np.full((n_trees,), f.base_score),
+            "learningRate": np.full((n_trees,), f.learning_rate),
+        })]
+
+    def save(self, path: str) -> None:
+        self._require_model()
+        persist.save_metadata(self, path)
+        f = self._forest
+        persist.save_model_arrays(path, "model", {
+            "feature": f.feature, "threshold": f.threshold, "value": f.value,
+            "binEdges": f.bin_edges,
+            "scalars": np.asarray([f.base_score, f.learning_rate])})
+
+    @classmethod
+    def load(cls, path: str):
+        model = persist.load_stage_param(path)
+        data = persist.load_model_arrays(path, "model")
+        model._forest = Forest(
+            feature=data["feature"].astype(np.int32),
+            threshold=data["threshold"].astype(np.int32),
+            value=data["value"].astype(np.float32),
+            bin_edges=data["binEdges"].astype(np.float64),
+            base_score=float(data["scalars"][0]),
+            learning_rate=float(data["scalars"][1]),
+        )
+        return model
+
+
+class GBTEstimatorBase(GBTParams, Estimator):
+    """Subclasses define ``_prepare_labels`` (-> float targets + label map),
+    ``_grad_hess``, ``_base_score``, and ``model_cls``."""
+
+    model_cls: type
+
+    def _config(self) -> GBTConfig:
+        return GBTConfig(
+            num_trees=self.get_max_iter(),
+            max_depth=self.get_max_depth(),
+            learning_rate=self.get_learning_rate(),
+            max_bins=self.get_max_bins(),
+            reg_lambda=self.get_reg_lambda(),
+            min_child_weight=self.get(GBTParams.MIN_CHILD_WEIGHT),
+        )
+
+    def fit(self, *inputs):
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
+        if len(X) == 0:
+            raise ValueError(f"{type(self).__name__}.fit requires rows")
+        y = self._prepare_labels(np.asarray(table[self.get_label_col()]))
+        forest = train_forest(X, y, self._grad_hess, self._base_score(y),
+                              self._config())
+        model = self.model_cls()
+        model.copy_params_from(self)
+        model._forest = forest
+        self._finalize_model(model, table)
+        return model
+
+    def _finalize_model(self, model, table) -> None:
+        """Hook for subclasses (e.g. stash the label mapping)."""
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+
+    @classmethod
+    def load(cls, path: str):
+        return persist.load_stage_param(path)

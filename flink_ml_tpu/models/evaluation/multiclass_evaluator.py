@@ -1,16 +1,16 @@
 """MulticlassClassificationEvaluator — accuracy / weighted F-measure.
 
 Companion to the binary evaluator (the Flink ML 2.x evaluation surface).
-All metrics derive from the (classes, classes) confusion matrix, which is
-one one-hot^T @ one-hot MXU matmul over the batch.
+All metrics derive from the (classes, classes) confusion matrix, computed
+with one host ``np.bincount`` over the joint (true, predicted) key — exact
+integer counts at any n (a one-hot f32 matmul loses exactness past 2^24
+rows per cell and materializes O(n*classes) memory for no device win).
 """
 
 from __future__ import annotations
 
 from typing import List
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ...api.stage import AlgoOperator
@@ -22,11 +22,6 @@ __all__ = ["MulticlassClassificationEvaluator"]
 
 _SUPPORTED = ("accuracy", "weightedPrecision", "weightedRecall",
               "weightedFMeasure")
-
-
-@jax.jit
-def _confusion(pred_hot, label_hot):
-    return label_hot.T @ pred_hot           # [true, predicted]
 
 
 def _metrics(conf: np.ndarray) -> dict:
@@ -70,9 +65,8 @@ class MulticlassClassificationEvaluator(HasLabelCol, HasPredictionCol,
         y = np.searchsorted(classes, labels)
         p = np.searchsorted(classes, preds)
         c = len(classes)
-        conf = np.asarray(_confusion(
-            jax.nn.one_hot(jnp.asarray(p), c, dtype=jnp.float32),
-            jax.nn.one_hot(jnp.asarray(y), c, dtype=jnp.float32)))
+        conf = np.bincount(y * c + p, minlength=c * c).reshape(c, c)
+        conf = conf.astype(np.float64)      # [true, predicted]
         values = _metrics(conf)
         names = self.get(MulticlassClassificationEvaluator.METRICS)
         return [Table({name: np.asarray([values[name]]) for name in names})]

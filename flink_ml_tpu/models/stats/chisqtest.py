@@ -3,10 +3,12 @@
 Member of the Flink ML 2.x stats surface.  AlgoOperator: one output row per
 feature column with (pValue, degreesOfFreedom, statistic).
 
-TPU-native shape: for each categorical feature, the contingency table is a
-one-hot^T @ one-hot MXU matmul over the batch; the p-value is the
+Contingency tables and statistics are exact host ``np.bincount`` integer
+counts (tiny work; a per-feature jitted kernel would recompile for every
+distinct (levels, labels) shape and sync three times per feature); the
+p-values for ALL features evaluate in one vectorized device call of the
 regularized upper incomplete gamma ``Q(df/2, x/2)``
-(``jax.scipy.special.gammaincc``) evaluated on device.
+(``jax.scipy.special.gammaincc``).
 """
 
 from __future__ import annotations
@@ -25,30 +27,29 @@ from ...params.shared import HasFeaturesCol, HasLabelCol
 __all__ = ["ChiSqTest"]
 
 
-@jax.jit
-def _chi2_from_contingency(table):
-    """(r, c) observed counts -> (statistic, dof)."""
-    total = jnp.sum(table)
-    row = jnp.sum(table, axis=1, keepdims=True)
-    col = jnp.sum(table, axis=0, keepdims=True)
-    expected = row * col / jnp.maximum(total, 1.0)
+def _chi2_from_contingency(table: np.ndarray):
+    """(r, c) observed counts -> (statistic, dof), exact host arithmetic."""
+    total = table.sum()
+    expected = (table.sum(1, keepdims=True) * table.sum(0, keepdims=True)
+                / max(total, 1.0))
     # cells with zero expectation contribute nothing (their observed is 0
     # too, since a zero row/col sum forces zero observed)
     diff = table - expected
-    stat = jnp.sum(jnp.where(expected > 0, diff * diff
-                             / jnp.maximum(expected, 1e-12), 0.0))
-    r_eff = jnp.sum(jnp.any(table > 0, axis=1))
-    c_eff = jnp.sum(jnp.any(table > 0, axis=0))
-    dof = jnp.maximum((r_eff - 1) * (c_eff - 1), 0)
-    return stat, dof
+    stat = float(np.where(expected > 0,
+                          diff * diff / np.maximum(expected, 1e-12),
+                          0.0).sum())
+    r_eff = int(np.any(table > 0, axis=1).sum())
+    c_eff = int(np.any(table > 0, axis=0).sum())
+    return stat, max((r_eff - 1) * (c_eff - 1), 0)
 
 
 @jax.jit
-def _p_value(stat, dof):
-    """Survival function of chi^2_dof at stat: Q(dof/2, stat/2)."""
-    return jnp.where(dof > 0,
+def _p_values(stats, dofs):
+    """Survival function of chi^2_dof at stat, vectorized over features:
+    Q(dof/2, stat/2)."""
+    return jnp.where(dofs > 0,
                      jax.scipy.special.gammaincc(
-                         jnp.maximum(dof, 1) / 2.0, stat / 2.0),
+                         jnp.maximum(dofs, 1) / 2.0, stats / 2.0),
                      1.0)
 
 
@@ -64,19 +65,21 @@ class ChiSqTest(HasFeaturesCol, HasLabelCol, AlgoOperator):
         y_raw = np.asarray(table[self.get_label_col()])
         _, y = np.unique(y_raw, return_inverse=True)
         n_label = int(y.max()) + 1 if len(y) else 0
-        y_hot = jax.nn.one_hot(jnp.asarray(y), n_label, dtype=jnp.float32)
 
-        stats, dofs, ps = [], [], []
+        stats, dofs = [], []
         for j in range(X.shape[1]):
             _, xj = np.unique(X[:, j], return_inverse=True)
             n_feat = int(xj.max()) + 1 if len(xj) else 0
-            x_hot = jax.nn.one_hot(jnp.asarray(xj), n_feat,
-                                   dtype=jnp.float32)
-            contingency = x_hot.T @ y_hot                  # (r, c) MXU
+            contingency = np.bincount(
+                xj * n_label + y, minlength=n_feat * n_label).reshape(
+                    n_feat, n_label).astype(np.float64)
             stat, dof = _chi2_from_contingency(contingency)
-            stats.append(float(stat))
-            dofs.append(int(dof))
-            ps.append(float(_p_value(stat, dof)))
+            stats.append(stat)
+            dofs.append(dof)
+
+        ps = np.asarray(_p_values(jnp.asarray(stats, jnp.float32),
+                                  jnp.asarray(dofs, jnp.float32)),
+                        np.float64) if stats else np.zeros(0)
 
         return [Table({
             "featureIndex": np.arange(X.shape[1], dtype=np.int64),

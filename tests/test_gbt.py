@@ -1,0 +1,127 @@
+"""Gradient-boosted trees: trainer, classifier, regressor."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.classification import GBTClassifier, GBTClassifierModel
+from flink_ml_tpu.models.regression import GBTRegressor, GBTRegressorModel
+
+
+def _xor_table(n=800, seed=0):
+    """Nonlinear target a linear model cannot fit."""
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-1, 1, size=(n, 2))
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.int64)
+    return Table({"features": X, "label": y}), X, y
+
+
+def _friedman(n=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(size=(n, 5))
+    y = (10 * np.sin(np.pi * X[:, 0] * X[:, 1]) + 20 * (X[:, 2] - 0.5) ** 2
+         + 10 * X[:, 3] + 5 * X[:, 4])
+    return Table({"features": X, "label": y}), X, y
+
+
+def test_classifier_learns_xor():
+    table, X, y = _xor_table()
+    model = (GBTClassifier().set_max_iter(30).set_max_depth(3)
+             .set_learning_rate(0.3).fit(table))
+    out = model.transform(table)[0]
+    pred = np.asarray(out["prediction"])
+    assert (pred == y).mean() > 0.97
+    probs = np.asarray(out["rawPrediction"])
+    assert ((probs > 0.5) == (pred == 1)).all()
+    assert probs.min() >= 0 and probs.max() <= 1
+
+
+def test_classifier_label_values_preserved():
+    table, X, y = _xor_table(n=400)
+    relabeled = Table({"features": X, "label": np.where(y == 1, "yes", "no")})
+    model = GBTClassifier().set_max_iter(20).set_max_depth(3).fit(relabeled)
+    pred = np.asarray(model.transform(relabeled)[0]["prediction"])
+    assert set(np.unique(pred)) <= {"yes", "no"}
+    assert (pred == np.where(y == 1, "yes", "no")).mean() > 0.9
+
+
+def test_classifier_rejects_multiclass():
+    table = Table({"features": np.zeros((3, 2)), "label": np.asarray([0, 1, 2])})
+    with pytest.raises(ValueError, match="binary"):
+        GBTClassifier().fit(table)
+
+
+def test_regressor_beats_linear_on_friedman():
+    table, X, y = _friedman()
+    model = (GBTRegressor().set_max_iter(40).set_max_depth(4)
+             .set_learning_rate(0.2).fit(table))
+    pred = np.asarray(model.transform(table)[0]["prediction"])
+    rmse = np.sqrt(np.mean((pred - y) ** 2))
+    # linear least squares on the same data
+    A = np.concatenate([X, np.ones((len(X), 1))], axis=1)
+    lin = A @ np.linalg.lstsq(A, y, rcond=None)[0]
+    lin_rmse = np.sqrt(np.mean((lin - y) ** 2))
+    assert rmse < 0.5 * lin_rmse, (rmse, lin_rmse)
+
+
+def test_regressor_monotone_improvement_with_trees():
+    table, X, y = _friedman(n=500, seed=1)
+
+    def rmse(trees):
+        m = (GBTRegressor().set_max_iter(trees).set_max_depth(3)
+             .set_learning_rate(0.3).fit(table))
+        p = np.asarray(m.transform(table)[0]["prediction"])
+        return np.sqrt(np.mean((p - y) ** 2))
+
+    assert rmse(30) < rmse(5) < rmse(1)
+
+
+def test_constant_labels_yield_constant_prediction():
+    X = np.random.default_rng(0).normal(size=(50, 3))
+    table = Table({"features": X, "label": np.full(50, 7.0)})
+    model = GBTRegressor().set_max_iter(5).fit(table)
+    pred = np.asarray(model.transform(table)[0]["prediction"])
+    np.testing.assert_allclose(pred, 7.0, atol=1e-3)
+
+
+def test_save_load_round_trip(tmp_path):
+    table, X, y = _xor_table(n=300)
+    model = GBTClassifier().set_max_iter(10).set_max_depth(3).fit(table)
+    p1 = np.asarray(model.transform(table)[0]["prediction"])
+    model.save(str(tmp_path / "c"))
+    re = GBTClassifierModel.load(str(tmp_path / "c"))
+    p2 = np.asarray(re.transform(table)[0]["prediction"])
+    np.testing.assert_array_equal(p1, p2)
+
+    rtable, _, ry = _friedman(n=300)
+    rmodel = GBTRegressor().set_max_iter(8).fit(rtable)
+    r1 = np.asarray(rmodel.transform(rtable)[0]["prediction"])
+    rmodel.save(str(tmp_path / "r"))
+    rre = GBTRegressorModel.load(str(tmp_path / "r"))
+    np.testing.assert_allclose(
+        np.asarray(rre.transform(rtable)[0]["prediction"]), r1)
+
+
+def test_model_data_round_trip():
+    table, X, y = _xor_table(n=200)
+    model = GBTClassifier().set_max_iter(5).set_max_depth(2).fit(table)
+    rebuilt = GBTClassifierModel().set_model_data(*model.get_model_data())
+    rebuilt.copy_params_from(model)
+    np.testing.assert_array_equal(
+        np.asarray(rebuilt.transform(table)[0]["prediction"]),
+        np.asarray(model.transform(table)[0]["prediction"]))
+
+
+def test_unseen_data_generalizes():
+    table, X, y = _xor_table(n=1000, seed=2)
+    model = (GBTClassifier().set_max_iter(30).set_max_depth(3)
+             .set_learning_rate(0.3).fit(table))
+    _, X2, y2 = _xor_table(n=500, seed=99)
+    pred = np.asarray(model.transform(Table({"features": X2}))[0]["prediction"])
+    assert (pred == y2).mean() > 0.95
+
+
+def test_empty_fit_rejected():
+    with pytest.raises(ValueError):
+        GBTRegressor().fit(Table({"features": np.zeros((0, 2)),
+                                  "label": np.zeros(0)}))

@@ -27,7 +27,6 @@ def test_chisq_independent_vs_dependent():
 def test_chisq_matches_scipy_formula():
     # hand-checkable 2x2: observed [[10, 20], [20, 10]]
     x = np.repeat([0, 0, 1, 1], [10, 20, 20, 10])
-    y = np.tile([0, 1], 30)[:60]
     y = np.concatenate([np.zeros(10), np.ones(20), np.zeros(20), np.ones(10)])
     out = ChiSqTest().transform(Table({
         "features": x[:, None].astype(np.float64), "label": y}))[0]
