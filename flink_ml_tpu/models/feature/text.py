@@ -1,0 +1,229 @@
+"""Text/hashing feature stages: HashingTF, IDF, FeatureHasher, and
+IndexToString (the StringIndexer inverse).
+
+Members of the Flink ML 2.x feature surface.  Hashing uses a deterministic
+FNV-1a over the value's string form (stable across runs and machines — a
+requirement the reference family inherits from save/load).  The TF/IDF
+scoring itself is device work: one elementwise log-scale op over the
+document-term matrix.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import Estimator, Model, Transformer
+from ...data.table import Table
+from ...params.param import BoolParam, IntParam, ParamValidators
+from ...params.shared import (
+    HasFeaturesCol,
+    HasInputCols,
+    HasOutputCol,
+)
+from ...utils import persist
+
+__all__ = ["HashingTF", "IDF", "IDFModel", "FeatureHasher", "IndexToString"]
+
+_FNV_OFFSET = np.uint64(14695981039346656037)
+_FNV_PRIME = np.uint64(1099511628211)
+
+
+def _fnv1a(value) -> int:
+    h = _FNV_OFFSET
+    for b in str(value).encode("utf-8"):
+        h = np.uint64(h ^ np.uint64(b)) * _FNV_PRIME
+    return int(h)
+
+
+class HashingTF(HasOutputCol, HasFeaturesCol, Transformer):
+    """Token sequences -> fixed-size term-frequency vectors by hashing.
+    Input column: one list/array of tokens per row."""
+
+    NUM_FEATURES = IntParam("numFeatures", "Hash-space size.", default=256,
+                            validator=ParamValidators.gt(0))
+    BINARY = BoolParam("binary", "1/0 presence instead of counts.",
+                       default=False)
+
+    def get_num_features(self) -> int:
+        return self.get(HashingTF.NUM_FEATURES)
+
+    def set_num_features(self, value: int):
+        return self.set(HashingTF.NUM_FEATURES, value)
+
+    def set_binary(self, value: bool):
+        return self.set(HashingTF.BINARY, value)
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        docs = table[self.get_features_col()]
+        m = self.get_num_features()
+        out = np.zeros((len(docs), m), np.float64)
+        for i, doc in enumerate(docs):
+            for token in np.ravel(np.asarray(doc, dtype=object)):
+                out[i, _fnv1a(token) % m] += 1.0
+        if self.get(HashingTF.BINARY):
+            out = (out > 0).astype(np.float64)
+        return [table.with_column(self.get_output_col(), out)]
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "HashingTF":
+        return persist.load_stage_param(path)
+
+
+@jax.jit
+def _idf_scale(tf, idf):
+    return tf * idf[None, :]
+
+
+class IDFModel(HasOutputCol, HasFeaturesCol, Model):
+    def __init__(self):
+        super().__init__()
+        self._idf: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs) -> "IDFModel":
+        (t,) = inputs
+        self._idf = np.asarray(t["idf"][0], np.float64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require_model()
+        return [Table({"idf": self._idf[None]})]
+
+    def _require_model(self) -> None:
+        if self._idf is None:
+            raise RuntimeError("IDFModel has no model data; call "
+                               "set_model_data() or fit an IDF first")
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        self._require_model()
+        tf = np.asarray(table[self.get_features_col()], np.float64)
+        out = np.asarray(_idf_scale(jnp.asarray(tf, jnp.float32),
+                                    jnp.asarray(self._idf, jnp.float32)),
+                         np.float64)
+        return [table.with_column(self.get_output_col(), out)]
+
+    def save(self, path: str) -> None:
+        self._require_model()
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(path, "model", {"idf": self._idf})
+
+    @classmethod
+    def load(cls, path: str) -> "IDFModel":
+        model = persist.load_stage_param(path)
+        model._idf = persist.load_model_arrays(
+            path, "model")["idf"].astype(np.float64)
+        return model
+
+
+class IDF(HasOutputCol, HasFeaturesCol, Estimator[IDFModel]):
+    """Learns ``log((n_docs + 1) / (df + 1))`` per term column."""
+
+    MIN_DOC_FREQ = IntParam("minDocFreq",
+                            "Terms below this document frequency get idf 0.",
+                            default=0, validator=ParamValidators.gt_eq(0))
+
+    def set_min_doc_freq(self, value: int):
+        return self.set(IDF.MIN_DOC_FREQ, value)
+
+    def fit(self, *inputs) -> IDFModel:
+        (table,) = inputs
+        tf = np.asarray(table[self.get_features_col()], np.float64)
+        df = (tf > 0).sum(axis=0)
+        idf = np.log((len(tf) + 1.0) / (df + 1.0))
+        idf[df < self.get(IDF.MIN_DOC_FREQ)] = 0.0
+        model = IDFModel()
+        model.copy_params_from(self)
+        model._idf = idf
+        return model
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "IDF":
+        return persist.load_stage_param(path)
+
+
+class FeatureHasher(HasOutputCol, HasInputCols, Transformer):
+    """Hash arbitrary columns into one fixed-size vector: numeric columns
+    add their value at ``hash(colName)``, categorical/string columns add 1
+    at ``hash(colName=value)`` (the classic hashing trick)."""
+
+    NUM_FEATURES = IntParam("numFeatures", "Hash-space size.", default=256,
+                            validator=ParamValidators.gt(0))
+
+    def get_num_features(self) -> int:
+        return self.get(FeatureHasher.NUM_FEATURES)
+
+    def set_num_features(self, value: int):
+        return self.set(FeatureHasher.NUM_FEATURES, value)
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        in_cols = self.get_input_cols()
+        if not in_cols:
+            raise ValueError("FeatureHasher requires inputCols")
+        m = self.get_num_features()
+        n = table.num_rows
+        out = np.zeros((n, m), np.float64)
+        for col in in_cols:
+            values = np.asarray(table[col])
+            if np.issubdtype(values.dtype, np.number):
+                slot = _fnv1a(col) % m
+                out[:, slot] += values.astype(np.float64)
+            else:
+                for i, v in enumerate(values):
+                    out[i, _fnv1a(f"{col}={v}") % m] += 1.0
+        return [table.with_column(self.get_output_col(), out)]
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "FeatureHasher":
+        return persist.load_stage_param(path)
+
+
+class IndexToString(HasOutputCol, HasFeaturesCol, Transformer):
+    """Inverse of StringIndexer: dense ids -> original label values, using
+    the labels array set via ``set_labels`` (or taken from a fitted
+    StringIndexerModel's vocabulary)."""
+
+    def __init__(self):
+        super().__init__()
+        self._labels: Optional[np.ndarray] = None
+
+    def set_labels(self, labels) -> "IndexToString":
+        self._labels = np.asarray(labels)
+        return self
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        if self._labels is None:
+            raise RuntimeError("IndexToString needs set_labels(...) first")
+        idx = np.asarray(table[self.get_features_col()], np.int64)
+        if idx.size and (idx.min() < 0 or idx.max() >= len(self._labels)):
+            raise ValueError(f"index out of range for {len(self._labels)} "
+                             "labels")
+        return [table.with_column(self.get_output_col(), self._labels[idx])]
+
+    def save(self, path: str) -> None:
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(path, "model", {"labels": self._labels
+                                                  if self._labels is not None
+                                                  else np.zeros(0)})
+
+    @classmethod
+    def load(cls, path: str) -> "IndexToString":
+        stage = persist.load_stage_param(path)
+        labels = persist.load_model_arrays(path, "model")["labels"]
+        stage._labels = labels if len(labels) else None
+        return stage
