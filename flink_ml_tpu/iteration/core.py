@@ -335,6 +335,15 @@ def _iterate_fused(body: BodyFn, initial_state, provider: _DataProvider,
         return IterationResult(final_state, outputs, max_epochs, {})
 
     # Criteria-driven: lax.while_loop; keeps only the last outputs.
+    if probe.outputs is not None:
+        import warnings
+
+        warnings.warn(
+            "fused iteration with a termination criterion keeps only the "
+            "LAST epoch's outputs (a while_loop cannot stack a dynamic "
+            "number of them); use mode='hosted' (or carry a fixed-size "
+            "buffer in state) to keep the full per-epoch output log",
+            stacklevel=3)
     zero_out = jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype), probe.outputs)
 

@@ -59,13 +59,12 @@ class GBTClassifierModel(GBTModelBase):
 class GBTClassifier(GBTEstimatorBase):
     model_cls = GBTClassifierModel
 
-    def _prepare_labels(self, y_raw: np.ndarray) -> np.ndarray:
+    def _prepare_labels(self, y_raw: np.ndarray):
         labels, y = np.unique(y_raw, return_inverse=True)
         if len(labels) != 2:
             raise ValueError(
                 f"GBTClassifier is binary; got {len(labels)} label values")
-        self._label_values = labels
-        return y.astype(np.float64)
+        return y.astype(np.float64), labels
 
     def _grad_hess(self, y, pred):
         p = _sigmoid(pred)
@@ -75,5 +74,5 @@ class GBTClassifier(GBTEstimatorBase):
         p = np.clip(y.mean(), 1e-6, 1 - 1e-6)
         return float(np.log(p / (1.0 - p)))
 
-    def _finalize_model(self, model, table) -> None:
-        model._labels = self._label_values
+    def _finalize_model(self, model, label_values) -> None:
+        model._labels = label_values

@@ -150,17 +150,20 @@ class GBTEstimatorBase(GBTParams, Estimator):
         X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
         if len(X) == 0:
             raise ValueError(f"{type(self).__name__}.fit requires rows")
-        y = self._prepare_labels(np.asarray(table[self.get_label_col()]))
+        # Label values thread through fit (never stored on the estimator):
+        # concurrent fits on one estimator stay independent.
+        y, label_values = self._prepare_labels(
+            np.asarray(table[self.get_label_col()]))
         forest = train_forest(X, y, self._grad_hess, self._base_score(y),
                               self._config())
         model = self.model_cls()
         model.copy_params_from(self)
         model._forest = forest
-        self._finalize_model(model, table)
+        self._finalize_model(model, label_values)
         return model
 
-    def _finalize_model(self, model, table) -> None:
-        """Hook for subclasses (e.g. stash the label mapping)."""
+    def _finalize_model(self, model, label_values) -> None:
+        """Hook for subclasses (e.g. install the label mapping)."""
 
     def save(self, path: str) -> None:
         persist.save_metadata(self, path)

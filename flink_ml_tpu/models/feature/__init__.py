@@ -19,6 +19,13 @@ from .scalers import (  # noqa: F401
     StandardScaler,
     StandardScalerModel,
 )
+from .text import (  # noqa: F401
+    FeatureHasher,
+    HashingTF,
+    IDF,
+    IDFModel,
+    IndexToString,
+)
 from .transforms import (  # noqa: F401
     Binarizer,
     Bucketizer,

@@ -28,15 +28,18 @@ from ...utils import persist
 
 __all__ = ["HashingTF", "IDF", "IDFModel", "FeatureHasher", "IndexToString"]
 
-_FNV_OFFSET = np.uint64(14695981039346656037)
-_FNV_PRIME = np.uint64(1099511628211)
+_FNV_OFFSET = 14695981039346656037
+_FNV_PRIME = 1099511628211
+_FNV_MASK = (1 << 64) - 1
 
 
 def _fnv1a(value) -> int:
+    # Python-int arithmetic masked to 64 bits: identical wrap-around values
+    # to uint64 hardware arithmetic, without numpy overflow warnings.
     h = _FNV_OFFSET
     for b in str(value).encode("utf-8"):
-        h = np.uint64(h ^ np.uint64(b)) * _FNV_PRIME
-    return int(h)
+        h = ((h ^ b) * _FNV_PRIME) & _FNV_MASK
+    return h
 
 
 class HashingTF(HasOutputCol, HasFeaturesCol, Transformer):

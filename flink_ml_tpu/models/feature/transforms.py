@@ -80,12 +80,21 @@ class Binarizer(_SimpleTransformer):
 
 class Bucketizer(_SimpleTransformer):
     """Map each value to the index of its half-open split interval
-    ``[splits[i], splits[i+1])``; values outside the outer splits clip into
-    the first/last bucket.  One ``searchsorted`` per column batch."""
+    ``[splits[i], splits[i+1])``.  Values outside the outer splits are
+    *invalid* (as is NaN) and routed by ``handleInvalid`` (the Flink ML
+    Bucketizer contract): ``"error"`` (default) raises, ``"keep"`` maps them
+    into a dedicated extra bucket ``len(splits) - 1``, ``"clip"`` clamps
+    into the first/last regular bucket (NaN still errors — it has no nearest
+    bucket).  One ``searchsorted`` per column batch."""
 
     SPLITS = DoubleArrayParam(
         "splits", "Strictly increasing bucket boundaries (>= 3 values).",
         default=None, validator=ParamValidators.not_null())
+    HANDLE_INVALID = StringParam(
+        "handleInvalid",
+        "Values outside the outer splits: error | keep | clip.",
+        default="error",
+        validator=ParamValidators.in_array(["error", "keep", "clip"]))
 
     def get_splits(self):
         return self.get(Bucketizer.SPLITS)
@@ -95,6 +104,12 @@ class Bucketizer(_SimpleTransformer):
             else values
         return self.set(Bucketizer.SPLITS, tuple(float(v) for v in vals))
 
+    def get_handle_invalid(self) -> str:
+        return self.get(Bucketizer.HANDLE_INVALID)
+
+    def set_handle_invalid(self, value: str):
+        return self.set(Bucketizer.HANDLE_INVALID, value)
+
     def _apply(self, X: np.ndarray) -> np.ndarray:
         splits = np.asarray(self.get_splits(), np.float64)
         if len(splits) < 3:
@@ -102,8 +117,22 @@ class Bucketizer(_SimpleTransformer):
                              f"(got {len(splits)})")
         if not np.all(np.diff(splits) > 0):
             raise ValueError("Bucketizer splits must be strictly increasing")
+        n_buckets = len(splits) - 1  # last regular bucket is closed on top
+        nan = np.isnan(X)
+        invalid = nan | (X < splits[0]) | (X > splits[-1])
+        policy = self.get_handle_invalid()
+        if np.any(invalid) and (policy == "error"
+                                or (policy == "clip" and np.any(nan))):
+            bad = X[invalid if policy == "error" else nan].ravel()[0]
+            raise ValueError(
+                f"Bucketizer got invalid value {bad} for splits "
+                f"[{splits[0]}, {splits[-1]}]; set handleInvalid to 'keep' "
+                "to accept it")
         idx = np.searchsorted(splits, X, side="right") - 1
-        return np.clip(idx, 0, len(splits) - 2).astype(np.float64)
+        idx = np.clip(idx, 0, n_buckets - 1)  # top edge + 'clip' policy
+        if policy == "keep":
+            idx = np.where(invalid, n_buckets, idx)
+        return idx.astype(np.float64)
 
 
 class Normalizer(_SimpleTransformer):

@@ -27,8 +27,8 @@ class GBTRegressorModel(GBTModelBase):
 class GBTRegressor(GBTEstimatorBase):
     model_cls = GBTRegressorModel
 
-    def _prepare_labels(self, y_raw: np.ndarray) -> np.ndarray:
-        return np.asarray(y_raw, np.float64)
+    def _prepare_labels(self, y_raw: np.ndarray):
+        return np.asarray(y_raw, np.float64), None
 
     def _grad_hess(self, y, pred):
         return pred - y, np.ones_like(pred)

@@ -6,18 +6,18 @@ feature column with (pValue, degreesOfFreedom, statistic).
 Contingency tables and statistics are exact host ``np.bincount`` integer
 counts (tiny work; a per-feature jitted kernel would recompile for every
 distinct (levels, labels) shape and sync three times per feature); the
-p-values for ALL features evaluate in one vectorized device call of the
-regularized upper incomplete gamma ``Q(df/2, x/2)``
-(``jax.scipy.special.gammaincc``).
+p-values are the chi^2 survival function ``Q(df/2, x/2)`` evaluated on the
+host in float64 (``scipy.special.gammaincc``) — the output column is
+float64-typed and must carry genuine float64 precision, which a device f32
+evaluation caps at ~1e-7 and flushes tiny p-values to 0.
 """
 
 from __future__ import annotations
 
 from typing import List
 
-import jax
-import jax.numpy as jnp
 import numpy as np
+from scipy.special import gammaincc
 
 from ...api.stage import AlgoOperator
 from ...data.table import Table
@@ -43,14 +43,14 @@ def _chi2_from_contingency(table: np.ndarray):
     return stat, max((r_eff - 1) * (c_eff - 1), 0)
 
 
-@jax.jit
-def _p_values(stats, dofs):
-    """Survival function of chi^2_dof at stat, vectorized over features:
-    Q(dof/2, stat/2)."""
-    return jnp.where(dofs > 0,
-                     jax.scipy.special.gammaincc(
-                         jnp.maximum(dofs, 1) / 2.0, stats / 2.0),
-                     1.0)
+def _p_values(stats: np.ndarray, dofs: np.ndarray) -> np.ndarray:
+    """Survival function of chi^2_dof at stat, vectorized over features in
+    host float64: Q(dof/2, stat/2)."""
+    stats = np.asarray(stats, np.float64)
+    dofs = np.asarray(dofs, np.float64)
+    return np.where(dofs > 0,
+                    gammaincc(np.maximum(dofs, 1.0) / 2.0, stats / 2.0),
+                    1.0)
 
 
 class ChiSqTest(HasFeaturesCol, HasLabelCol, AlgoOperator):
@@ -77,9 +77,8 @@ class ChiSqTest(HasFeaturesCol, HasLabelCol, AlgoOperator):
             stats.append(stat)
             dofs.append(dof)
 
-        ps = np.asarray(_p_values(jnp.asarray(stats, jnp.float32),
-                                  jnp.asarray(dofs, jnp.float32)),
-                        np.float64) if stats else np.zeros(0)
+        ps = (_p_values(np.asarray(stats), np.asarray(dofs)) if stats
+              else np.zeros(0))
 
         return [Table({
             "featureIndex": np.arange(X.shape[1], dtype=np.int64),

@@ -26,10 +26,42 @@ def test_binarizer():
 
 
 def test_bucketizer_boundaries_and_clipping():
-    b = Bucketizer().set_splits(0.0, 1.0, 2.0, 3.0)
+    b = Bucketizer().set_splits(0.0, 1.0, 2.0, 3.0).set_handle_invalid("clip")
     out = b.transform(_t([[-5.0, 0.0], [0.99, 1.0], [2.5, 99.0]]))[0]
     np.testing.assert_array_equal(np.asarray(out["output"]),
                                   [[0, 0], [0, 1], [2, 2]])
+
+
+def test_bucketizer_handle_invalid_error_default():
+    b = Bucketizer().set_splits(0.0, 1.0, 2.0)
+    # in-range values (incl. both outer edges) are fine under the default
+    out = b.transform(_t([[0.0, 1.5], [2.0, 0.5]]))[0]
+    np.testing.assert_array_equal(np.asarray(out["output"]),
+                                  [[0, 1], [1, 0]])
+    with pytest.raises(ValueError, match="handleInvalid"):
+        b.transform(_t([[-0.1]]))
+    with pytest.raises(ValueError, match="handleInvalid"):
+        b.transform(_t([[2.1]]))
+
+
+def test_bucketizer_handle_invalid_keep_routes_to_extra_bucket():
+    b = Bucketizer().set_splits(0.0, 1.0, 2.0).set_handle_invalid("keep")
+    out = b.transform(_t([[-5.0, 0.5], [1.5, 99.0]]))[0]
+    # 2 regular buckets -> invalids land in the dedicated bucket index 2
+    np.testing.assert_array_equal(np.asarray(out["output"]),
+                                  [[2, 0], [1, 2]])
+
+
+def test_bucketizer_nan_is_invalid():
+    with pytest.raises(ValueError, match="invalid"):
+        Bucketizer().set_splits(0.0, 1.0, 2.0).transform(_t([[np.nan]]))
+    # clip has no nearest bucket for NaN either
+    with pytest.raises(ValueError, match="invalid"):
+        (Bucketizer().set_splits(0.0, 1.0, 2.0).set_handle_invalid("clip")
+         .transform(_t([[np.nan]])))
+    out = (Bucketizer().set_splits(0.0, 1.0, 2.0).set_handle_invalid("keep")
+           .transform(_t([[np.nan, 0.5]]))[0])
+    np.testing.assert_array_equal(np.asarray(out["output"]), [[2, 0]])
 
 
 def test_bucketizer_validates_splits():

@@ -1,0 +1,114 @@
+"""HashingTF / IDF / FeatureHasher / IndexToString (models/feature/text.py)."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature import (
+    FeatureHasher,
+    HashingTF,
+    IDF,
+    IDFModel,
+    IndexToString,
+)
+from flink_ml_tpu.models.feature.text import _fnv1a
+
+
+def test_fnv1a_deterministic_and_no_overflow_warning():
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any RuntimeWarning fails the test
+        a = _fnv1a("some token")
+        b = _fnv1a("some token")
+    assert a == b
+    assert 0 <= a < (1 << 64)
+    assert _fnv1a("x") != _fnv1a("y")
+
+
+def _docs_table():
+    docs = np.empty((3,), object)
+    docs[0] = ["a", "b", "a"]
+    docs[1] = ["b"]
+    docs[2] = ["c", "c", "c"]
+    return Table({"features": docs})
+
+
+def test_hashingtf_counts_and_binary():
+    tf = (HashingTF().set_num_features(32)
+          .set_features_col("features").set_output_col("tf"))
+    out = tf.transform(_docs_table())[0]
+    mat = np.asarray(out["tf"])
+    assert mat.shape == (3, 32)
+    # row sums = token counts; "a" hashed twice in doc 0
+    np.testing.assert_array_equal(mat.sum(axis=1), [3, 1, 3])
+    slot_a = _fnv1a("a") % 32
+    assert mat[0, slot_a] == 2.0
+
+    binary = tf.set_binary(True).transform(_docs_table())[0]
+    bmat = np.asarray(binary["tf"])
+    assert set(np.unique(bmat)) <= {0.0, 1.0}
+
+
+def test_idf_fit_transform_roundtrip(tmp_path):
+    tf = np.asarray([[1.0, 0.0], [1.0, 2.0], [1.0, 0.0]])
+    table = Table({"features": tf})
+    idf = IDF().set_features_col("features").set_output_col("scaled")
+    model = idf.fit(table)
+    # df = [3, 1]; idf = log((3+1)/(df+1))
+    expected_idf = np.log([4.0 / 4.0, 4.0 / 2.0])
+    got_idf = np.asarray(model.get_model_data()[0]["idf"][0])
+    np.testing.assert_allclose(got_idf, expected_idf, atol=1e-6)
+    out = model.transform(table)[0]
+    np.testing.assert_allclose(np.asarray(out["scaled"]),
+                               tf * expected_idf[None, :], atol=1e-5)
+
+    model.save(str(tmp_path / "idf"))
+    re = IDFModel.load(str(tmp_path / "idf"))
+    np.testing.assert_allclose(
+        np.asarray(re.transform(table)[0]["scaled"]),
+        np.asarray(out["scaled"]), atol=1e-6)
+
+
+def test_idf_min_doc_freq_zeroes_rare_terms():
+    tf = np.asarray([[1.0, 0.0], [1.0, 2.0], [1.0, 0.0]])
+    model = (IDF().set_min_doc_freq(2).set_features_col("features")
+             .set_output_col("o").fit(Table({"features": tf})))
+    got = np.asarray(model.get_model_data()[0]["idf"][0])
+    assert got[1] == 0.0  # df=1 < 2
+
+
+def test_feature_hasher_numeric_and_categorical():
+    t = Table({"age": np.asarray([30.0, 40.0]),
+               "city": np.asarray(["sf", "nyc"])})
+    fh = (FeatureHasher().set_input_cols("age", "city")
+          .set_num_features(64).set_output_col("hashed"))
+    out = fh.transform(t)[0]
+    mat = np.asarray(out["hashed"])
+    assert mat.shape == (2, 64)
+    # numeric column lands its value at hash(colName)
+    assert mat[0, _fnv1a("age") % 64] == 30.0
+    # categorical column adds 1 at hash(col=value)
+    assert mat[0, _fnv1a("city=sf") % 64] == 1.0
+    assert mat[1, _fnv1a("city=nyc") % 64] == 1.0
+
+
+def test_feature_hasher_requires_input_cols():
+    with pytest.raises(ValueError, match="inputCols"):
+        (FeatureHasher().set_output_col("h")
+         .transform(Table({"x": np.asarray([1.0])})))
+
+
+def test_index_to_string_roundtrip(tmp_path):
+    its = (IndexToString().set_labels(["red", "green", "blue"])
+           .set_features_col("idx").set_output_col("color"))
+    out = its.transform(Table({"idx": np.asarray([2, 0, 1])}))[0]
+    np.testing.assert_array_equal(np.asarray(out["color"]),
+                                  ["blue", "red", "green"])
+    with pytest.raises(ValueError, match="out of range"):
+        its.transform(Table({"idx": np.asarray([3])}))
+
+    its.save(str(tmp_path / "its"))
+    re = IndexToString.load(str(tmp_path / "its"))
+    out2 = re.transform(Table({"idx": np.asarray([1])}))[0]
+    np.testing.assert_array_equal(np.asarray(out2["color"]), ["green"])
