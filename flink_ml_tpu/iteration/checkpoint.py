@@ -106,15 +106,40 @@ def _decode_structure(node: Any, leaves: Dict[int, np.ndarray]) -> Any:
     return leaf
 
 
+def _leaf_to_host(x: Any) -> Any:
+    """Device leaf -> host value, multi-host safe: a jax.Array sharded over a
+    multi-host mesh is NOT fully addressable (``jax.device_get`` would
+    throw), so its global value is assembled with a ``process_allgather``
+    collective — which every process must enter (it compiles to an
+    all-gather over DCN/ICI)."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return x
+
+
 def save_pytree(path: str, tree: Any,
                 meta: Optional[Dict[str, Any]] = None) -> None:
     """Atomically persist a pytree: arrays into one npz, structure + metadata
     into a JSON sidecar.  Device arrays are fetched to host first (one
     blocking transfer; callers wanting async snapshots copy the state with
-    ``jax.device_get`` beforehand)."""
+    ``jax.device_get`` beforehand).
+
+    Multi-host: every process participates in assembling the global value
+    (collective), then ONLY process 0 touches the filesystem — no directory
+    races — and a cross-host barrier makes the checkpoint visible to all
+    processes before anyone proceeds (the directory must be on a filesystem
+    shared by all hosts, the standard pod setup)."""
+    multi = jax.process_count() > 1
     leaves: List[np.ndarray] = []
-    host_tree = jax.device_get(tree)
+    host_tree = jax.device_get(jax.tree_util.tree_map(_leaf_to_host, tree))
     skeleton = _encode_structure(host_tree, leaves)
+    if multi and jax.process_index() != 0:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"save_pytree:{path}")
+        return
     tmp = path + ".tmp"
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
@@ -136,6 +161,10 @@ def save_pytree(path: str, tree: Any,
         shutil.rmtree(old)
     else:
         os.replace(tmp, path)
+    if multi:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"save_pytree:{path}")
 
 
 def load_pytree(path: str) -> Tuple[Any, Dict[str, Any]]:
@@ -242,5 +271,7 @@ class CheckpointManager:
         keep = self.config.max_to_keep
         if keep <= 0:
             return
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return  # process 0 owns the directory (save_pytree writes there)
         for epoch in self.list_epochs()[:-keep]:
             shutil.rmtree(self._ckpt_path(epoch), ignore_errors=True)

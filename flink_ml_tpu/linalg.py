@@ -16,7 +16,8 @@ from typing import Any, Iterable, Sequence, Union
 
 import numpy as np
 
-__all__ = ["Vector", "DenseVector", "SparseVector", "Vectors"]
+__all__ = ["Vector", "DenseVector", "SparseVector", "Vectors",
+           "stack_vectors", "stack_sparse_vectors"]
 
 
 class Vector:
@@ -118,6 +119,38 @@ class Vectors:
     @staticmethod
     def sparse(n: int, indices: Sequence[int], values: Sequence[float]) -> SparseVector:
         return SparseVector(n, indices, values)
+
+
+def stack_sparse_vectors(column: Iterable["SparseVector"],
+                         nnz: int = 0) -> tuple:
+    """Normalise a column of :class:`SparseVector` into the device-facing
+    fixed-active-count form: ``(indices (n, nnz) int32, values (n, nnz)
+    float32, dim)``.  Rows with fewer actives pad with ``(index 0, value
+    0.0)`` — a zero value contributes nothing to any gather-based score or
+    scatter-based gradient, so padding is free of masking.
+
+    This is what makes the hashed high-dim path (Criteo-shape, 2^20+ dims)
+    expressible: the dense ``stack_vectors`` form would materialise an
+    ``(n, 2^20)`` matrix.  TPUs want static shapes, hence fixed nnz (pass
+    ``nnz`` to force a count >= the max actives; 0 = use the max)."""
+    vecs = list(column)
+    n = len(vecs)
+    max_active = max((v.indices.shape[0] for v in vecs), default=0)
+    if nnz <= 0:
+        nnz = max(max_active, 1)
+    elif max_active > nnz:
+        raise ValueError(
+            f"nnz={nnz} is smaller than the densest row ({max_active} "
+            "active entries)")
+    indices = np.zeros((n, nnz), np.int32)
+    values = np.zeros((n, nnz), np.float32)
+    dim = 0
+    for i, v in enumerate(vecs):
+        k = v.indices.shape[0]
+        indices[i, :k] = v.indices
+        values[i, :k] = v.values
+        dim = max(dim, v.size())
+    return indices, values, dim
 
 
 def stack_vectors(column: Iterable[Any]) -> np.ndarray:

@@ -34,13 +34,14 @@ from ...iteration import (
     IterationListener,
     iterate,
 )
-from ...linalg import stack_vectors
 from ...params.param import FloatParam, IntParam, ParamValidators
+from ..common.linear import check_sparse_indices, resolve_features
 from ...params.shared import (
     HasElasticNet,
     HasFeaturesCol,
     HasGlobalBatchSize,
     HasLabelCol,
+    HasNumFeatures,
     HasRegParam,
     HasWeightCol,
 )
@@ -63,6 +64,7 @@ class OnlineLogisticRegressionModel(LogisticRegressionModel):
 
 class OnlineLogisticRegression(HasFeaturesCol, HasLabelCol, HasWeightCol,
                                HasGlobalBatchSize, HasRegParam, HasElasticNet,
+                               HasNumFeatures,
                                Estimator[OnlineLogisticRegressionModel]):
     ALPHA = FloatParam("alpha", "FTRL alpha (learning-rate scale).",
                        default=0.1, validator=ParamValidators.gt(0))
@@ -96,24 +98,29 @@ class OnlineLogisticRegression(HasFeaturesCol, HasLabelCol, HasWeightCol,
 
     # -- streaming fit ------------------------------------------------------
     def _batches(self, source) -> Iterator[tuple]:
-        """Normalise the input into an iterator of (X, y, w) host batches."""
+        """Normalise the input into an iterator of host batches:
+        ``("dense", X, y, w)`` or ``("sparse", (idx, vals), y, w, dim)``
+        (hashed pair columns / SparseVector rows — the Criteo shape)."""
         feat, lab = self.get_features_col(), self.get_label_col()
         wcol = self.get_weight_col()
         batch = self.get_global_batch_size()
 
-        def table_to_xyw(t: Table):
-            X = stack_vectors(t[feat]).astype(np.float32)
+        def extract(t: Table):
+            kind, feats = resolve_features(t, feat)
             y = np.asarray(t[lab], np.float32)
             w = (np.asarray(t[wcol], np.float32) if wcol
                  else np.ones_like(y))
-            return X, y, w
+            if kind == "sparse":
+                idx, vals, dim = feats
+                return ("sparse", (idx, vals), y, w, dim)
+            return ("dense", feats.astype(np.float32), y, w, 0)
 
         if isinstance(source, Table):
             for b in source.batches(batch):
-                yield table_to_xyw(b)
+                yield extract(b)
         else:
             for t in source:
-                yield table_to_xyw(t)
+                yield extract(t)
 
     def fit(self, *inputs) -> OnlineLogisticRegressionModel:
         """``fit(stream)`` where stream is a Table (windowed by
@@ -125,13 +132,21 @@ class OnlineLogisticRegression(HasFeaturesCol, HasLabelCol, HasWeightCol,
         l1, l2 = reg * alpha_mix, reg * (1.0 - alpha_mix)
         alpha, beta = self.get_alpha(), self.get_beta()
 
-        ftrl_step = _make_ftrl_step(alpha, beta, l1, l2)
-
         batches = self._batches(source)
         first = next(batches, None)
         if first is None:
             raise ValueError("OnlineLogisticRegression.fit got an empty stream")
-        d = first[0].shape[1]
+        sparse = first[0] == "sparse"
+        if sparse:
+            d = self.get_num_features() or first[4]
+            if not d:
+                raise ValueError(
+                    "hashed pair-column input needs numFeatures (the hash-"
+                    "space size); call set_num_features")
+            ftrl_step = _make_sparse_ftrl_step(alpha, beta, l1, l2)
+        else:
+            d = first[1].shape[1]
+            ftrl_step = _make_ftrl_step(alpha, beta, l1, l2)
 
         w0 = (np.zeros((d,), np.float32) if self._initial_model is None
               else self._initial_model.astype(np.float32))
@@ -142,12 +157,29 @@ class OnlineLogisticRegression(HasFeaturesCol, HasLabelCol, HasWeightCol,
         }
 
         def rechain():
-            yield first
-            yield from batches
+            if sparse:
+                check_sparse_indices(first[1][0], d)
+            yield first[1:4]
+            for kind, feats, y, w, *_ in batches:
+                if (kind == "sparse") != sparse:
+                    raise ValueError(
+                        "stream switched between dense and sparse features "
+                        "mid-flight")
+                if sparse:
+                    check_sparse_indices(feats[0], d)
+                yield feats, y, w
 
         def body(state, epoch, data):
-            X, y, w = data
-            new_state, loss = ftrl_step(state, X, y, w)
+            feats, y, w = data
+            if sparse:
+                idx, vals = feats
+                new_state, loss = ftrl_step(
+                    state, jnp.asarray(idx), jnp.asarray(vals),
+                    jnp.asarray(y), jnp.asarray(w))
+            else:
+                new_state, loss = ftrl_step(
+                    state, jnp.asarray(feats), jnp.asarray(y),
+                    jnp.asarray(w))
             return IterationBodyResult(new_state, outputs=loss)
 
         versions: List[LinearState] = []
@@ -161,9 +193,7 @@ class OnlineLogisticRegression(HasFeaturesCol, HasLabelCol, HasWeightCol,
                     versions.append(LinearState(w_host, 0.0))
 
         result = iterate(
-            body, state0,
-            ((jnp.asarray(X), jnp.asarray(y), jnp.asarray(w))
-             for X, y, w in rechain()),
+            body, state0, rechain(),
             config=IterationConfig(mode="hosted", jit=True),
             listeners=[VersionEmitter()],
         )
@@ -189,6 +219,39 @@ def _make_ftrl_step(alpha: float, beta: float, l1: float, l2: float):
         p = jax.nn.sigmoid(margin)
         weight_sum = jnp.maximum(jnp.sum(sample_w), 1e-12)
         g = X.T @ ((p - y) * sample_w) / weight_sum
+        loss = (-jnp.sum(sample_w * (y * jnp.log(p + 1e-12)
+                                     + (1 - y) * jnp.log(1 - p + 1e-12)))
+                / weight_sum)
+
+        sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / alpha
+        z = z + g - sigma * w
+        n = n + g * g
+        new_w = jnp.where(
+            jnp.abs(z) <= l1,
+            0.0,
+            -(z - jnp.sign(z) * l1) / ((beta + jnp.sqrt(n)) / alpha + l2))
+        return {"w": new_w, "z": z, "n": n}, loss
+
+    return step
+
+
+def _make_sparse_ftrl_step(alpha: float, beta: float, l1: float, l2: float):
+    """FTRL update for hashed ``(indices, values)`` batches: the gradient is
+    one scatter-add into the dense coordinate space, after which the update
+    is the standard per-coordinate FTRL formula — coordinates with g=0 are
+    exact fixed points (sigma=0, z and n unchanged), so the dense formula IS
+    the classic sparse/lazy FTRL, with O(d) elementwise work kept on-device
+    in HBM."""
+
+    @jax.jit
+    def step(state, idx, vals, y, sample_w):
+        w, z, n = state["w"], state["z"], state["n"]
+        margin = jnp.sum(vals * w[idx], axis=-1)
+        p = jax.nn.sigmoid(margin)
+        weight_sum = jnp.maximum(jnp.sum(sample_w), 1e-12)
+        r = (p - y) * sample_w / weight_sum
+        g = jnp.zeros_like(w).at[idx.reshape(-1)].add(
+            (vals * r[:, None]).reshape(-1))
         loss = (-jnp.sum(sample_w * (y * jnp.log(p + 1e-12)
                                      + (1 - y) * jnp.log(1 - p + 1e-12)))
                 / weight_sum)

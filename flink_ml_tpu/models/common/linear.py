@@ -11,7 +11,7 @@ import numpy as np
 
 from ...api.stage import Estimator, Model
 from ...data.table import Table
-from ...linalg import stack_vectors
+from ...linalg import SparseVector, stack_sparse_vectors, stack_vectors
 from ...params.shared import (
     HasElasticNet,
     HasFeaturesCol,
@@ -19,6 +19,7 @@ from ...params.shared import (
     HasLabelCol,
     HasLearningRate,
     HasMaxIter,
+    HasNumFeatures,
     HasPredictionCol,
     HasRawPredictionCol,
     HasRegParam,
@@ -28,15 +29,67 @@ from ...params.shared import (
 )
 from ...utils import persist
 from .losses import LOSSES
-from .sgd import LinearState, SGDConfig, sgd_fit, sgd_fit_outofcore
+from .sgd import (
+    LinearState,
+    SGDConfig,
+    sgd_fit,
+    sgd_fit_outofcore,
+    sgd_fit_sparse,
+)
 
-__all__ = ["LinearEstimatorParams", "LinearModelBase", "LinearEstimatorBase"]
+__all__ = ["LinearEstimatorParams", "LinearModelBase", "LinearEstimatorBase",
+           "resolve_features", "check_sparse_indices"]
+
+
+def check_sparse_indices(idx: np.ndarray, num_features: int) -> None:
+    """Range-check hashed indices against the weight size.  A jitted gather
+    silently CLAMPS out-of-range indices (piling every stray feature onto
+    the last weight), so a hasher/model numFeatures mismatch would produce
+    garbage scores with no diagnostic — the same trap ``_validate_cat_ids``
+    guards in WideDeep."""
+    if idx.size and (int(idx.min()) < 0 or int(idx.max()) >= num_features):
+        raise ValueError(
+            f"hashed index out of range for numFeatures={num_features} "
+            f"(got index {int(idx.max()) if int(idx.min()) >= 0 else int(idx.min())}); "
+            "the hasher and the model disagree on the hash-space size")
 
 
 @jax.jit
 def _jit_margins(X, w, b):
     """Module-level jit: repeated transform() calls are cache hits."""
     return X @ w + b
+
+
+@jax.jit
+def _jit_sparse_margins(idx, vals, w, b):
+    """Sparse score: one gather + row reduce (no dense matrix ever built)."""
+    return jnp.sum(vals * w[idx], axis=-1) + b
+
+
+def resolve_features(table: Table, col: str):
+    """Resolve a features column into the device-facing form.
+
+    Sparse/hashed features appear in a Table either as a column of
+    :class:`SparseVector` objects, or as the hashed PAIR convention two
+    columns ``{col}_indices (n, nnz) int`` + ``{col}_values (n, nnz)
+    float`` (what ``FeatureHasher.set_sparse_output(True)`` emits).
+
+    Returns ``("dense", X)`` or ``("sparse", (indices, values, dim))`` where
+    ``dim`` is the feature dimension if derivable from the data (SparseVector
+    carries it) else 0 (pair columns: the caller must know numFeatures)."""
+    if col not in table:
+        idx_col, val_col = f"{col}_indices", f"{col}_values"
+        if idx_col in table and val_col in table:
+            return "sparse", (np.asarray(table[idx_col], np.int32),
+                              np.asarray(table[val_col], np.float32), 0)
+        raise KeyError(
+            f"No column {col!r} (nor {idx_col!r}/{val_col!r}); available: "
+            f"{table.column_names}")
+    column = table[col]
+    if column.dtype == object and len(column) \
+            and isinstance(column[0], SparseVector):
+        return "sparse", stack_sparse_vectors(column)
+    return "dense", stack_vectors(column)
 
 
 class LinearModelParams(HasFeaturesCol, HasPredictionCol, HasRawPredictionCol):
@@ -46,7 +99,7 @@ class LinearModelParams(HasFeaturesCol, HasPredictionCol, HasRawPredictionCol):
 class LinearEstimatorParams(LinearModelParams, HasLabelCol, HasWeightCol,
                             HasMaxIter, HasLearningRate, HasRegParam,
                             HasElasticNet, HasGlobalBatchSize, HasTol,
-                            HasSeed):
+                            HasSeed, HasNumFeatures):
     pass
 
 
@@ -84,10 +137,16 @@ class LinearModelBase(LinearModelParams, Model):
     # -- inference ----------------------------------------------------------
     def _margins(self, table: Table) -> np.ndarray:
         self._require_model()
-        X = stack_vectors(table[self.get_features_col()]).astype(np.float32)
+        kind, feats = resolve_features(table, self.get_features_col())
         w = jnp.asarray(self._state.coefficients, jnp.float32)
         b = jnp.asarray(self._state.intercept, jnp.float32)
-        return np.asarray(_jit_margins(X, w, b), np.float64)
+        if kind == "sparse":
+            idx, vals, _ = feats
+            check_sparse_indices(idx, self._state.coefficients.shape[0])
+            return np.asarray(_jit_sparse_margins(idx, vals, w, b),
+                              np.float64)
+        return np.asarray(_jit_margins(feats.astype(np.float32), w, b),
+                          np.float64)
 
     def _decision(self, margins: np.ndarray) -> np.ndarray:
         raise NotImplementedError
@@ -135,14 +194,27 @@ class LinearEstimatorBase(LinearEstimatorParams, Estimator):
 
     def fit(self, *inputs):
         (table,) = inputs
-        X = stack_vectors(table[self.get_features_col()])
+        kind, feats = resolve_features(table, self.get_features_col())
         y = self._labels(table)
         weight_col = self.get_weight_col()
         weights = (np.asarray(table[weight_col], np.float64)
                    if weight_col else None)
 
-        state, loss_log = sgd_fit(
-            LOSSES[self.loss_name], X, y, weights, self._sgd_config())
+        if kind == "sparse":
+            idx, vals, dim = feats
+            num_features = self.get_num_features() or dim
+            if not num_features:
+                raise ValueError(
+                    "hashed pair-column input needs numFeatures (the hash-"
+                    "space size); call set_num_features")
+            check_sparse_indices(idx, num_features)
+            state, loss_log = sgd_fit_sparse(
+                LOSSES[self.loss_name], idx, vals, y, weights,
+                num_features, self._sgd_config())
+        else:
+            state, loss_log = sgd_fit(
+                LOSSES[self.loss_name], feats, y, weights,
+                self._sgd_config())
 
         model = self.model_cls()
         model.copy_params_from(self)
@@ -161,21 +233,30 @@ class LinearEstimatorBase(LinearEstimatorParams, Estimator):
             seed=self.get_seed(),
         )
 
-    def fit_outofcore(self, make_reader, *, num_features: int, mesh=None):
+    def fit_outofcore(self, make_reader, *, num_features: int, mesh=None,
+                      sparse: bool = False, checkpoint=None,
+                      checkpoint_every_steps: int = 0, resume: bool = False):
         """Out-of-core ``fit``: the dataset streams from ``make_reader()``
         (a fresh per-epoch iterator of host batch dicts, e.g. a re-seeked
         ``DataCacheReader``) instead of living in RAM/HBM — the
         Criteo-scale input path (BASELINE.md north star).  Column names
-        follow this estimator's params (featuresCol/labelCol/weightCol).
+        follow this estimator's params (featuresCol/labelCol/weightCol);
+        with ``sparse=True`` the reader must carry the hashed pair columns
+        ``{featuresCol}_indices`` / ``{featuresCol}_values`` instead.
         globalBatchSize and seed are inert here: the reader owns batch size
         and ordering (shuffle when writing the cache or vary segment order
         per epoch)."""
+        feat = self.get_features_col()
         state, loss_log = sgd_fit_outofcore(
             LOSSES[self.loss_name], make_reader,
             num_features=num_features, config=self._sgd_config(), mesh=mesh,
-            features_key=self.get_features_col(),
+            features_key=feat,
             label_key=self.get_label_col(),
-            weight_key=self.get_weight_col() or None)
+            weight_key=self.get_weight_col() or None,
+            indices_key=f"{feat}_indices" if sparse else None,
+            values_key=f"{feat}_values" if sparse else None,
+            checkpoint=checkpoint,
+            checkpoint_every_steps=checkpoint_every_steps, resume=resume)
         model = self.model_cls()
         model.copy_params_from(self)
         model._state = state

@@ -25,9 +25,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...data.prefetch import prefetch_to_device
 from ...iteration import IterationBodyResult, IterationConfig, iterate
+from ...iteration.checkpoint import CheckpointConfig, CheckpointManager
 from ...parallel.mesh import default_mesh, replicate
 
-__all__ = ["SGDConfig", "sgd_fit", "sgd_fit_params",
+__all__ = ["SGDConfig", "sgd_fit", "sgd_fit_params", "sgd_fit_sparse",
            "sgd_fit_outofcore", "LinearState", "plan_epoch_layout",
            "prepare_epoch_tensor"]
 
@@ -188,12 +189,120 @@ def _linear_update(loss_fn: LossFn, config: SGDConfig):
     return update
 
 
+def _sparse_update(loss_fn: LossFn, config: SGDConfig):
+    """Single-batch update for hashed/sparse features ``(indices, values)``
+    of fixed active count per row: the score is one gather + row reduce
+    (``sum(values * w[indices])``), and ``jax.grad`` of the gather lowers to
+    one scatter-add — the TPU-native replacement for a CSR SpMV.  Regularizer
+    and proximal step are identical to :func:`_linear_update` (they are O(d)
+    dense ops either way)."""
+    lr = config.learning_rate
+    reg, alpha = config.reg, config.elastic_net
+    l2 = reg * (1.0 - alpha)
+    l1 = reg * alpha
+
+    def objective(params, idx, vals, yb, wb):
+        margin = jnp.sum(vals * params["w"][idx], axis=-1) + params["b"]
+        loss = loss_fn(margin, yb, wb)
+        if l2 > 0:
+            loss = loss + 0.5 * l2 * jnp.sum(jnp.square(params["w"]))
+        return loss
+
+    grad_fn = jax.value_and_grad(objective)
+
+    def update(params, idx, vals, yb, wb):
+        value, grads = grad_fn(params, idx, vals, yb, wb)
+        new_w = params["w"] - lr * grads["w"]
+        if l1 > 0:
+            new_w = jnp.sign(new_w) * jnp.maximum(
+                jnp.abs(new_w) - lr * l1, 0.0)
+        new_b = params["b"] - (lr * grads["b"]
+                               if config.fit_intercept else 0.0)
+        return {"w": new_w, "b": new_b}, value
+
+    return update
+
+
+def sgd_fit_sparse(loss_fn: LossFn, indices: np.ndarray, values: np.ndarray,
+                   labels: np.ndarray, weights: Optional[np.ndarray],
+                   num_features: int, config: SGDConfig,
+                   mesh=None) -> Tuple[LinearState, list]:
+    """Sparse-feature variant of :func:`sgd_fit`: rows are ``(indices
+    (n, nnz) int32, values (n, nnz) f32)`` pairs (the
+    :func:`flink_ml_tpu.linalg.stack_sparse_vectors` / hashed-FeatureHasher
+    form) scored against a dense ``(num_features,)`` weight living in HBM.
+    This is the Criteo-shaped path: 2^20+ hashed dims never materialise as a
+    dense matrix; only the weight (4 MiB at 2^20 f32) is dense."""
+    from .linear import check_sparse_indices
+
+    check_sparse_indices(indices, num_features)
+    mesh = mesh or default_mesh()
+    n_dev = int(mesh.shape["data"])
+    n = indices.shape[0]
+    steps, batch, perm = plan_epoch_layout(
+        n, config.global_batch_size, n_dev, config.seed)
+
+    idx = prepare_epoch_tensor(indices.astype(np.int32), perm, steps, batch)
+    vals = prepare_epoch_tensor(values.astype(np.float32), perm, steps, batch)
+    y = prepare_epoch_tensor(labels.astype(np.float32), perm, steps, batch)
+    w_host = (weights.astype(np.float32) if weights is not None
+              else np.ones((n,), np.float32))
+    w = prepare_epoch_tensor(w_host, perm, steps, batch, pad_value=0.0)
+
+    batch_sharded = NamedSharding(mesh, P(None, "data"))
+    row_sharded = NamedSharding(mesh, P(None, "data", None))
+    idx = jax.device_put(idx, row_sharded)
+    vals = jax.device_put(vals, row_sharded)
+    y = jax.device_put(y, batch_sharded)
+    w = jax.device_put(w, batch_sharded)
+
+    update = _sparse_update(loss_fn, config)
+
+    def epoch_body(state, epoch, data):
+        idx_d, vals_d, yd, wd = data
+        params, prev_loss, loss_log = state
+
+        def batch_step(params, i):
+            return update(params, idx_d[i], vals_d[i], yd[i], wd[i])
+
+        params, losses = jax.lax.scan(
+            batch_step, params, jnp.arange(steps, dtype=jnp.int32))
+        epoch_loss = jnp.mean(losses)
+        loss_log = loss_log.at[epoch].set(epoch_loss)
+        termination = (jnp.abs(prev_loss - epoch_loss) > config.tol
+                       if config.tol > 0 else None)
+        return IterationBodyResult(
+            feedback=(params, epoch_loss, loss_log), termination=termination)
+
+    init_state = (
+        replicate({"w": jnp.zeros((num_features,), jnp.float32),
+                   "b": jnp.zeros((), jnp.float32)}, mesh),
+        jnp.asarray(jnp.inf, jnp.float32),
+        jnp.full((config.max_epochs,), jnp.nan, jnp.float32))
+
+    result = iterate(
+        epoch_body, init_state, (idx, vals, y, w),
+        max_epochs=config.max_epochs,
+        config=IterationConfig(mode="fused"),
+    )
+    params, _final_loss, loss_buf = result.state
+    params = jax.device_get(params)
+    loss_log = list(np.asarray(jax.device_get(loss_buf))[:result.num_epochs])
+    return LinearState(np.asarray(params["w"], np.float64),
+                       float(params["b"])), loss_log
+
+
 def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                       num_features: int, config: SGDConfig, mesh=None,
                       features_key: str = "features",
                       label_key: str = "label",
                       weight_key: Optional[str] = None,
-                      prefetch_depth: int = 2
+                      indices_key: Optional[str] = None,
+                      values_key: Optional[str] = None,
+                      prefetch_depth: int = 2,
+                      checkpoint=None,
+                      checkpoint_every_steps: int = 0,
+                      resume: bool = False
                       ) -> Tuple[LinearState, list]:
     """Out-of-core variant of :func:`sgd_fit`: the dataset never has to fit
     in host RAM or HBM (the Criteo-1TB shape, BASELINE.md north star).
@@ -208,67 +317,173 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     compiled update program — static shapes, zero recompiles across the
     epoch.
 
+    With ``indices_key``/``values_key`` set the reader feeds **sparse**
+    batches — ``(rows, nnz)`` hashed index/value pairs scored against the
+    dense ``(num_features,)`` weight (the :func:`sgd_fit_sparse` layout);
+    ``features_key`` is ignored.  This is the Criteo ingest path: 2^20+
+    dims stream from disk without ever densifying.
+
     Unlike :func:`sgd_fit`, the READER owns the data layout:
     ``config.global_batch_size`` and ``config.seed`` are inert here — batch
     size is the reader's ``batch_rows`` and any shuffling must happen in the
     reader (e.g. shuffle when writing the cache, or shuffle segment order
     per epoch).
+
+    **Mid-epoch checkpoints** (``checkpoint`` + ``checkpoint_every_steps``):
+    on a 1TB pass one epoch is hours, so an epoch-boundary-only cut (the
+    ``iterate`` default) loses the whole pass on a crash — the reference
+    checkpoints *inside* a superstep for the same reason
+    (``checkpoint/Checkpoints.java:43-211``,
+    ``operator/HeadOperator.java:323-335``).  Every
+    ``checkpoint_every_steps`` batches the (params, loss accumulator,
+    reader cursor) triple is cut; ``resume=True`` restarts exactly at that
+    batch: the reader is re-seeked (``seek``/``batch_rows`` protocol — the
+    ``DataCacheReader`` surface — or by skipping batches) and the epoch
+    continues as if never interrupted — deterministic-replay exactness is
+    asserted in tests/test_checkpoint.py.
     """
     mesh = mesh or default_mesh()
     n_dev = int(mesh.shape["data"])
-    update = _linear_update(loss_fn, config)
+    sparse = indices_key is not None
+    if sparse and values_key is None:
+        raise ValueError("indices_key requires values_key")
+    update = (_sparse_update if sparse else _linear_update)(loss_fn, config)
     batch_step = jax.jit(update, donate_argnums=0)
+
+    manager: Optional[CheckpointManager] = None
+    if isinstance(checkpoint, CheckpointManager):
+        manager = checkpoint
+    elif isinstance(checkpoint, CheckpointConfig):
+        manager = CheckpointManager(checkpoint)
 
     x_sh = NamedSharding(mesh, P("data", None))
     v_sh = NamedSharding(mesh, P("data"))
-    sharding = (x_sh, v_sh, v_sh)
+    sharding = (x_sh, x_sh, v_sh, v_sh) if sparse else (x_sh, v_sh, v_sh)
     batch_rows: list = []   # fixed after first batch
 
-    def to_host_triplet(batch):
-        X = np.asarray(batch[features_key], np.float32)
+    def _pad_rows(arrs, rows):
+        have = arrs[0].shape[0]
+        if have > rows:
+            raise ValueError(
+                f"reader produced a growing batch ({have} rows after "
+                f"{rows}); fixed-size batches are required")
+        if have == rows:
+            return arrs
+        return tuple(
+            np.concatenate(
+                [a, np.zeros((rows - have,) + a.shape[1:], a.dtype)])
+            for a in arrs)
+
+    def to_host_batch(batch):
+        if sparse:
+            from .linear import check_sparse_indices
+
+            idx = np.asarray(batch[indices_key], np.int32)
+            check_sparse_indices(idx, num_features)
+            feats = (idx, np.asarray(batch[values_key], np.float32))
+        else:
+            feats = (np.asarray(batch[features_key], np.float32),)
         y = np.asarray(batch[label_key], np.float32)
         w = (np.asarray(batch[weight_key], np.float32) if weight_key
-             else np.ones((X.shape[0],), np.float32))
+             else np.ones((y.shape[0],), np.float32))
         if not batch_rows:
-            rows = X.shape[0]
+            rows = y.shape[0]
             rows += (-rows) % n_dev   # data-axis divisibility
             batch_rows.append(rows)
-        rows = batch_rows[0]
-        if X.shape[0] > rows:
-            raise ValueError(
-                f"reader produced a growing batch ({X.shape[0]} rows after "
-                f"{rows}); fixed-size batches are required")
-        if X.shape[0] < rows:       # final partial batch: pad, weight 0
-            pad = rows - X.shape[0]
-            X = np.concatenate([X, np.zeros((pad, X.shape[1]), X.dtype)])
-            y = np.concatenate([y, np.zeros((pad,), y.dtype)])
-            w = np.concatenate([w, np.zeros((pad,), w.dtype)])
-        return X, y, w
+        # final partial batch: pad, weight 0
+        return _pad_rows(feats + (y, w), batch_rows[0])
 
     params = replicate(
         {"w": jnp.zeros((num_features,), jnp.float32),
          "b": jnp.zeros((), jnp.float32)}, mesh)
     loss_log: list = []
     prev_loss = float("inf")
+    start_epoch = 0
+    skip_steps = 0          # batches already consumed in start_epoch
+    resume_loss_sum = None  # their accumulated loss
+    resume_n_batches = 0
+    global_step = 0         # checkpoint tick: total batches over all epochs
     add = jax.jit(jnp.add)
-    for _epoch in range(config.max_epochs):
+
+    if manager is not None and resume:
+        restored = manager.restore_latest()
+        if restored is not None:
+            # NOTE: restored[0] is meta["epoch"] — the manager's save-slot
+            # key, which our "train_epoch" meta key deliberately does NOT
+            # collide with: the slot key is the global step, so post-resume
+            # saves keep ascending and GC never deletes newer checkpoints.
+            global_step, saved, meta = restored
+            params = replicate(jax.tree_util.tree_map(jnp.asarray,
+                                                      saved["params"]), mesh)
+            start_epoch = int(meta["train_epoch"])
+            skip_steps = int(meta["step_in_epoch"])
+            resume_n_batches = int(meta["n_batches"])
+            if resume_n_batches:
+                resume_loss_sum = jnp.asarray(saved["loss_sum"], jnp.float32)
+            prev_loss = float(meta["prev_loss"])
+            loss_log = list(meta["loss_log"])
+            if meta.get("converged"):
+                # The checkpointed run had already hit the tol stop:
+                # continuing would train past the converged answer.
+                host = jax.device_get(saved["params"])
+                return LinearState(np.asarray(host["w"], np.float64),
+                                   float(host["b"])), loss_log
+
+    def _save(epoch, step_in_epoch, loss_sum, n_batches, converged=False):
+        manager.save(global_step, {
+            "params": params,
+            "loss_sum": (loss_sum if loss_sum is not None
+                         else jnp.zeros((), jnp.float32)),
+        }, {
+            "train_epoch": epoch, "step_in_epoch": step_in_epoch,
+            "n_batches": n_batches, "prev_loss": prev_loss,
+            "loss_log": loss_log, "converged": converged,
+        })
+
+    for epoch in range(start_epoch, config.max_epochs):
+        reader = make_reader()
+        if epoch == start_epoch and skip_steps:
+            # Fast-forward to the checkpointed cursor: seek when the reader
+            # speaks the DataCacheReader protocol, else discard batches.
+            if hasattr(reader, "seek") and hasattr(reader, "batch_rows"):
+                reader.seek(min(skip_steps * reader.batch_rows,
+                                reader.total_rows))
+            else:
+                reader = iter(reader)
+                for _ in range(skip_steps):
+                    next(reader)
+        if not batch_rows and hasattr(reader, "batch_rows"):
+            rows = int(reader.batch_rows)
+            batch_rows.append(rows + (-rows) % n_dev)
+
         # Running on-device sum: memory stays flat over millions of batches
         # (a list of live per-batch scalars would grow O(n_batches)).
-        loss_sum = None
-        n_batches = 0
-        for xb, yb, wb in prefetch_to_device(
-                make_reader(), depth=prefetch_depth,
-                transform=to_host_triplet, sharding=sharding):
-            params, value = batch_step(params, xb, yb, wb)
+        loss_sum = resume_loss_sum
+        n_batches = resume_n_batches
+        step_in_epoch = skip_steps
+        resume_loss_sum, resume_n_batches, skip_steps = None, 0, 0
+        for dev_batch in prefetch_to_device(
+                reader, depth=prefetch_depth,
+                transform=to_host_batch, sharding=sharding):
+            params, value = batch_step(params, *dev_batch)
             loss_sum = value if loss_sum is None else add(loss_sum, value)
             n_batches += 1
+            step_in_epoch += 1
+            global_step += 1
+            if (manager is not None and checkpoint_every_steps > 0
+                    and step_in_epoch % checkpoint_every_steps == 0):
+                _save(epoch, step_in_epoch, loss_sum, n_batches)
         if loss_sum is None:
             raise ValueError("make_reader() returned an empty epoch")
         epoch_loss = float(jax.device_get(loss_sum)) / n_batches
         loss_log.append(epoch_loss)
-        if config.tol > 0 and abs(prev_loss - epoch_loss) <= config.tol:
+        stop = config.tol > 0 and abs(prev_loss - epoch_loss) <= config.tol
+        if not stop:
+            prev_loss = epoch_loss
+        if manager is not None:
+            _save(epoch + 1, 0, None, 0, converged=stop)  # epoch-boundary cut
+        if stop:
             break
-        prev_loss = epoch_loss
     params = jax.device_get(params)
     return LinearState(np.asarray(params["w"], np.float64),
                        float(params["b"])), loss_log

@@ -158,10 +158,23 @@ class IDF(HasOutputCol, HasFeaturesCol, Estimator[IDFModel]):
 class FeatureHasher(HasOutputCol, HasInputCols, Transformer):
     """Hash arbitrary columns into one fixed-size vector: numeric columns
     add their value at ``hash(colName)``, categorical/string columns add 1
-    at ``hash(colName=value)`` (the classic hashing trick)."""
+    at ``hash(colName=value)`` (the classic hashing trick).
+
+    With ``set_sparse_output(True)`` the transform never densifies: it emits
+    the hashed PAIR columns ``{outputCol}_indices (n, n_cols) int32`` and
+    ``{outputCol}_values (n, n_cols) float32`` — one active slot per input
+    column — which the linear family scores directly against a dense weight
+    (``models/common/linear.py::resolve_features``).  This is what makes
+    2^20+ hash spaces (the Criteo shape) usable: the dense form would be an
+    ``(n, 2^20)`` matrix.  Within-row slot collisions stay as separate pair
+    entries; gather/scatter sums them, matching the dense semantics."""
 
     NUM_FEATURES = IntParam("numFeatures", "Hash-space size.", default=256,
                             validator=ParamValidators.gt(0))
+    SPARSE_OUTPUT = BoolParam(
+        "sparseOutput",
+        "Emit {outputCol}_indices/{outputCol}_values pair columns instead "
+        "of a dense matrix.", default=False)
 
     def get_num_features(self) -> int:
         return self.get(FeatureHasher.NUM_FEATURES)
@@ -169,23 +182,49 @@ class FeatureHasher(HasOutputCol, HasInputCols, Transformer):
     def set_num_features(self, value: int):
         return self.set(FeatureHasher.NUM_FEATURES, value)
 
+    def set_sparse_output(self, value: bool):
+        return self.set(FeatureHasher.SPARSE_OUTPUT, value)
+
+    def _hash_columns(self, table: Table, in_cols, m: int):
+        """Per input column: (slot indices (n,), float64 values (n,)).
+        Categorical columns hash each distinct value once (np.unique +
+        inverse) instead of per row.  Values stay float64 here; only the
+        device-facing sparse pair output downcasts to f32."""
+        n = table.num_rows
+        idx_cols, val_cols = [], []
+        for col in in_cols:
+            values = np.asarray(table[col])
+            if np.issubdtype(values.dtype, np.number):
+                idx_cols.append(np.full((n,), _fnv1a(col) % m, np.int32))
+                val_cols.append(values.astype(np.float64))
+            else:
+                uniq, inverse = np.unique(values, return_inverse=True)
+                slots = np.asarray([_fnv1a(f"{col}={u}") % m for u in uniq],
+                                   np.int32)
+                idx_cols.append(slots[inverse])
+                val_cols.append(np.ones((n,), np.float64))
+        return idx_cols, val_cols
+
     def transform(self, *inputs) -> List[Table]:
         (table,) = inputs
         in_cols = self.get_input_cols()
         if not in_cols:
             raise ValueError("FeatureHasher requires inputCols")
         m = self.get_num_features()
-        n = table.num_rows
-        out = np.zeros((n, m), np.float64)
-        for col in in_cols:
-            values = np.asarray(table[col])
-            if np.issubdtype(values.dtype, np.number):
-                slot = _fnv1a(col) % m
-                out[:, slot] += values.astype(np.float64)
-            else:
-                for i, v in enumerate(values):
-                    out[i, _fnv1a(f"{col}={v}") % m] += 1.0
-        return [table.with_column(self.get_output_col(), out)]
+        idx_cols, val_cols = self._hash_columns(table, in_cols, m)
+        out_col = self.get_output_col()
+        if self.get(FeatureHasher.SPARSE_OUTPUT):
+            return [table
+                    .with_column(f"{out_col}_indices",
+                                 np.stack(idx_cols, axis=1))
+                    .with_column(f"{out_col}_values",
+                                 np.stack(val_cols, axis=1)
+                                 .astype(np.float32))]
+        out = np.zeros((table.num_rows, m), np.float64)
+        rows = np.arange(table.num_rows)
+        for idx, vals in zip(idx_cols, val_cols):
+            np.add.at(out, (rows, idx), vals)
+        return [table.with_column(out_col, out)]
 
     def save(self, path: str) -> None:
         persist.save_metadata(self, path)

@@ -198,6 +198,20 @@ class HasGlobalBatchSize(WithParams):
         return self.set(HasGlobalBatchSize.GLOBAL_BATCH_SIZE, value)
 
 
+class HasNumFeatures(WithParams):
+    NUM_FEATURES = IntParam(
+        "numFeatures",
+        "Feature-space size for hashed sparse input (pair columns); 0 = "
+        "derive from the data (dense input or SparseVector.size).",
+        default=0, validator=ParamValidators.gt_eq(0))
+
+    def get_num_features(self) -> int:
+        return self.get(HasNumFeatures.NUM_FEATURES)
+
+    def set_num_features(self, value: int):
+        return self.set(HasNumFeatures.NUM_FEATURES, value)
+
+
 class HasBatchStrategy(WithParams):
     BATCH_STRATEGY = StringParam(
         "batchStrategy", "Mini-batch strategy.", default="count",

@@ -250,3 +250,130 @@ def test_elastic_restore_across_mesh_sizes(tmp_path):
                       checkpoint=CheckpointConfig(ckpt), resume=True)
     assert resumed.num_epochs == 6
     assert float(resumed.state) == 6 * np.arange(32).sum()
+
+
+# ------------------------------------------------- mid-epoch (step) cuts
+
+
+class _FailingReader:
+    """DataCacheReader wrapper that dies after N read_batch calls across the
+    whole run (the analog of the reference's FailingMap fault injection,
+    ``flink-ml-tests/.../operators/FailingMap.java``)."""
+
+    fail_counter = None  # class-level so the count survives re-creation
+
+    def __init__(self, inner, fail_after):
+        self._inner = inner
+        self._fail_after = fail_after
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __iter__(self):
+        while True:
+            if _FailingReader.fail_counter is not None:
+                _FailingReader.fail_counter += 1
+                if _FailingReader.fail_counter > self._fail_after:
+                    raise RuntimeError("injected mid-epoch failure")
+            b = self._inner.read_batch()
+            if b is None:
+                return
+            yield b
+
+
+def _lr_cache(tmp_path, name, n=1536, d=8, seed=7):
+    from flink_ml_tpu.data.datacache import DataCacheWriter
+
+    rng = np.random.default_rng(seed)
+    true_w = rng.normal(size=(d,))
+    cache = str(tmp_path / name)
+    writer = DataCacheWriter(cache, segment_rows=512)
+    for _ in range(n // 512):
+        X = rng.normal(size=(512, d)).astype(np.float32)
+        writer.append({"features": X,
+                       "label": (X @ true_w > 0).astype(np.float32)})
+    writer.finish()
+    return cache
+
+
+def test_outofcore_midepoch_kill_and_resume_exact(tmp_path):
+    """A crash mid-pass resumes from the step-granular cut and lands on
+    EXACTLY the uninterrupted run's parameters (deterministic replay: the
+    exactly-once equivalence the reference gets from its in-flight feedback
+    log, ``checkpoint/Checkpoints.java:43-211``)."""
+    from flink_ml_tpu.data.datacache import DataCacheReader
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    cache = _lr_cache(tmp_path, "c1")
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=4, tol=0.0)
+    # 1536 rows / 256 = 6 batches per epoch
+
+    def reader():
+        return DataCacheReader(cache, batch_rows=256)
+
+    ref_state, ref_log = sgd_fit_outofcore(
+        logistic_loss, reader, num_features=8, config=cfg)
+
+    # run 2: checkpoint every 2 steps, die mid-epoch-2 (batch 15 overall)
+    ckpt = CheckpointConfig(str(tmp_path / "ck"), max_to_keep=3)
+    _FailingReader.fail_counter = 0
+    with pytest.raises(RuntimeError, match="injected"):
+        sgd_fit_outofcore(
+            logistic_loss, lambda: _FailingReader(reader(), 15),
+            num_features=8, config=cfg,
+            checkpoint=ckpt, checkpoint_every_steps=2)
+    _FailingReader.fail_counter = None
+
+    resumed_state, resumed_log = sgd_fit_outofcore(
+        logistic_loss, reader, num_features=8, config=cfg,
+        checkpoint=ckpt, checkpoint_every_steps=2, resume=True)
+
+    np.testing.assert_array_equal(resumed_state.coefficients,
+                                  ref_state.coefficients)
+    assert resumed_state.intercept == ref_state.intercept
+    np.testing.assert_array_equal(resumed_log, ref_log)
+
+
+def test_outofcore_midepoch_resume_without_seek_protocol(tmp_path):
+    """Readers without seek/batch_rows (plain generators) fast-forward by
+    skipping batches; the result is still exact."""
+    from flink_ml_tpu.data.datacache import DataCacheReader
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    cache = _lr_cache(tmp_path, "c2")
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=3, tol=0.0)
+
+    def gen_reader():
+        # strips the DataCacheReader protocol down to a bare generator
+        def gen():
+            yield from DataCacheReader(cache, batch_rows=256)
+        return gen()
+
+    ref_state, _ = sgd_fit_outofcore(
+        logistic_loss, gen_reader, num_features=8, config=cfg)
+
+    ckpt = CheckpointConfig(str(tmp_path / "ck2"), max_to_keep=3)
+    _FailingReader.fail_counter = 0
+
+    def failing_gen_reader():
+        def gen():
+            for b in DataCacheReader(cache, batch_rows=256):
+                _FailingReader.fail_counter += 1
+                if _FailingReader.fail_counter > 9:
+                    raise RuntimeError("injected mid-epoch failure")
+                yield b
+        return gen()
+
+    with pytest.raises(RuntimeError, match="injected"):
+        sgd_fit_outofcore(
+            logistic_loss, failing_gen_reader, num_features=8, config=cfg,
+            checkpoint=ckpt, checkpoint_every_steps=2)
+    _FailingReader.fail_counter = None
+
+    resumed_state, _ = sgd_fit_outofcore(
+        logistic_loss, gen_reader, num_features=8, config=cfg,
+        checkpoint=ckpt, checkpoint_every_steps=2, resume=True)
+    np.testing.assert_array_equal(resumed_state.coefficients,
+                                  ref_state.coefficients)

@@ -133,6 +133,52 @@ def test_sgd_outofcore_empty_reader_rejected():
             config=SGDConfig(max_epochs=2))
 
 
+def _write_sparse_cache(tmp_path, n=2048, d=1 << 18, nnz=6, seed=0):
+    """Hashed-pair rows on disk (the Criteo ingest shape)."""
+    rng = np.random.default_rng(seed)
+    cache = str(tmp_path / "sparse_cache")
+    writer = DataCacheWriter(cache, segment_rows=1024)
+    for start in range(0, n, 512):
+        idx = rng.integers(4, d, size=(512, nnz)).astype(np.int32)
+        y = rng.integers(0, 2, size=512).astype(np.float32)
+        idx[:, 0] = np.where(y == 1, 1, 2)  # marker slots
+        writer.append({"features_indices": idx,
+                       "features_values": np.ones((512, nnz), np.float32),
+                       "label": y})
+    writer.finish()
+    return cache
+
+
+def test_sgd_outofcore_sparse_converges(tmp_path):
+    cache = _write_sparse_cache(tmp_path)
+    d = 1 << 18
+
+    state, loss_log = sgd_fit_outofcore(
+        logistic_loss,
+        lambda: DataCacheReader(cache, batch_rows=256),
+        num_features=d,
+        indices_key="features_indices", values_key="features_values",
+        config=SGDConfig(learning_rate=1.0, max_epochs=5, tol=0.0))
+    assert state.coefficients.shape == (d,)
+    assert loss_log[-1] < loss_log[0] * 0.5
+    assert state.coefficients[1] > 0 > state.coefficients[2]
+
+
+def test_estimator_fit_outofcore_sparse(tmp_path):
+    cache = _write_sparse_cache(tmp_path, n=1024)
+    d = 1 << 18
+    model = (LogisticRegression().set_learning_rate(1.0).set_max_iter(4)
+             .set_tol(0.0)
+             .fit_outofcore(
+                 lambda: DataCacheReader(cache, batch_rows=256),
+                 num_features=d, sparse=True))
+    reader = DataCacheReader(cache, batch_rows=1024)
+    batch = reader.read_batch()
+    t = Table(batch)
+    pred = np.asarray(model.transform(t)[0]["prediction"])
+    assert (pred == batch["label"]).mean() > 0.95
+
+
 def test_estimator_fit_outofcore_matches_inmemory_quality(tmp_path):
     cache, _ = _write_lr_cache(tmp_path, n=2048)
     reader = DataCacheReader(cache, batch_rows=256)
