@@ -1,2 +1,3 @@
 from .prefetch import prefetch_to_device  # noqa: F401
+from .stream import CountWindows, EventTimeWindows, windows_of  # noqa: F401
 from .table import Table  # noqa: F401

@@ -250,6 +250,7 @@ def iterate(
     max_epochs: Optional[int] = None,
     listeners: Sequence[IterationListener] = (),
     per_round_init: Optional[Callable[[], Any]] = None,
+    per_round: Optional[Sequence[str]] = None,
     checkpoint: Optional[Union[CheckpointConfig, CheckpointManager]] = None,
     resume: bool = False,
 ) -> IterationResult:
@@ -262,6 +263,16 @@ def iterate(
     ``Iterations.java:69-83``: state entering epoch ``e`` produces the state
     for epoch ``e+1`` (the feedback edge increments the epoch).
 
+    **Mixed lifecycle** (``per_round=``): the ``IterationBody.forEachRound``
+    analog (``IterationBody.java:73-91``) — name top-level keys of a dict
+    state that are re-initialised from ``initial_state`` at the start of
+    every epoch while the rest of the state is carried.  Where the reference
+    builds a per-round sub-graph whose operators are recreated and scrubbed
+    each round (``BoundedMixedLifeCycleStreamIterationITCase``), here the
+    named subtree simply re-enters each epoch at its initial value — the
+    final result keeps the LAST round's values (what ``forEachRound``'s
+    output forwarding yields).  Works in both fused and hosted modes.
+
     Termination: ``max_epochs`` reached, OR the body's ``termination`` vote
     is zero/false, OR an iterator data source is exhausted.
     """
@@ -269,9 +280,30 @@ def iterate(
     if max_epochs is not None:
         config = dataclasses.replace(config, max_epochs=max_epochs)
 
+    if per_round:
+        if not isinstance(initial_state, dict):
+            raise TypeError(
+                "per_round= names top-level dict keys; state is "
+                f"{type(initial_state).__name__}")
+        missing = [k for k in per_round if k not in initial_state]
+        if missing:
+            raise KeyError(f"per_round keys {missing} not in state "
+                           f"{list(initial_state)}")
+        reset_subtree = {k: _private_copy(initial_state[k])
+                        for k in per_round}
+        inner_body = body
+
+        def body(state, epoch, *rest):  # noqa: F811
+            # Re-entering each epoch at the initial value IS the per-round
+            # re-init; at epoch 0 this is a no-op by construction.
+            return _call_body(inner_body, {**state, **reset_subtree},
+                              epoch, rest[0] if rest else None)
+
     provider = _DataProvider(data)
-    per_round = config.lifecycle == OperatorLifeCycle.PER_ROUND
-    if per_round and per_round_init is None:
+    # NOTE: distinct from the per_round= KEY LIST above — this is the
+    # whole-state PER_ROUND lifecycle flag from IterationConfig.
+    per_round_lifecycle = config.lifecycle == OperatorLifeCycle.PER_ROUND
+    if per_round_lifecycle and per_round_init is None:
         # Default per-round re-init: restart every epoch from initial_state.
         init_copy = initial_state
         per_round_init = lambda: init_copy  # noqa: E731
@@ -279,7 +311,7 @@ def iterate(
     mode = config.mode
     if mode == "auto":
         fusible = (provider.is_static and not listeners and checkpoint is None
-                   and not per_round and config.jit
+                   and not per_round_lifecycle and config.jit
                    and config.max_epochs is not None)
         if fusible:
             # Criteria-driven fused loops keep only the LAST epoch's outputs
@@ -296,7 +328,8 @@ def iterate(
     if mode == "fused":
         return _iterate_fused(body, initial_state, provider, config)
     return _iterate_hosted(body, initial_state, provider, config, listeners,
-                           per_round, per_round_init, checkpoint, resume)
+                           per_round_lifecycle, per_round_init, checkpoint,
+                           resume)
 
 
 # ---------------------------------------------------------------------------
@@ -374,9 +407,10 @@ def _iterate_fused(body: BodyFn, initial_state, provider: _DataProvider,
 def _iterate_hosted(body: BodyFn, initial_state, provider: _DataProvider,
                     config: IterationConfig,
                     listeners: Sequence[IterationListener],
-                    per_round: bool, per_round_init,
+                    per_round_lifecycle: bool, per_round_init,
                     checkpoint, resume: bool) -> IterationResult:
-    donating = config.jit and config.donate_state and not per_round
+    donating = (config.jit and config.donate_state
+                and not per_round_lifecycle)
     if config.jit:
         # Donating the state argument keeps HBM flat across epochs: the new
         # feedback pytree reuses the old buffers (the in-place feedback edge).
@@ -422,7 +456,7 @@ def _iterate_hosted(body: BodyFn, initial_state, provider: _DataProvider,
             if provider.exhausted:
                 terminated_reason = "stream_end"
                 break
-            if per_round and epoch > start_epoch:
+            if per_round_lifecycle and epoch > start_epoch:
                 state = per_round_init()
             res = step(state, jnp.asarray(epoch, jnp.int32), epoch_data)
             state = res.feedback

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...api.stage import Estimator
+from ...data.stream import windows_of
 from ...data.table import Table
 from ...iteration import (
     EpochContext,
@@ -115,12 +116,8 @@ class OnlineLogisticRegression(HasFeaturesCol, HasLabelCol, HasWeightCol,
                 return ("sparse", (idx, vals), y, w, dim)
             return ("dense", feats.astype(np.float32), y, w, 0)
 
-        if isinstance(source, Table):
-            for b in source.batches(batch):
-                yield extract(b)
-        else:
-            for t in source:
-                yield extract(t)
+        for t in windows_of(source, batch):
+            yield extract(t)
 
     def fit(self, *inputs) -> OnlineLogisticRegressionModel:
         """``fit(stream)`` where stream is a Table (windowed by

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...api.stage import Estimator
+from ...data.stream import windows_of
 from ...data.table import Table
 from ...distance import DistanceMeasure
 from ...iteration import (
@@ -93,8 +94,7 @@ class OnlineKMeans(KMeansParams, Estimator[OnlineKMeansModel]):
         measure = DistanceMeasure.get_instance(self.get_distance_measure())
         feat = self.get_features_col()
 
-        batches = iter(source) if not isinstance(source, Table) else iter(
-            source.batches(max(k, 256)))
+        batches = windows_of(source, max(k, 256))
         first = next(batches, None)
         if first is None:
             raise ValueError("OnlineKMeans.fit got an empty stream")

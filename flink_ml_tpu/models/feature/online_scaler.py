@@ -17,6 +17,7 @@ from __future__ import annotations
 import numpy as np
 
 from ...api.stage import Estimator
+from ...data.stream import windows_of
 from ...data.table import Table
 from ...linalg import stack_vectors
 from ...utils import persist
@@ -73,8 +74,7 @@ class OnlineStandardScaler(StandardScalerParams,
         (consumed as batches).  Returns when the stream ends."""
         (source,) = inputs
         feat = self.get_features_col()
-        batches = iter(source) if not isinstance(source, Table) else iter(
-            source.batches(4096))
+        batches = windows_of(source, 4096)
 
         count = 0.0
         mean = None

@@ -287,6 +287,45 @@ class WideDeepModel(WideDeepParams, Model):
         return model
 
 
+def build_reference_train_step(d_dense: int, vocab_sizes, emb_dim: int,
+                               hidden, lr: float = 1e-2):
+    """The unsharded single-device oracle for :func:`build_sharded_train_step`
+    — SAME init seed (0), optimizer, and loss, no shardings anywhere.
+    Returns (train_step, params, opt_state).  The dp x tp step must
+    reproduce this one allclose on loss AND updated params (a wrong
+    psum/axis placement still converges, so only exact equivalence catches
+    it); asserted by tests/test_widedeep.py and __graft_entry__'s multichip
+    dryrun."""
+    params = jax.tree_util.tree_map(
+        jnp.asarray,
+        init_params(np.random.default_rng(0), d_dense, vocab_sizes, emb_dim,
+                    hidden))
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+    grad_fn = jax.value_and_grad(bce_loss)
+
+    @jax.jit
+    def train_step(params, opt_state, dense, cat_ids, labels, mask):
+        loss, grads = grad_fn(params, dense, cat_ids, labels, mask)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return train_step, params, opt_state
+
+
+def assert_sharded_matches_reference(sharded_params, sharded_loss,
+                                     ref_params, ref_loss) -> None:
+    """Allclose on loss and every param leaf (f32 tolerances: cross-device
+    reduction order differs from the single-device program)."""
+    np.testing.assert_allclose(float(np.asarray(sharded_loss)),
+                               float(np.asarray(ref_loss)),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(sharded_params)),
+                    jax.tree_util.tree_leaves(jax.device_get(ref_params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def build_sharded_train_step(mesh, d_dense: int, vocab_sizes, emb_dim: int,
                              hidden, lr: float = 1e-2):
     """A dp x tp training step for the multichip dry run: embeddings and MLP

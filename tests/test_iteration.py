@@ -261,3 +261,57 @@ def test_replayed_marker_is_fusible():
                   jnp.asarray(0.0), data, max_epochs=3,
                   config=IterationConfig(mode="fused"))
     assert float(res.state) == 18.0
+
+
+# ----------------------------------------------- mixed lifecycle (forEachRound)
+
+
+def test_mixed_lifecycle_per_round_subtree():
+    """Part of the state is per-round (re-initialised each epoch), part is
+    carried — the ``IterationBody.forEachRound`` analog, semantics mirroring
+    ``BoundedMixedLifeCycleStreamIterationITCase.java``: an all-round
+    running reduce feeds a per-round accumulator that must start fresh every
+    round."""
+    data = jnp.arange(4.0)
+
+    def body(state, epoch, d):
+        # per-round scratch starts at 0 every epoch; if it carried, round_sum
+        # would accumulate across rounds and the asserts below would fail
+        round_sum = state["scratch"] + jnp.sum(d) + state["carried"]
+        return IterationBodyResult(
+            {"carried": state["carried"] + 1.0, "scratch": round_sum},
+            outputs=round_sum)
+
+    init = {"carried": jnp.asarray(0.0), "scratch": jnp.asarray(0.0)}
+    result = iterate(body, init, data, max_epochs=4, per_round=("scratch",),
+                     config=IterationConfig(mode="hosted"))
+    # round e: scratch re-enters at 0, carried enters at e -> output 6 + e
+    assert [float(o) for o in result.outputs] == [6.0, 7.0, 8.0, 9.0]
+    assert float(result.state["carried"]) == 4.0
+    # final state keeps the LAST round's per-round value (forEachRound output)
+    assert float(result.state["scratch"]) == 9.0
+
+
+def test_mixed_lifecycle_fused_matches_hosted():
+    data = jnp.arange(3.0)
+
+    def body(state, epoch, d):
+        s = state["tmp"] + jnp.sum(d)
+        return IterationBodyResult({"acc": state["acc"] + s, "tmp": s})
+
+    init = {"acc": jnp.asarray(0.0), "tmp": jnp.asarray(0.0)}
+    hosted = iterate(body, init, data, max_epochs=5, per_round=("tmp",),
+                     config=IterationConfig(mode="hosted"))
+    fused = iterate(body, init, data, max_epochs=5, per_round=("tmp",),
+                    config=IterationConfig(mode="fused"))
+    assert float(hosted.state["acc"]) == float(fused.state["acc"]) == 15.0
+    assert float(fused.state["tmp"]) == 3.0
+
+
+def test_mixed_lifecycle_validates_keys():
+    with pytest.raises(KeyError, match="nope"):
+        iterate(lambda s, e: s, {"a": jnp.asarray(0.0)}, max_epochs=1,
+                per_round=("nope",))
+    with pytest.raises(TypeError, match="dict"):
+        iterate(lambda s, e: s, jnp.asarray(0.0), max_epochs=1,
+                per_round=("a",))

@@ -1,0 +1,156 @@
+"""Streaming substrate (data/stream.py): count/event-time windows, watermark
+close, snapshot/restore cursors, and the shared online-model ingest."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.data.stream import CountWindows, EventTimeWindows, windows_of
+
+
+def _t(n, start=0):
+    return Table({"x": np.arange(start, start + n, dtype=np.float64)})
+
+
+# ------------------------------------------------------------ CountWindows
+
+
+def test_count_windows_over_table_flushes_tail():
+    windows = list(CountWindows(_t(10), 4))
+    assert [w.num_rows for w in windows] == [4, 4, 2]
+    np.testing.assert_array_equal(np.asarray(windows[2]["x"]), [8.0, 9.0])
+
+
+def test_count_windows_rechunks_live_feed_across_table_boundaries():
+    feed = [_t(3, 0), _t(5, 3), _t(2, 8)]  # 10 rows in ragged tables
+    windows = list(CountWindows(iter(feed), 4))
+    assert [w.num_rows for w in windows] == [4, 4, 2]
+    np.testing.assert_array_equal(np.asarray(windows[0]["x"]), [0, 1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(windows[1]["x"]), [4, 5, 6, 7])
+
+
+def test_count_windows_table_cursor_snapshot_restore():
+    src = CountWindows(_t(10), 4)
+    it = iter(src)
+    next(it)
+    snap = src.snapshot()
+    assert snap == {"cursor": 4}
+    fresh = CountWindows(_t(10), 4)
+    fresh.restore(snap)
+    remaining = list(fresh)
+    assert [w.num_rows for w in remaining] == [4, 2]
+    np.testing.assert_array_equal(np.asarray(remaining[0]["x"]),
+                                  [4, 5, 6, 7])
+
+
+def test_count_windows_feed_restore_skips_windows():
+    def feed():
+        yield _t(4, 0)
+        yield _t(4, 4)
+        yield _t(4, 8)
+
+    src = CountWindows(feed(), 4)
+    it = iter(src)
+    next(it), next(it)
+    snap = src.snapshot()
+    fresh = CountWindows(feed(), 4)
+    fresh.restore(snap)
+    remaining = list(fresh)
+    assert len(remaining) == 1
+    np.testing.assert_array_equal(np.asarray(remaining[0]["x"]),
+                                  [8, 9, 10, 11])
+
+
+def test_count_windows_validates_size():
+    with pytest.raises(ValueError, match="positive"):
+        CountWindows(_t(4), 0)
+
+
+# -------------------------------------------------------- EventTimeWindows
+
+
+def _timed(ts, vals=None):
+    ts = np.asarray(ts, np.float64)
+    return Table({"ts": ts,
+                  "v": np.asarray(vals if vals is not None else ts)})
+
+
+def test_event_time_tumbling_windows_close_on_watermark():
+    # windows of size 10; rows arrive slightly out of order within windows
+    stream = [_timed([1, 5, 3]), _timed([12, 8]), _timed([25])]
+    out = list(EventTimeWindows(stream, "ts", 10.0))
+    # window [0,10) closes when watermark (max ts) reaches 10 -> after t=12
+    # window [10,20) closes when ts=25 arrives; [20,30) flushes at stream end
+    assert len(out) == 3
+    np.testing.assert_array_equal(sorted(np.asarray(out[0]["ts"])),
+                                  [1, 3, 5, 8])
+    np.testing.assert_array_equal(np.asarray(out[1]["ts"]), [12])
+    np.testing.assert_array_equal(np.asarray(out[2]["ts"]), [25])
+
+
+def test_event_time_late_rows_dropped():
+    # ts=2 arrives after the watermark passed 10 -> dropped
+    stream = [_timed([1, 11]), _timed([2, 13])]
+    out = list(EventTimeWindows(stream, "ts", 10.0))
+    all_ts = np.concatenate([np.asarray(w["ts"]) for w in out])
+    assert 2.0 not in all_ts
+    assert {1.0, 11.0, 13.0} <= set(all_ts)
+
+
+def test_event_time_allowed_lateness_keeps_late_rows():
+    stream = [_timed([1, 11]), _timed([2, 13])]
+    out = list(EventTimeWindows(stream, "ts", 10.0, allowed_lateness=20.0))
+    all_ts = np.concatenate([np.asarray(w["ts"]) for w in out])
+    assert 2.0 in all_ts
+
+
+def test_event_time_snapshot_restore_skips_emitted():
+    stream = lambda: [_timed([1, 5]), _timed([12]), _timed([25])]  # noqa: E731
+    src = EventTimeWindows(stream(), "ts", 10.0)
+    it = iter(src)
+    first = next(it)
+    snap = src.snapshot()
+    fresh = EventTimeWindows(stream(), "ts", 10.0)
+    fresh.restore(snap)
+    remaining = list(fresh)
+    assert len(remaining) == 2
+    assert float(np.asarray(first["ts"]).max()) < float(
+        np.asarray(remaining[0]["ts"]).min())
+
+
+# -------------------------------------------------------------- windows_of
+
+
+def test_windows_of_table_and_feed_and_windows():
+    assert [w.num_rows for w in windows_of(_t(5), 2)] == [2, 2, 1]
+    # live feeds pass through unchanged (the feed's framing is the windowing)
+    feed = [_t(3), _t(5)]
+    assert [w.num_rows for w in windows_of(iter(feed), 2)] == [3, 5]
+    # an explicit windowing object is consumed as-is
+    assert [w.num_rows
+            for w in windows_of(CountWindows(_t(5), 4), 999)] == [4, 1]
+
+
+def test_online_models_consume_event_time_windows(rng):
+    """A time-windowed stream feeds an online estimator directly — the
+    shared substrate replaces per-model windowing."""
+    from flink_ml_tpu.models.feature import OnlineStandardScaler
+
+    X = rng.normal(size=(300, 3)) * 2.0 + 5.0
+    ts = np.arange(300, dtype=np.float64)
+    stream = EventTimeWindows(
+        [Table({"features": X[i:i + 50], "ts": ts[i:i + 50]})
+         for i in range(0, 300, 50)], "ts", 100.0)
+    model = OnlineStandardScaler().fit(stream)
+    got_mean = np.asarray(model.get_model_data()[0]["mean"][0])
+    np.testing.assert_allclose(got_mean, X.mean(axis=0), atol=1e-9)
+    assert model.model_version == 3  # three closed [0,100) windows
+
+
+def test_event_time_out_of_order_rows_join_open_windows():
+    # ts=12 arrives after ts=15 advanced the watermark; window [10,20) is
+    # still open, so 12 must join it (only CLOSED windows reject rows)
+    stream = [_timed([1, 15]), _timed([12])]
+    out = list(EventTimeWindows(stream, "ts", 10.0))
+    assert len(out) == 2
+    np.testing.assert_array_equal(sorted(np.asarray(out[1]["ts"])), [12, 15])

@@ -89,6 +89,42 @@ def test_sharded_train_step_dp_tp():
     assert p["emb"].sharding.spec == emb_sharding.spec
 
 
+def test_sharded_train_step_matches_single_device_oracle():
+    """THE dp x tp numerical oracle (VERDICT r1 task 4): the sharded train
+    step on the 8-device mesh must reproduce an unsharded single-device step
+    bit-for-tolerance — loss AND updated params over several steps.  Wrong
+    psum/axis placement still *converges*, which is why the loss-decreases
+    assert above cannot catch it; exact equivalence can."""
+    from flink_ml_tpu.models.recommendation.widedeep import (
+        assert_sharded_matches_reference,
+        build_reference_train_step,
+    )
+    from flink_ml_tpu.parallel.mesh import device_mesh
+
+    d_dense, vocab_sizes, emb_dim, hidden, lr = 4, [10, 7], 8, (16, 8), 1e-2
+    mesh = device_mesh({"data": 4, "model": 2})
+    train_step, params_s, opt, opt_state_s, shard_batch = \
+        build_sharded_train_step(mesh, d_dense=d_dense,
+                                 vocab_sizes=vocab_sizes, emb_dim=emb_dim,
+                                 hidden=hidden, lr=lr)
+    step_1, params_1, opt_state_1 = build_reference_train_step(
+        d_dense, vocab_sizes, emb_dim, hidden, lr)
+
+    rng = np.random.default_rng(1)
+    for step in range(3):
+        dense = rng.normal(size=(32, d_dense)).astype(np.float32)
+        cat = np.stack([rng.integers(0, 10, 32),
+                        10 + rng.integers(0, 7, 32)], 1).astype(np.int32)
+        labels = rng.integers(0, 2, 32).astype(np.float32)
+        mask = np.ones((32,), np.float32)
+
+        params_s, opt_state_s, loss_s = train_step(
+            params_s, opt_state_s, *shard_batch(dense, cat, labels, mask))
+        params_1, opt_state_1, loss_1 = step_1(
+            params_1, opt_state_1, dense, cat, labels, mask)
+        assert_sharded_matches_reference(params_s, loss_s, params_1, loss_1)
+
+
 def test_broadcast_utils():
     import jax.numpy as jnp
 
