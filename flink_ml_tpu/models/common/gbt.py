@@ -31,7 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["GBTConfig", "bin_features", "train_forest", "predict_forest",
-           "Forest"]
+           "Forest", "SoftmaxForest", "train_forest_softmax",
+           "predict_forest_softmax"]
 
 
 @dataclass
@@ -155,17 +156,72 @@ def _leaf_values(node_ids, grad, hess, n_nodes: int, reg_lambda: float):
     return -g / (h + reg_lambda)
 
 
+def _train_one_tree(binned, g, h, d: int, config: GBTConfig):
+    """Grow one tree against device gradients/hessians; returns the host
+    (feature, threshold, value) node rows plus the tree's DEVICE in-sample
+    prediction (margin scale, before learning-rate shrinkage)."""
+    n = binned.shape[0]
+    bins = config.max_bins
+    depth = config.max_depth
+    n_nodes_total = 2 ** (depth + 1) - 1
+    feature_row = np.full((n_nodes_total,), -1, np.int32)
+    threshold_row = np.zeros((n_nodes_total,), np.int32)
+    value_row = np.zeros((n_nodes_total,), np.float32)
+
+    node_ids = jnp.zeros((n,), jnp.int32)
+    level_feature: List[np.ndarray] = []
+    level_bin: List[np.ndarray] = []
+    level_gain: List[np.ndarray] = []
+    level_ids = [node_ids]
+    for level in range(depth):
+        n_nodes = 2 ** level
+        f, b, gain, node_ids = _build_level(
+            binned, node_ids, g, h, n_nodes, d, bins,
+            config.reg_lambda, config.min_child_weight)
+        level_feature.append(np.asarray(f))
+        level_bin.append(np.asarray(b))
+        level_gain.append(np.asarray(gain))
+        level_ids.append(node_ids)
+
+    # assemble the tree: internal nodes that actually split get
+    # (feature, threshold); everything else becomes a leaf holding the
+    # Newton value of the rows that stopped there
+    base = 0
+    for level in range(depth):
+        n_nodes = 2 ** level
+        split = level_gain[level] > 0
+        feature_row[base:base + n_nodes] = np.where(
+            split, level_feature[level], -1)
+        threshold_row[base:base + n_nodes] = level_bin[level]
+        # leaf value for rows that STOP at this level (their node did not
+        # split): computed from the ids entering the level
+        vals = np.asarray(_leaf_values(level_ids[level], g, h, n_nodes,
+                                       config.reg_lambda))
+        value_row[base:base + n_nodes] = np.where(split, 0.0, vals)
+        base += n_nodes
+    # deepest level: always leaves
+    n_nodes = 2 ** depth
+    vals = np.asarray(_leaf_values(level_ids[depth], g, h, n_nodes,
+                                   config.reg_lambda))
+    value_row[base:base + n_nodes] = vals
+
+    # in-sample update reuses the DEVICE binned copy — predicting from the
+    # host matrix would re-upload it once per tree
+    pred = _predict_tree_jit(binned, jnp.asarray(feature_row),
+                             jnp.asarray(threshold_row),
+                             jnp.asarray(value_row), depth)
+    return feature_row, threshold_row, value_row, pred
+
+
 def train_forest(X: np.ndarray, y: np.ndarray,
                  grad_hess: Callable[[np.ndarray, np.ndarray],
                                      Tuple[np.ndarray, np.ndarray]],
                  base_score: float, config: GBTConfig) -> Forest:
     """Boost ``num_trees`` trees against ``grad_hess(y, pred)``."""
     n, d = X.shape
-    bins = config.max_bins
-    binned_host, edges = bin_features(X, bins)
+    binned_host, edges = bin_features(X, config.max_bins)
     binned = jnp.asarray(binned_host)
-    depth = config.max_depth
-    n_nodes_total = 2 ** (depth + 1) - 1
+    n_nodes_total = 2 ** (config.max_depth + 1) - 1
 
     features = np.full((config.num_trees, n_nodes_total), -1, np.int32)
     thresholds = np.zeros((config.num_trees, n_nodes_total), np.int32)
@@ -174,55 +230,89 @@ def train_forest(X: np.ndarray, y: np.ndarray,
     pred = np.full((n,), base_score, np.float64)
     for t in range(config.num_trees):
         g, h = grad_hess(y, pred)
-        g = jnp.asarray(g, jnp.float32)
-        h = jnp.asarray(h, jnp.float32)
-        node_ids = jnp.zeros((n,), jnp.int32)
-
-        level_feature: List[np.ndarray] = []
-        level_bin: List[np.ndarray] = []
-        level_gain: List[np.ndarray] = []
-        level_ids = [node_ids]
-        for level in range(depth):
-            n_nodes = 2 ** level
-            f, b, gain, node_ids = _build_level(
-                binned, node_ids, g, h, n_nodes, d, bins,
-                config.reg_lambda, config.min_child_weight)
-            level_feature.append(np.asarray(f))
-            level_bin.append(np.asarray(b))
-            level_gain.append(np.asarray(gain))
-            level_ids.append(node_ids)
-
-        # assemble the tree: internal nodes that actually split get
-        # (feature, threshold); everything else becomes a leaf holding the
-        # Newton value of the rows that stopped there
-        base = 0
-        for level in range(depth):
-            n_nodes = 2 ** level
-            gain = level_gain[level]
-            split = gain > 0
-            features[t, base:base + n_nodes] = np.where(
-                split, level_feature[level], -1)
-            thresholds[t, base:base + n_nodes] = level_bin[level]
-            # leaf value for rows that STOP at this level (their node did
-            # not split): computed from the ids entering the level
-            vals = np.asarray(_leaf_values(level_ids[level], g, h, n_nodes,
-                                           config.reg_lambda))
-            values[t, base:base + n_nodes] = np.where(split, 0.0, vals)
-            base += n_nodes
-        # deepest level: always leaves
-        n_nodes = 2 ** depth
-        vals = np.asarray(_leaf_values(level_ids[depth], g, h, n_nodes,
-                                       config.reg_lambda))
-        values[t, base:base + n_nodes] = vals
-
-        # in-sample update reuses the DEVICE binned copy — _predict_tree
-        # on binned_host would re-upload the full matrix once per tree
-        pred = pred + config.learning_rate * np.asarray(_predict_tree_jit(
-            binned, jnp.asarray(features[t]), jnp.asarray(thresholds[t]),
-            jnp.asarray(values[t]), depth), np.float64)
+        features[t], thresholds[t], values[t], tree_pred = _train_one_tree(
+            binned, jnp.asarray(g, jnp.float32), jnp.asarray(h, jnp.float32),
+            d, config)
+        pred = pred + config.learning_rate * np.asarray(tree_pred, np.float64)
 
     return Forest(features, thresholds, values, edges, base_score,
                   config.learning_rate)
+
+
+@dataclass
+class SoftmaxForest:
+    """K-class boosted forest: ``num_trees`` rounds x ``n_classes`` trees
+    (the standard softmax objective — one tree per class per round, the
+    XGBoost ``multi:softmax`` formulation)."""
+
+    feature: np.ndarray       # (T, K, n_nodes) int32, -1 for leaf
+    threshold: np.ndarray     # (T, K, n_nodes) int32
+    value: np.ndarray         # (T, K, n_nodes) f32
+    bin_edges: np.ndarray     # (d, max_bins - 1) f64
+    base_scores: np.ndarray   # (K,) f64 log-priors
+    learning_rate: float
+
+    @property
+    def n_classes(self) -> int:
+        return self.feature.shape[1]
+
+
+def _softmax_rows(m: np.ndarray) -> np.ndarray:
+    e = np.exp(m - m.max(axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def train_forest_softmax(X: np.ndarray, y_ids: np.ndarray, n_classes: int,
+                         config: GBTConfig) -> SoftmaxForest:
+    """Multiclass boosting: each round trains one tree per class against the
+    softmax gradients ``g_k = p_k - 1[y=k]``, ``h_k = p_k (1 - p_k)``; class
+    margins start at the log-priors."""
+    n, d = X.shape
+    binned_host, edges = bin_features(X, config.max_bins)
+    binned = jnp.asarray(binned_host)
+    n_nodes_total = 2 ** (config.max_depth + 1) - 1
+    T, K = config.num_trees, n_classes
+
+    features = np.full((T, K, n_nodes_total), -1, np.int32)
+    thresholds = np.zeros((T, K, n_nodes_total), np.int32)
+    values = np.zeros((T, K, n_nodes_total), np.float32)
+
+    priors = np.bincount(y_ids, minlength=K) / max(n, 1)
+    base_scores = np.log(np.clip(priors, 1e-6, None))
+    margins = np.tile(base_scores, (n, 1))
+    onehot = (y_ids[:, None] == np.arange(K)[None, :]).astype(np.float64)
+
+    for t in range(T):
+        p = _softmax_rows(margins)
+        for k in range(K):
+            g = p[:, k] - onehot[:, k]
+            h = np.maximum(p[:, k] * (1.0 - p[:, k]), 1e-12)
+            (features[t, k], thresholds[t, k], values[t, k],
+             tree_pred) = _train_one_tree(
+                binned, jnp.asarray(g, jnp.float32),
+                jnp.asarray(h, jnp.float32), d, config)
+            margins[:, k] += config.learning_rate * np.asarray(tree_pred,
+                                                               np.float64)
+
+    return SoftmaxForest(features, thresholds, values, edges, base_scores,
+                         config.learning_rate)
+
+
+def predict_forest_softmax(X: np.ndarray, forest: SoftmaxForest) -> np.ndarray:
+    """Per-class margins (n, K)."""
+    binned = apply_bins(X, forest.bin_edges)
+    depth = int(np.log2(forest.feature.shape[2] + 1)) - 1
+    margins = np.tile(forest.base_scores, (len(X), 1))
+    binned_dev = jnp.asarray(binned)
+    for t in range(forest.feature.shape[0]):
+        for k in range(forest.n_classes):
+            margins[:, k] += forest.learning_rate * np.asarray(
+                _predict_tree_jit(binned_dev,
+                                  jnp.asarray(forest.feature[t, k]),
+                                  jnp.asarray(forest.threshold[t, k]),
+                                  jnp.asarray(forest.value[t, k]), depth),
+                np.float64)
+    return margins
 
 
 def _predict_tree(binned: np.ndarray, feature: np.ndarray,

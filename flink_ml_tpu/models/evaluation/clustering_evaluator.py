@@ -1,0 +1,75 @@
+"""ClusteringEvaluator — mean silhouette coefficient.
+
+Companion to the classification/regression evaluators (the Flink ML 2.x
+evaluation surface).  The silhouette is all-pairs work, which is exactly
+what the MXU is for: the (n, n) distance matrix is one pairwise expansion
+matmul and the per-cluster mean distances are one ``D @ onehot`` matmul —
+the whole metric is a single jitted program, no per-point host loops.
+
+s(i) = (b_i - a_i) / max(a_i, b_i) with
+    a_i = mean distance to OWN cluster (excluding self)
+    b_i = min over other clusters of mean distance to that cluster;
+singleton clusters score 0 by convention (sklearn's rule).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import AlgoOperator
+from ...data.table import Table
+from ...distance import DistanceMeasure
+from ...linalg import stack_vectors
+from ...params.shared import HasDistanceMeasure, HasFeaturesCol, \
+    HasPredictionCol
+
+__all__ = ["ClusteringEvaluator"]
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def _silhouette(measure: DistanceMeasure, X, labels, k: int):
+    D = measure.pairwise(X, X)                       # (n, n)
+    onehot = jax.nn.one_hot(labels, k, dtype=X.dtype)  # (n, k)
+    counts = jnp.sum(onehot, axis=0)                 # (k,)
+    sums = D @ onehot                                # (n, k) dist sums
+
+    own_count = counts[labels]
+    # a_i: own-cluster mean excluding self (D[i,i] = 0 contributes nothing)
+    a = jnp.take_along_axis(sums, labels[:, None], 1)[:, 0] \
+        / jnp.maximum(own_count - 1.0, 1.0)
+    # b_i: min mean distance over OTHER non-empty clusters
+    means = sums / jnp.maximum(counts, 1.0)[None, :]
+    own_or_empty = (jax.nn.one_hot(labels, k, dtype=bool)
+                    | (counts[None, :] == 0))
+    b = jnp.min(jnp.where(own_or_empty, jnp.inf, means), axis=1)
+
+    s = (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-12)
+    s = jnp.where(own_count > 1, s, 0.0)             # singleton convention
+    s = jnp.where(jnp.isfinite(s), s, 0.0)           # all-in-one-cluster
+    return jnp.mean(s)
+
+
+class ClusteringEvaluator(HasDistanceMeasure, HasFeaturesCol,
+                          HasPredictionCol, AlgoOperator):
+    """transform(table with features + cluster predictions) -> one-row Table
+    with the mean silhouette."""
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float32)
+        labels_raw = np.asarray(table[self.get_prediction_col()])
+        if len(X) != len(labels_raw):
+            raise ValueError("features/prediction length mismatch")
+        if len(X) < 2:
+            raise ValueError("silhouette needs at least 2 rows")
+        uniq, labels = np.unique(labels_raw, return_inverse=True)
+        measure = DistanceMeasure.get_instance(self.get_distance_measure())
+        value = float(_silhouette(measure, jnp.asarray(X),
+                                  jnp.asarray(labels, jnp.int32),
+                                  int(len(uniq))))
+        return [Table({"silhouette": np.asarray([value])})]

@@ -1,0 +1,98 @@
+"""Worker process for the two-process jax.distributed test tier (the
+MiniCluster analog — see tests/test_distributed_multiprocess.py).
+
+Run as: python tests/_distributed_worker.py <coordinator> <nprocs> <pid> <outdir>
+
+Exercises the real multi-process branches of parallel/distributed.py
+(initialize, global_mesh, host_local_to_global, barrier,
+broadcast_from_host0, global_to_host_local) plus a data-parallel iterate fit
+with the multi-host checkpoint path (process-0 writes + cross-host barrier),
+then writes a result JSON the parent compares across processes.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    coord, nprocs, pid, outdir = (sys.argv[1], int(sys.argv[2]),
+                                  int(sys.argv[3]), sys.argv[4])
+    import jax
+
+    # The environment's sitecustomize imports jax and initializes the axon
+    # backend at interpreter startup — before this script runs.  Tear the
+    # live backend down and pin a 2-device CPU platform so the distributed
+    # runtime owns backend creation (the same dance as
+    # __graft_entry__.dryrun_multichip).
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    from flink_ml_tpu.parallel import distributed as dist
+
+    dist.initialize(coordinator_address=coord, num_processes=nprocs,
+                    process_id=pid)
+    info = dist.process_info()
+    assert info.process_count == nprocs, info
+    assert info.global_device_count == 2 * nprocs, info  # 2 cpu devs/host
+    assert info.is_coordinator == (pid == 0)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    mesh = dist.global_mesh()
+    assert int(mesh.shape["data"]) == 2 * nprocs
+
+    # host-local -> global: host p contributes rows [4p, 4p+4)
+    local = np.arange(pid * 4, pid * 4 + 4, dtype=np.float32)
+    global_arr = dist.host_local_to_global(local, mesh, axis="data")
+    assert not global_arr.is_fully_addressable
+    total = float(np.asarray(jax.jit(jnp.sum)(global_arr)))
+    assert total == sum(range(4 * nprocs)), total
+
+    # global -> host-local round trip returns this host's own rows
+    back = dist.global_to_host_local(global_arr, mesh, axis="data")
+    np.testing.assert_array_equal(np.asarray(back), local)
+
+    dist.barrier("after-ingest")
+    v = dist.broadcast_from_host0(np.asarray([123.0 + pid]))
+    assert float(np.asarray(v)[0]) == 123.0, v  # host 0's value everywhere
+
+    # data-parallel iterate + the multi-host checkpoint path: every epoch
+    # all processes enter save_pytree (collective assembly + barrier),
+    # process 0 writes, everyone restores the same bytes on resume
+    from flink_ml_tpu.iteration import (
+        IterationBodyResult,
+        IterationConfig,
+        iterate,
+    )
+    from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+
+    def body(w, epoch, d):
+        return IterationBodyResult(w + jnp.sum(d))
+
+    ck = os.path.join(outdir, "ck")  # same dir: the shared-filesystem setup
+    res = iterate(body, jnp.asarray(0.0, jnp.float32), global_arr,
+                  max_epochs=3, config=IterationConfig(mode="hosted"),
+                  checkpoint=CheckpointConfig(ck))
+    resumed = iterate(body, jnp.asarray(0.0, jnp.float32), global_arr,
+                      max_epochs=5, config=IterationConfig(mode="hosted"),
+                      checkpoint=CheckpointConfig(ck), resume=True)
+
+    out = {
+        "pid": pid,
+        "global_devices": info.global_device_count,
+        "total": total,
+        "final": float(np.asarray(jax.device_get(res.state))),
+        "resumed": float(np.asarray(jax.device_get(resumed.state))),
+    }
+    with open(os.path.join(outdir, f"result_{pid}.json"), "w") as f:
+        json.dump(out, f)
+    dist.barrier("done")
+
+
+if __name__ == "__main__":
+    main()

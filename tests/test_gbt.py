@@ -45,10 +45,11 @@ def test_classifier_label_values_preserved():
     assert (pred == np.where(y == 1, "yes", "no")).mean() > 0.9
 
 
-def test_classifier_rejects_multiclass():
-    table = Table({"features": np.zeros((3, 2)), "label": np.asarray([0, 1, 2])})
-    with pytest.raises(ValueError, match="binary"):
-        GBTClassifier().fit(table)
+def test_classifier_routes_three_labels_to_softmax_path():
+    table = Table({"features": np.random.default_rng(0).normal(size=(30, 2)),
+                   "label": np.asarray([0, 1, 2] * 10)})
+    model = GBTClassifier().set_max_iter(2).fit(table)
+    assert model._soft is not None and model._soft.n_classes == 3
 
 
 def test_regressor_beats_linear_on_friedman():
@@ -125,3 +126,87 @@ def test_empty_fit_rejected():
     with pytest.raises(ValueError):
         GBTRegressor().fit(Table({"features": np.zeros((0, 2)),
                                   "label": np.zeros(0)}))
+
+
+# ------------------------------------------------------------- multiclass
+
+
+def test_gbt_multiclass_three_rings(rng):
+    """3 well-separated blobs; softmax GBT must classify near-perfectly."""
+    from flink_ml_tpu.models.classification import GBTClassifier
+
+    n = 120
+    centers = np.asarray([[0.0, 0.0], [6.0, 0.0], [0.0, 6.0]])
+    X = np.concatenate([rng.normal(size=(n, 2)) * 0.5 + c for c in centers])
+    y = np.repeat(["alpha", "beta", "gamma"], n)
+    t = Table({"features": X, "label": y})
+    model = (GBTClassifier().set_max_iter(10).set_max_depth(3)
+             .set_learning_rate(0.3).fit(t))
+    out = model.transform(t)[0]
+    pred = np.asarray(out["prediction"])
+    assert (pred == y).mean() > 0.98
+    probs = np.asarray(out["rawPrediction"])
+    assert probs.shape == (3 * n, 3)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-9)
+
+
+def test_gbt_multiclass_save_load_and_model_data(tmp_path, rng):
+    from flink_ml_tpu.models.classification import (
+        GBTClassifier,
+        GBTClassifierModel,
+    )
+
+    X = rng.normal(size=(90, 3))
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)  # 3 classes
+    t = Table({"features": X, "label": y})
+    model = GBTClassifier().set_max_iter(4).set_max_depth(3).fit(t)
+    pred = np.asarray(model.transform(t)[0]["prediction"])
+
+    model.save(str(tmp_path / "m"))
+    re = GBTClassifierModel.load(str(tmp_path / "m"))
+    np.testing.assert_array_equal(
+        np.asarray(re.transform(t)[0]["prediction"]), pred)
+
+    # model-data round trip through Tables
+    fresh = GBTClassifierModel().set_model_data(*model.get_model_data())
+    fresh.copy_params_from(model)
+    np.testing.assert_array_equal(
+        np.asarray(fresh.transform(t)[0]["prediction"]), pred)
+
+
+def test_gbt_binary_still_binary(rng):
+    """2-label input keeps the logistic path (scalar margins)."""
+    from flink_ml_tpu.models.classification import GBTClassifier
+
+    X = rng.normal(size=(80, 2))
+    y = (X[:, 0] > 0).astype(int)
+    model = (GBTClassifier().set_max_iter(5)
+             .fit(Table({"features": X, "label": y})))
+    assert model._soft is None
+    probs = np.asarray(model.transform(
+        Table({"features": X, "label": y}))[0]["rawPrediction"])
+    assert probs.ndim == 1
+
+
+def test_set_model_data_replaces_representation(rng):
+    """Installing binary model data on a model that held a multiclass forest
+    (or vice versa) fully replaces it — no stale forest answers."""
+    from flink_ml_tpu.models.classification import GBTClassifier
+
+    X = rng.normal(size=(90, 2))
+    t3 = Table({"features": X,
+                "label": (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)})
+    t2 = Table({"features": X, "label": (X[:, 0] > 0).astype(int)})
+    m3 = GBTClassifier().set_max_iter(3).fit(t3)
+    m2 = GBTClassifier().set_max_iter(3).fit(t2)
+
+    m3.set_model_data(*m2.get_model_data())
+    assert m3._soft is None
+    pred = np.asarray(m3.transform(t2)[0]["prediction"])
+    np.testing.assert_array_equal(pred,
+                                  np.asarray(m2.transform(t2)[0]["prediction"]))
+
+    m2.set_model_data(*GBTClassifier().set_max_iter(3).fit(t3)
+                      .get_model_data())
+    assert m2._soft is not None and m2._forest is None
+    assert set(np.asarray(m2.transform(t3)[0]["prediction"])) <= {0, 1, 2}

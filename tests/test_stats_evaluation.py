@@ -76,3 +76,102 @@ def test_multiclass_evaluator_string_labels():
     out = (MulticlassClassificationEvaluator().set_metrics("accuracy")
            .transform(Table({"label": y, "prediction": pred}))[0])
     assert float(np.asarray(out["accuracy"])[0]) == pytest.approx(2 / 3)
+
+
+# ---------------------------------------------------- RegressionEvaluator
+
+
+def test_regression_evaluator_hand_computed():
+    from flink_ml_tpu.models.evaluation import RegressionEvaluator
+
+    y = np.asarray([1.0, 2.0, 3.0, 4.0])
+    pred = np.asarray([1.5, 2.0, 2.0, 5.0])
+    # errors: .5, 0, -1, 1 -> mse = (.25+0+1+1)/4 = .5625; mae = 2.5/4
+    t = Table({"label": y, "prediction": pred})
+    out = (RegressionEvaluator().set_metrics("rmse", "mse", "mae", "r2")
+           .transform(t)[0])
+    np.testing.assert_allclose(float(out["mse"][0]), 0.5625)
+    np.testing.assert_allclose(float(out["rmse"][0]), np.sqrt(0.5625))
+    np.testing.assert_allclose(float(out["mae"][0]), 0.625)
+    # ss_tot = sum((y - 2.5)^2) = 5 -> r2 = 1 - 2.25/5
+    np.testing.assert_allclose(float(out["r2"][0]), 1 - 2.25 / 5)
+
+
+def test_regression_evaluator_weighted_and_degenerate():
+    from flink_ml_tpu.models.evaluation import RegressionEvaluator
+
+    t = Table({"label": np.asarray([0.0, 10.0]),
+               "prediction": np.asarray([1.0, 10.0]),
+               "w": np.asarray([1.0, 3.0])})
+    out = (RegressionEvaluator().set_metrics("mse").set_weight_col("w")
+           .transform(t)[0])
+    np.testing.assert_allclose(float(out["mse"][0]), 0.25)  # (1*1+3*0)/4
+
+    # constant labels: perfect fit -> r2 = 1; any error -> 0
+    const = Table({"label": np.ones(3), "prediction": np.ones(3)})
+    out = RegressionEvaluator().set_metrics("r2").transform(const)[0]
+    assert float(out["r2"][0]) == 1.0
+    off = Table({"label": np.ones(3), "prediction": np.zeros(3)})
+    assert float(RegressionEvaluator().set_metrics("r2")
+                 .transform(off)[0]["r2"][0]) == 0.0
+
+
+def test_regression_evaluator_validates():
+    from flink_ml_tpu.models.evaluation import RegressionEvaluator
+
+    with pytest.raises(ValueError, match="at least one"):
+        RegressionEvaluator().transform(
+            Table({"label": np.zeros(0), "prediction": np.zeros(0)}))
+
+
+# ---------------------------------------------------- ClusteringEvaluator
+
+
+def test_silhouette_matches_sklearn_formula(rng):
+    """Hand-verified against the definition on a small fixture (and equal to
+    sklearn.metrics.silhouette_score on the same input)."""
+    from flink_ml_tpu.models.evaluation import ClusteringEvaluator
+
+    X = rng.normal(size=(60, 3))
+    labels = rng.integers(0, 3, size=60)
+    t = Table({"features": X, "prediction": labels})
+    got = float(ClusteringEvaluator().transform(t)[0]["silhouette"][0])
+
+    # reference implementation straight from the definition
+    from scipy.spatial.distance import cdist
+
+    D = cdist(X, X)
+    s_vals = []
+    for i in range(len(X)):
+        own = labels == labels[i]
+        a = D[i, own].sum() / max(own.sum() - 1, 1)
+        b = min(D[i, labels == c].mean()
+                for c in np.unique(labels) if c != labels[i])
+        s_vals.append((b - a) / max(a, b) if own.sum() > 1 else 0.0)
+    np.testing.assert_allclose(got, np.mean(s_vals), atol=1e-5)
+
+
+def test_silhouette_separated_blobs_near_one(rng):
+    from flink_ml_tpu.models.evaluation import ClusteringEvaluator
+
+    X = np.concatenate([rng.normal(size=(40, 2)) * 0.1,
+                        rng.normal(size=(40, 2)) * 0.1 + 50.0])
+    labels = np.repeat([0, 1], 40)
+    t = Table({"features": X, "prediction": labels})
+    got = float(ClusteringEvaluator().transform(t)[0]["silhouette"][0])
+    assert got > 0.98
+
+
+def test_silhouette_singletons_and_validation(rng):
+    from flink_ml_tpu.models.evaluation import ClusteringEvaluator
+
+    # one singleton cluster scores 0 by convention, pulling the mean down
+    X = np.asarray([[0.0, 0], [0.1, 0], [9.0, 9]])
+    t = Table({"features": X, "prediction": np.asarray([0, 0, 1])})
+    got = float(ClusteringEvaluator().transform(t)[0]["silhouette"][0])
+    assert 0.0 < got < 1.0
+
+    with pytest.raises(ValueError, match="2 rows"):
+        ClusteringEvaluator().transform(
+            Table({"features": np.zeros((1, 2)),
+                   "prediction": np.zeros(1)}))
