@@ -111,6 +111,13 @@ class Table:
     def slice(self, start: int, stop: int) -> "Table":
         return Table({name: c[start:stop] for name, c in self._columns.items()})
 
+    def select_rows(self, indices: Any) -> "Table":
+        """Row subset/reorder by integer index array (or boolean mask)."""
+        idx = np.asarray(indices)
+        if idx.dtype == bool:
+            idx = np.flatnonzero(idx)
+        return Table({name: c[idx] for name, c in self._columns.items()})
+
     def shuffle(self, seed: int = 0) -> "Table":
         perm = np.random.default_rng(seed).permutation(self._num_rows)
         return Table({name: c[perm] for name, c in self._columns.items()})

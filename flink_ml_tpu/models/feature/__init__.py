@@ -26,6 +26,16 @@ from .text import (  # noqa: F401
     IDFModel,
     IndexToString,
 )
+from .vector_ops import (  # noqa: F401
+    DCT,
+    ElementwiseProduct,
+    Interaction,
+    KBinsDiscretizer,
+    KBinsDiscretizerModel,
+    VectorIndexer,
+    VectorIndexerModel,
+    VectorSlicer,
+)
 from .transforms import (  # noqa: F401
     Binarizer,
     Bucketizer,

@@ -1,0 +1,447 @@
+"""Vector-shaping transformers: VectorSlicer, ElementwiseProduct,
+Interaction, DCT, plus the fitted KBinsDiscretizer and VectorIndexer.
+
+All are members of the Flink ML 2.x feature-engineering surface (the
+reference snapshot's lib module is KMeans-only — SURVEY §2.8 — but the
+library line these mirror ships them).  The dense row-wise math (DCT
+matmul, interaction outer products, elementwise scaling) runs as jitted
+XLA ops so batches land on the MXU; the index-learning estimators
+(KBinsDiscretizer, VectorIndexer) compute their per-column statistics on
+host in float64 where exact comparisons matter.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import Estimator, Model, Transformer
+from ...data.table import Table
+from ...linalg import stack_vectors
+from ...params.param import (
+    BoolParam,
+    DoubleArrayParam,
+    IntArrayParam,
+    IntParam,
+    ParamValidators,
+    StringParam,
+)
+from ...params.shared import HasInputCols, HasOutputCol
+from ...utils import persist
+from .transforms import _InOutParams, _SimpleTransformer
+
+__all__ = [
+    "DCT",
+    "ElementwiseProduct",
+    "Interaction",
+    "KBinsDiscretizer",
+    "KBinsDiscretizerModel",
+    "VectorIndexer",
+    "VectorIndexerModel",
+    "VectorSlicer",
+]
+
+
+class VectorSlicer(_SimpleTransformer):
+    """Select a sub-vector of the input by index list (order-preserving,
+    duplicates allowed — the Flink ML VectorSlicer contract requires
+    non-negative indices within bounds)."""
+
+    INDICES = IntArrayParam(
+        "indices", "Indices of the features to keep (non-negative).",
+        default=None, validator=ParamValidators.not_null())
+
+    def get_indices(self):
+        return self.get(VectorSlicer.INDICES)
+
+    def set_indices(self, *values: int):
+        vals = values[0] if len(values) == 1 and not np.isscalar(values[0]) \
+            else values
+        return self.set(VectorSlicer.INDICES, tuple(int(v) for v in vals))
+
+    def _apply(self, X: np.ndarray) -> np.ndarray:
+        idx = np.asarray(self.get_indices(), np.int64)
+        if idx.size == 0:
+            raise ValueError("VectorSlicer needs at least one index")
+        if np.any(idx < 0) or np.any(idx >= X.shape[1]):
+            raise ValueError(
+                f"VectorSlicer index out of range for dim {X.shape[1]}: "
+                f"{idx[(idx < 0) | (idx >= X.shape[1])][0]}")
+        return X[:, idx]
+
+
+class ElementwiseProduct(_SimpleTransformer):
+    """Hadamard product of each row with a fixed scaling vector."""
+
+    SCALING_VEC = DoubleArrayParam(
+        "scalingVec", "The vector to multiply with.", default=None,
+        validator=ParamValidators.not_null())
+
+    def get_scaling_vec(self):
+        return self.get(ElementwiseProduct.SCALING_VEC)
+
+    def set_scaling_vec(self, *values: float):
+        vals = values[0] if len(values) == 1 and not np.isscalar(values[0]) \
+            else values
+        return self.set(ElementwiseProduct.SCALING_VEC,
+                        tuple(float(v) for v in vals))
+
+    def _apply(self, X: np.ndarray) -> np.ndarray:
+        scale = np.asarray(self.get_scaling_vec(), np.float64)
+        if scale.shape[0] != X.shape[1]:
+            raise ValueError(
+                f"scalingVec has dim {scale.shape[0]}, input rows have "
+                f"dim {X.shape[1]}")
+        return X * scale[None, :]
+
+
+class Interaction(HasInputCols, HasOutputCol, Transformer):
+    """Row-wise tensor (outer) product of the input columns, flattened.
+
+    For input vectors ``a (da,), b (db,), c (dc,)`` the output row is the
+    flattened ``da*db*dc`` product tensor with the LAST input varying
+    fastest — the nested-loop order of the Flink ML / Spark Interaction.
+    Scalar (1-D) columns are treated as length-1 vectors.  The whole batch
+    is one jitted chain of broadcasted multiplies.
+    """
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        cols = self.get_input_cols()
+        if not cols or len(cols) < 2:
+            raise ValueError("Interaction needs >= 2 input columns")
+        mats = []
+        for name in cols:
+            arr = np.asarray(table[name], np.float64)
+            if arr.ndim == 1:
+                arr = arr[:, None]
+            mats.append(jnp.asarray(arr, jnp.float32))
+        out = np.asarray(_interact(tuple(mats)))
+        return [table.with_column(self.get_output_col(), out)]
+
+
+@jax.jit
+def _interact(mats):
+    acc = mats[0]                                   # (n, d0)
+    for m in mats[1:]:
+        # (n, da, 1) * (n, 1, db) -> (n, da, db) -> (n, da*db)
+        acc = (acc[:, :, None] * m[:, None, :]).reshape(acc.shape[0], -1)
+    return acc
+
+
+class DCT(_SimpleTransformer):
+    """Orthonormal 1-D DCT-II of each row (``inverse=True`` applies the
+    DCT-III inverse).  Implemented as one (n, d) @ (d, d) matmul so the
+    whole batch rides the MXU — for feature-sized d the cosine matrix is
+    tiny and XLA keeps it resident."""
+
+    INVERSE = BoolParam("inverse", "Apply the inverse transform (DCT-III).",
+                        default=False)
+
+    def get_inverse(self) -> bool:
+        return self.get(DCT.INVERSE)
+
+    def set_inverse(self, value: bool):
+        return self.set(DCT.INVERSE, bool(value))
+
+    @staticmethod
+    def _matrix(d: int) -> np.ndarray:
+        # C[k, n] = s_k * sqrt(2/d) * cos(pi * (2n + 1) * k / (2d)),
+        # s_0 = 1/sqrt(2): the orthonormal DCT-II basis (C @ C.T = I).
+        n = np.arange(d)
+        k = np.arange(d)[:, None]
+        C = np.sqrt(2.0 / d) * np.cos(np.pi * (2 * n[None, :] + 1) * k
+                                      / (2.0 * d))
+        C[0] /= np.sqrt(2.0)
+        return C
+
+    def _apply(self, X: np.ndarray) -> np.ndarray:
+        C = self._matrix(X.shape[1])
+        return np.asarray(_dct_apply(jnp.asarray(X, jnp.float32),
+                                     jnp.asarray(C, jnp.float32),
+                                     self.get_inverse()))
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _dct_apply(X, C, inverse):
+    # orthonormal => inverse is the transpose
+    return X @ (C if inverse else C.T)
+
+
+# ---------------------------------------------------------------------------
+# KBinsDiscretizer
+# ---------------------------------------------------------------------------
+
+class KBinsDiscretizerParams(_InOutParams):
+    NUM_BINS = IntParam("numBins", "Number of bins per column.", default=5,
+                        validator=ParamValidators.gt_eq(2))
+    STRATEGY = StringParam(
+        "strategy", "Bin-edge strategy: uniform | quantile | kmeans.",
+        default="quantile",
+        validator=ParamValidators.in_array(["uniform", "quantile", "kmeans"]))
+    SUB_SAMPLES = IntParam(
+        "subSamples", "Max rows sampled for edge fitting (<=0: use all).",
+        default=200_000)
+
+    def get_num_bins(self) -> int:
+        return self.get(KBinsDiscretizerParams.NUM_BINS)
+
+    def set_num_bins(self, value: int):
+        return self.set(KBinsDiscretizerParams.NUM_BINS, value)
+
+    def get_strategy(self) -> str:
+        return self.get(KBinsDiscretizerParams.STRATEGY)
+
+    def set_strategy(self, value: str):
+        return self.set(KBinsDiscretizerParams.STRATEGY, value)
+
+    def get_sub_samples(self) -> int:
+        return self.get(KBinsDiscretizerParams.SUB_SAMPLES)
+
+    def set_sub_samples(self, value: int):
+        return self.set(KBinsDiscretizerParams.SUB_SAMPLES, value)
+
+
+def _kmeans_1d_edges(col: np.ndarray, k: int, iters: int = 25) -> np.ndarray:
+    """1-D Lloyd's on a sorted column; edges are midpoints between adjacent
+    final centroids (the KBinsDiscretizer 'kmeans' strategy)."""
+    uniq = np.unique(col)
+    if len(uniq) <= k:
+        # one bin per distinct value: edges at midpoints
+        mids = (uniq[1:] + uniq[:-1]) / 2.0
+        return np.concatenate([[col.min()], mids, [col.max()]])
+    centers = np.quantile(col, (np.arange(k) + 0.5) / k)
+    for _ in range(iters):
+        # 1-D assignment = searchsorted against boundary midpoints
+        bounds = (centers[1:] + centers[:-1]) / 2.0
+        assign = np.searchsorted(bounds, col)
+        sums = np.bincount(assign, weights=col, minlength=k)
+        counts = np.bincount(assign, minlength=k)
+        nonempty = counts > 0
+        new = centers.copy()
+        new[nonempty] = sums[nonempty] / counts[nonempty]
+        if np.allclose(new, centers):
+            centers = new
+            break
+        centers = new
+    mids = (np.sort(centers)[1:] + np.sort(centers)[:-1]) / 2.0
+    return np.concatenate([[col.min()], mids, [col.max()]])
+
+
+class KBinsDiscretizerModel(KBinsDiscretizerParams, Model):
+    """Buckets each column by its learned edges; out-of-range values clamp
+    into the first/last bin (the Flink ML KBinsDiscretizerModel behavior)."""
+
+    def __init__(self):
+        super().__init__()
+        self._edges: Optional[np.ndarray] = None   # (d, max_edges) +inf pad
+        self._n_edges: Optional[np.ndarray] = None  # (d,) valid counts
+
+    def set_model_data(self, *inputs) -> "KBinsDiscretizerModel":
+        (t,) = inputs
+        self._edges = np.asarray(t["edges"], np.float64)
+        self._n_edges = np.asarray(t["n_edges"], np.int64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require_model()
+        return [Table({"edges": self._edges, "n_edges": self._n_edges})]
+
+    def _require_model(self) -> None:
+        if self._edges is None:
+            raise RuntimeError("KBinsDiscretizerModel has no model data")
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        self._require_model()
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
+        out = np.empty_like(X)
+        for j in range(X.shape[1]):
+            edges = self._edges[j, : self._n_edges[j]]
+            # interior edges only: clamping outer values into first/last bin
+            idx = np.searchsorted(edges[1:-1], X[:, j], side="right")
+            out[:, j] = idx
+        return [table.with_column(self.get_output_col(), out)]
+
+    def save(self, path: str) -> None:
+        self._require_model()
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(path, "model", {
+            "edges": self._edges, "n_edges": self._n_edges})
+
+    @classmethod
+    def load(cls, path: str) -> "KBinsDiscretizerModel":
+        model = persist.load_stage_param(path)
+        data = persist.load_model_arrays(path, "model")
+        model._edges = data["edges"].astype(np.float64)
+        model._n_edges = data["n_edges"].astype(np.int64)
+        return model
+
+
+class KBinsDiscretizer(KBinsDiscretizerParams,
+                       Estimator[KBinsDiscretizerModel]):
+    """Learns per-column bin edges.  ``quantile`` collapses duplicate
+    quantile edges (fewer effective bins on skewed data, same as the Flink
+    ML implementation); ``uniform`` spaces bins over [min, max]; ``kmeans``
+    runs 1-D Lloyd's per column and cuts at centroid midpoints."""
+
+    def fit(self, *inputs) -> KBinsDiscretizerModel:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
+        sub = self.get_sub_samples()
+        if 0 < sub < X.shape[0]:
+            sel = np.random.default_rng(0).choice(X.shape[0], sub,
+                                                  replace=False)
+            X = X[sel]
+        k = self.get_num_bins()
+        strategy = self.get_strategy()
+        per_col: List[np.ndarray] = []
+        for j in range(X.shape[1]):
+            col = X[:, j]
+            if strategy == "uniform":
+                edges = np.linspace(col.min(), col.max(), k + 1)
+            elif strategy == "quantile":
+                edges = np.unique(np.quantile(col, np.linspace(0, 1, k + 1)))
+                if len(edges) < 2:   # constant column: single degenerate bin
+                    edges = np.array([col.min(), col.max() + 1.0])
+            else:
+                edges = _kmeans_1d_edges(col, k)
+            per_col.append(edges)
+
+        max_e = max(len(e) for e in per_col)
+        edges = np.full((X.shape[1], max_e), np.inf)
+        n_edges = np.zeros(X.shape[1], np.int64)
+        for j, e in enumerate(per_col):
+            edges[j, : len(e)] = e
+            n_edges[j] = len(e)
+
+        model = KBinsDiscretizerModel()
+        model.copy_params_from(self)
+        model._edges = edges
+        model._n_edges = n_edges
+        return model
+
+
+# ---------------------------------------------------------------------------
+# VectorIndexer
+# ---------------------------------------------------------------------------
+
+class VectorIndexerParams(_InOutParams):
+    MAX_CATEGORIES = IntParam(
+        "maxCategories",
+        "Columns with more distinct values than this stay continuous.",
+        default=20, validator=ParamValidators.gt_eq(2))
+    HANDLE_INVALID = StringParam(
+        "handleInvalid", "Unseen categorical values: error | skip | keep.",
+        default="error",
+        validator=ParamValidators.in_array(["error", "skip", "keep"]))
+
+    def get_max_categories(self) -> int:
+        return self.get(VectorIndexerParams.MAX_CATEGORIES)
+
+    def set_max_categories(self, value: int):
+        return self.set(VectorIndexerParams.MAX_CATEGORIES, value)
+
+    def get_handle_invalid(self) -> str:
+        return self.get(VectorIndexerParams.HANDLE_INVALID)
+
+    def set_handle_invalid(self, value: str):
+        return self.set(VectorIndexerParams.HANDLE_INVALID, value)
+
+
+class VectorIndexerModel(VectorIndexerParams, Model):
+    """Maps each categorical column's values to indices in ascending value
+    order; columns whose distinct count exceeded ``maxCategories`` at fit
+    time pass through unchanged.  Unseen values at transform time follow
+    ``handleInvalid``: error raises, skip drops the row, keep maps to the
+    extra index ``numCategories``."""
+
+    def __init__(self):
+        super().__init__()
+        self._values: Optional[np.ndarray] = None   # (d, max_vals) NaN pad
+        self._n_values: Optional[np.ndarray] = None  # (d,) -1 => continuous
+
+    def set_model_data(self, *inputs) -> "VectorIndexerModel":
+        (t,) = inputs
+        self._values = np.asarray(t["values"], np.float64)
+        self._n_values = np.asarray(t["n_values"], np.int64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require_model()
+        return [Table({"values": self._values, "n_values": self._n_values})]
+
+    def _require_model(self) -> None:
+        if self._values is None:
+            raise RuntimeError("VectorIndexerModel has no model data")
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        self._require_model()
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
+        out = X.copy()
+        invalid_rows = np.zeros(X.shape[0], bool)
+        policy = self.get_handle_invalid()
+        for j in range(X.shape[1]):
+            n = self._n_values[j]
+            if n < 0:           # continuous column: passthrough
+                continue
+            vals = self._values[j, :n]
+            pos = np.searchsorted(vals, X[:, j])
+            pos_c = np.clip(pos, 0, n - 1)
+            hit = vals[pos_c] == X[:, j]
+            if not np.all(hit):
+                if policy == "error":
+                    bad = X[:, j][~hit][0]
+                    raise ValueError(
+                        f"VectorIndexer saw unseen value {bad} in column {j}"
+                        "; set handleInvalid to 'keep' or 'skip'")
+                invalid_rows |= ~hit
+            out[:, j] = np.where(hit, pos_c, float(n))
+        result = table.with_column(self.get_output_col(), out)
+        if policy == "skip" and np.any(invalid_rows):
+            result = result.select_rows(np.flatnonzero(~invalid_rows))
+        return [result]
+
+    def save(self, path: str) -> None:
+        self._require_model()
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(path, "model", {
+            "values": self._values, "n_values": self._n_values})
+
+    @classmethod
+    def load(cls, path: str) -> "VectorIndexerModel":
+        model = persist.load_stage_param(path)
+        data = persist.load_model_arrays(path, "model")
+        model._values = data["values"].astype(np.float64)
+        model._n_values = data["n_values"].astype(np.int64)
+        return model
+
+
+class VectorIndexer(VectorIndexerParams, Estimator[VectorIndexerModel]):
+    def fit(self, *inputs) -> VectorIndexerModel:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
+        max_cat = self.get_max_categories()
+        per_col: List[Optional[np.ndarray]] = []
+        for j in range(X.shape[1]):
+            uniq = np.unique(X[:, j])
+            per_col.append(uniq if len(uniq) <= max_cat else None)
+
+        max_v = max((len(v) for v in per_col if v is not None), default=1)
+        values = np.full((X.shape[1], max_v), np.nan)
+        n_values = np.full(X.shape[1], -1, np.int64)
+        for j, v in enumerate(per_col):
+            if v is not None:
+                values[j, : len(v)] = v
+                n_values[j] = len(v)
+
+        model = VectorIndexerModel()
+        model.copy_params_from(self)
+        model._values = values
+        model._n_values = n_values
+        return model

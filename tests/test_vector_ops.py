@@ -1,0 +1,174 @@
+"""VectorSlicer / ElementwiseProduct / Interaction / DCT /
+KBinsDiscretizer / VectorIndexer."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature import (
+    DCT,
+    ElementwiseProduct,
+    Interaction,
+    KBinsDiscretizer,
+    KBinsDiscretizerModel,
+    VectorIndexer,
+    VectorIndexerModel,
+    VectorSlicer,
+)
+
+
+def _t(X):
+    return Table({"features": np.asarray(X, np.float64)})
+
+
+def test_vector_slicer_selects_and_reorders():
+    out = (VectorSlicer().set_indices(2, 0, 2)
+           .transform(_t([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]]))[0])
+    np.testing.assert_array_equal(np.asarray(out["output"]),
+                                  [[3.0, 1.0, 3.0], [6.0, 4.0, 6.0]])
+
+
+def test_vector_slicer_rejects_out_of_range():
+    with pytest.raises(ValueError, match="out of range"):
+        VectorSlicer().set_indices(3).transform(_t([[1.0, 2.0]]))
+
+
+def test_elementwise_product():
+    out = (ElementwiseProduct().set_scaling_vec(2.0, 0.5)
+           .transform(_t([[1.0, 4.0], [3.0, 8.0]]))[0])
+    np.testing.assert_array_equal(np.asarray(out["output"]),
+                                  [[2.0, 2.0], [6.0, 4.0]])
+    with pytest.raises(ValueError, match="dim"):
+        (ElementwiseProduct().set_scaling_vec(1.0)
+         .transform(_t([[1.0, 2.0]])))
+
+
+def test_interaction_matches_nested_loop_order():
+    t = Table({
+        "a": np.array([2.0, 3.0]),                       # scalar column
+        "b": np.array([[1.0, 2.0], [3.0, 4.0]]),
+        "c": np.array([[5.0, 6.0], [7.0, 8.0]]),
+    })
+    out = (Interaction().set_input_cols("a", "b", "c")
+           .transform(t)[0])
+    got = np.asarray(out["output"])
+    # row 0: 2 * [1,2] (x) [5,6] -> [2*1*5, 2*1*6, 2*2*5, 2*2*6]
+    np.testing.assert_allclose(got[0], [10.0, 12.0, 20.0, 24.0])
+    np.testing.assert_allclose(got[1], [3 * 3 * 7, 3 * 3 * 8,
+                                        3 * 4 * 7, 3 * 4 * 8])
+
+
+def test_interaction_needs_two_columns():
+    with pytest.raises(ValueError, match=">= 2"):
+        Interaction().set_input_cols("a").transform(
+            Table({"a": np.array([1.0])}))
+
+
+def test_dct_roundtrip_and_orthonormality():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(4, 8))
+    fwd = DCT().transform(_t(X))[0]
+    Y = np.asarray(fwd["output"])
+    # Parseval: orthonormal transform preserves row norms
+    np.testing.assert_allclose(np.linalg.norm(Y, axis=1),
+                               np.linalg.norm(X, axis=1), rtol=1e-5)
+    back = (DCT().set_inverse(True)
+            .transform(Table({"features": Y}))[0])
+    np.testing.assert_allclose(np.asarray(back["output"]), X,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dct_constant_row_concentrates_in_dc():
+    out = DCT().transform(_t([[1.0, 1.0, 1.0, 1.0]]))[0]
+    got = np.asarray(out["output"])[0]
+    np.testing.assert_allclose(got, [2.0, 0.0, 0.0, 0.0], atol=1e-6)
+
+
+def test_kbins_uniform():
+    X = np.array([[0.0], [2.5], [4.9], [5.0], [10.0]])
+    model = (KBinsDiscretizer().set_num_bins(2).set_strategy("uniform")
+             .fit(_t(X)))
+    out = model.transform(_t(X))[0]
+    np.testing.assert_array_equal(np.asarray(out["output"]).ravel(),
+                                  [0, 0, 0, 1, 1])
+
+
+def test_kbins_quantile_balances_counts():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(1000, 1))
+    model = (KBinsDiscretizer().set_num_bins(4).set_strategy("quantile")
+             .fit(_t(X)))
+    out = np.asarray(model.transform(_t(X))[0]["output"]).ravel()
+    counts = np.bincount(out.astype(int), minlength=4)
+    assert counts.min() > 200      # ~250 each for quantile bins
+
+
+def test_kbins_quantile_collapses_duplicate_edges():
+    # skewed: 90% zeros -> duplicate quantile edges collapse
+    X = np.concatenate([np.zeros(90), np.arange(1, 11)])[:, None]
+    model = (KBinsDiscretizer().set_num_bins(5).set_strategy("quantile")
+             .fit(_t(X)))
+    out = np.asarray(model.transform(_t(X))[0]["output"]).ravel()
+    assert out.max() < 5 and out.min() == 0
+
+
+def test_kbins_kmeans_separated_clusters():
+    X = np.concatenate([np.full(10, 0.0), np.full(10, 5.0),
+                        np.full(10, 10.0)])[:, None]
+    model = (KBinsDiscretizer().set_num_bins(3).set_strategy("kmeans")
+             .fit(_t(X)))
+    out = np.asarray(model.transform(_t(X))[0]["output"]).ravel()
+    np.testing.assert_array_equal(out, [0] * 10 + [1] * 10 + [2] * 10)
+
+
+def test_kbins_clamps_out_of_range_and_roundtrips(tmp_path):
+    X = np.linspace(0, 10, 50)[:, None]
+    model = (KBinsDiscretizer().set_num_bins(5).set_strategy("uniform")
+             .fit(_t(X)))
+    out = np.asarray(
+        model.transform(_t([[-100.0], [100.0]]))[0]["output"]).ravel()
+    np.testing.assert_array_equal(out, [0, 4])
+
+    path = str(tmp_path / "kbins")
+    model.save(path)
+    loaded = KBinsDiscretizerModel.load(path)
+    out2 = np.asarray(
+        loaded.transform(_t([[-100.0], [100.0]]))[0]["output"]).ravel()
+    np.testing.assert_array_equal(out2, [0, 4])
+
+
+def test_vector_indexer_maps_ascending_and_passes_continuous():
+    X = np.array([[1.0, 0.1], [5.0, 0.2], [1.0, 0.3], [9.0, 0.4],
+                  [5.0, 0.5], [9.0, 0.6], [1.0, 0.7], [5.0, 0.8],
+                  [9.0, 0.9], [1.0, 1.0], [5.0, 1.1], [9.0, 1.2],
+                  [1.0, 1.3], [5.0, 1.4], [9.0, 1.5], [1.0, 1.6],
+                  [5.0, 1.7], [9.0, 1.8], [1.0, 1.9], [5.0, 2.0],
+                  [9.0, 2.1]])
+    model = VectorIndexer().set_max_categories(5).fit(_t(X))
+    out = np.asarray(model.transform(_t(X))[0]["output"])
+    # col 0: {1,5,9} -> {0,1,2}; col 1: 21 distinct > 5 -> continuous
+    np.testing.assert_array_equal(out[:3, 0], [0, 1, 0])
+    np.testing.assert_array_equal(out[:, 1], X[:, 1])
+
+
+def test_vector_indexer_handle_invalid():
+    X = np.array([[1.0], [2.0], [3.0]])
+    model = VectorIndexer().set_max_categories(5).fit(_t(X))
+    with pytest.raises(ValueError, match="unseen"):
+        model.transform(_t([[7.0]]))
+
+    keep = model.set_handle_invalid("keep").transform(_t([[7.0], [2.0]]))[0]
+    np.testing.assert_array_equal(np.asarray(keep["output"]).ravel(), [3, 1])
+
+    skip = model.set_handle_invalid("skip").transform(_t([[7.0], [2.0]]))[0]
+    np.testing.assert_array_equal(np.asarray(skip["output"]).ravel(), [1])
+
+
+def test_vector_indexer_save_load(tmp_path):
+    X = np.array([[1.0], [2.0], [3.0]])
+    model = VectorIndexer().set_max_categories(5).fit(_t(X))
+    path = str(tmp_path / "vidx")
+    model.save(path)
+    loaded = VectorIndexerModel.load(path)
+    out = np.asarray(loaded.transform(_t([[3.0], [1.0]]))[0]["output"])
+    np.testing.assert_array_equal(out.ravel(), [2, 0])
