@@ -19,6 +19,12 @@ from .scalers import (  # noqa: F401
     StandardScaler,
     StandardScalerModel,
 )
+from .selectors import (  # noqa: F401
+    UnivariateFeatureSelector,
+    UnivariateFeatureSelectorModel,
+    VarianceThresholdSelector,
+    VarianceThresholdSelectorModel,
+)
 from .text import (  # noqa: F401
     FeatureHasher,
     HashingTF,

@@ -1,0 +1,293 @@
+"""Feature selectors: VarianceThresholdSelector and
+UnivariateFeatureSelector.
+
+Members of the Flink ML 2.x feature surface (``feature/
+variancethresholdselector``, ``feature/univariatefeatureselector`` in the
+library line; the reference snapshot ships neither — SURVEY §2.8).  Both
+are Estimator/Model pairs whose model data is the list of surviving
+feature indices; transform is one gather.
+
+Scoring reuses the stats machinery: chi-squared (categorical feature /
+categorical label, ``stats.chisqtest``), one-way ANOVA F (continuous /
+categorical, ``stats.anovatest`` — device one-hot matmuls), and the
+F-regression test (continuous / continuous) whose correlation reduction
+is a single jitted pass.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import Estimator, Model
+from ...data.table import Table
+from ...linalg import stack_vectors
+from ...params.param import FloatParam, ParamValidators, StringParam
+from ...params.shared import HasLabelCol
+from ...utils import persist
+from ..stats.anovatest import anova_f_scores, f_p_values
+from ..stats.chisqtest import _chi2_from_contingency, _p_values
+from .transforms import _InOutParams
+
+__all__ = [
+    "UnivariateFeatureSelector",
+    "UnivariateFeatureSelectorModel",
+    "VarianceThresholdSelector",
+    "VarianceThresholdSelectorModel",
+]
+
+
+class _IndexSelectingModel(Model):
+    """Shared Model body: keep the learned subset of feature columns."""
+
+    def __init__(self):
+        super().__init__()
+        self._indices: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs):
+        (t,) = inputs
+        self._indices = np.asarray(t["indices"], np.int64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require_model()
+        return [Table({"indices": self._indices})]
+
+    def _require_model(self) -> None:
+        if self._indices is None:
+            raise RuntimeError(
+                f"{type(self).__name__} has no model data; call "
+                "set_model_data() or fit first")
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        self._require_model()
+        X = stack_vectors(table[self.get_features_col()])
+        if self._indices.size and self._indices.max() >= X.shape[1]:
+            raise ValueError(
+                f"model selects index {self._indices.max()} but input has "
+                f"only {X.shape[1]} features")
+        return [table.with_column(self.get_output_col(),
+                                  X[:, self._indices])]
+
+    def save(self, path: str) -> None:
+        self._require_model()
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(path, "model", {"indices": self._indices})
+
+    @classmethod
+    def load(cls, path: str):
+        model = persist.load_stage_param(path)
+        model._indices = persist.load_model_arrays(
+            path, "model")["indices"].astype(np.int64)
+        return model
+
+
+# ---------------------------------------------------------------------------
+# VarianceThresholdSelector
+# ---------------------------------------------------------------------------
+
+class VarianceThresholdSelectorParams(_InOutParams):
+    VARIANCE_THRESHOLD = FloatParam(
+        "varianceThreshold",
+        "Features with sample variance <= this are removed.", default=0.0,
+        validator=ParamValidators.gt_eq(0.0))
+
+    def get_variance_threshold(self) -> float:
+        return self.get(
+            VarianceThresholdSelectorParams.VARIANCE_THRESHOLD)
+
+    def set_variance_threshold(self, value: float):
+        return self.set(
+            VarianceThresholdSelectorParams.VARIANCE_THRESHOLD, value)
+
+
+class VarianceThresholdSelectorModel(VarianceThresholdSelectorParams,
+                                     _IndexSelectingModel):
+    pass
+
+
+@jax.jit
+def _sample_variances(X):
+    n = X.shape[0]
+    mean = jnp.mean(X, axis=0, keepdims=True)
+    ss = jnp.sum((X - mean) ** 2, axis=0)
+    return ss / jnp.maximum(n - 1, 1)
+
+
+class VarianceThresholdSelector(VarianceThresholdSelectorParams,
+                                Estimator[VarianceThresholdSelectorModel]):
+    """Drops features whose *sample* variance (ddof=1) does not exceed the
+    threshold — the Flink ML / sklearn VarianceThresholdSelector rule."""
+
+    def fit(self, *inputs) -> VarianceThresholdSelectorModel:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()])
+        var = np.asarray(_sample_variances(jnp.asarray(X, jnp.float32)),
+                         np.float64)
+        keep = np.flatnonzero(var > self.get_variance_threshold())
+        model = VarianceThresholdSelectorModel()
+        model.copy_params_from(self)
+        model._indices = keep.astype(np.int64)
+        return model
+
+
+# ---------------------------------------------------------------------------
+# UnivariateFeatureSelector
+# ---------------------------------------------------------------------------
+
+def _chi2_scores(X: np.ndarray, y: np.ndarray) -> np.ndarray:
+    """Per-feature chi-squared p-values (categorical X, categorical y)."""
+    _, y_idx = np.unique(y, return_inverse=True)
+    n_label = int(y_idx.max()) + 1 if len(y_idx) else 0
+    stats, dofs = [], []
+    for j in range(X.shape[1]):
+        _, xj = np.unique(X[:, j], return_inverse=True)
+        n_feat = int(xj.max()) + 1 if len(xj) else 0
+        contingency = np.bincount(
+            xj * n_label + y_idx, minlength=n_feat * n_label).reshape(
+                n_feat, n_label).astype(np.float64)
+        stat, dof = _chi2_from_contingency(contingency)
+        stats.append(stat)
+        dofs.append(dof)
+    return _p_values(np.asarray(stats), np.asarray(dofs))
+
+
+@jax.jit
+def _pearson_r(X, y):
+    Xc = X - jnp.mean(X, axis=0, keepdims=True)
+    yc = y - jnp.mean(y)
+    num = Xc.T @ yc
+    den = jnp.sqrt(jnp.sum(Xc * Xc, axis=0) * jnp.sum(yc * yc))
+    return num / jnp.maximum(den, 1e-30)
+
+
+def _f_regression_scores(X: np.ndarray, y: np.ndarray) -> np.ndarray:
+    """Per-feature F-regression p-values (continuous X, continuous y):
+    F = r^2 / (1 - r^2) * (n - 2) with dof (1, n - 2)."""
+    n, d = X.shape
+    r = np.asarray(_pearson_r(jnp.asarray(X, jnp.float32),
+                              jnp.asarray(y, jnp.float32)), np.float64)
+    r = np.clip(r, -1.0, 1.0)
+    dfd = n - 2
+    with np.errstate(divide="ignore", invalid="ignore"):
+        f = r * r / np.maximum(1.0 - r * r, 1e-300) * dfd
+    return f_p_values(f, np.ones(d), np.full(d, dfd))
+
+
+_DEFAULT_THRESHOLDS = {"numTopFeatures": 50.0, "percentile": 0.1,
+                       "fpr": 0.05, "fdr": 0.05, "fwe": 0.05}
+
+
+class UnivariateFeatureSelectorParams(_InOutParams, HasLabelCol):
+    FEATURE_TYPE = StringParam(
+        "featureType", "categorical | continuous.", default=None,
+        validator=ParamValidators.in_array(["categorical", "continuous"]))
+    LABEL_TYPE = StringParam(
+        "labelType", "categorical | continuous.", default=None,
+        validator=ParamValidators.in_array(["categorical", "continuous"]))
+    SELECTION_MODE = StringParam(
+        "selectionMode",
+        "numTopFeatures | percentile | fpr | fdr | fwe.",
+        default="numTopFeatures",
+        validator=ParamValidators.in_array(
+            ["numTopFeatures", "percentile", "fpr", "fdr", "fwe"]))
+    SELECTION_THRESHOLD = FloatParam(
+        "selectionThreshold",
+        "Meaning depends on mode: top-k count, percentile fraction, or "
+        "p-value bound.  Defaults per mode when unset.", default=None)
+
+    def get_feature_type(self) -> str:
+        return self.get(UnivariateFeatureSelectorParams.FEATURE_TYPE)
+
+    def set_feature_type(self, value: str):
+        return self.set(UnivariateFeatureSelectorParams.FEATURE_TYPE, value)
+
+    def get_label_type(self) -> str:
+        return self.get(UnivariateFeatureSelectorParams.LABEL_TYPE)
+
+    def set_label_type(self, value: str):
+        return self.set(UnivariateFeatureSelectorParams.LABEL_TYPE, value)
+
+    def get_selection_mode(self) -> str:
+        return self.get(UnivariateFeatureSelectorParams.SELECTION_MODE)
+
+    def set_selection_mode(self, value: str):
+        return self.set(UnivariateFeatureSelectorParams.SELECTION_MODE,
+                        value)
+
+    def get_selection_threshold(self) -> float:
+        value = self.get(UnivariateFeatureSelectorParams.SELECTION_THRESHOLD)
+        if value is None:
+            return _DEFAULT_THRESHOLDS[self.get_selection_mode()]
+        return value
+
+    def set_selection_threshold(self, value: float):
+        return self.set(
+            UnivariateFeatureSelectorParams.SELECTION_THRESHOLD, value)
+
+
+class UnivariateFeatureSelectorModel(UnivariateFeatureSelectorParams,
+                                     _IndexSelectingModel):
+    pass
+
+
+def _select_by_mode(p: np.ndarray, mode: str, threshold: float) -> np.ndarray:
+    """Sorted indices of the selected features, per the Flink ML modes."""
+    d = len(p)
+    order = np.argsort(p, kind="stable")
+    if mode == "numTopFeatures":
+        return np.sort(order[: int(threshold)])
+    if mode == "percentile":
+        return np.sort(order[: int(d * threshold)])
+    if mode == "fpr":
+        return np.flatnonzero(p < threshold)
+    if mode == "fdr":
+        # Benjamini-Hochberg: largest m with p_(m) <= m/d * alpha
+        ranked = p[order]
+        below = np.flatnonzero(ranked <= (np.arange(1, d + 1) / d) * threshold)
+        if below.size == 0:
+            return np.zeros(0, np.int64)
+        return np.sort(order[: below[-1] + 1])
+    if mode == "fwe":
+        return np.flatnonzero(p < threshold / d)
+    raise ValueError(f"unknown selection mode {mode!r}")
+
+
+class UnivariateFeatureSelector(UnivariateFeatureSelectorParams,
+                                Estimator[UnivariateFeatureSelectorModel]):
+    """Scores each feature against the label with the test implied by
+    (featureType, labelType) — chi-squared for categorical/categorical,
+    ANOVA F for continuous/categorical, F-regression for
+    continuous/continuous (categorical features with a continuous label are
+    unsupported, as in Flink ML) — then keeps features by ``selectionMode``
+    over the p-values."""
+
+    def fit(self, *inputs) -> UnivariateFeatureSelectorModel:
+        (table,) = inputs
+        # param-system null check raises here if the types were never set
+        ftype, ltype = self.get_feature_type(), self.get_label_type()
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
+        y = np.asarray(table[self.get_label_col()])
+
+        if ftype == "categorical" and ltype == "categorical":
+            p = _chi2_scores(X, y)
+        elif ftype == "continuous" and ltype == "categorical":
+            _, p, _, _ = anova_f_scores(X, y)
+        elif ftype == "continuous" and ltype == "continuous":
+            p = _f_regression_scores(X, y.astype(np.float64))
+        else:
+            raise ValueError(
+                "categorical features with a continuous label are not "
+                "supported (no test defined); index the label instead")
+
+        indices = _select_by_mode(np.asarray(p, np.float64),
+                                  self.get_selection_mode(),
+                                  self.get_selection_threshold())
+        model = UnivariateFeatureSelectorModel()
+        model.copy_params_from(self)
+        model._indices = indices.astype(np.int64)
+        return model

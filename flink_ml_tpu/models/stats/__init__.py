@@ -1,1 +1,2 @@
+from .anovatest import ANOVATest  # noqa: F401
 from .chisqtest import ChiSqTest  # noqa: F401

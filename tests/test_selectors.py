@@ -1,0 +1,158 @@
+"""ANOVATest / VarianceThresholdSelector / UnivariateFeatureSelector."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature import (
+    UnivariateFeatureSelector,
+    UnivariateFeatureSelectorModel,
+    VarianceThresholdSelector,
+    VarianceThresholdSelectorModel,
+)
+from flink_ml_tpu.models.stats import ANOVATest
+
+
+def _t(X, y=None):
+    cols = {"features": np.asarray(X, np.float64)}
+    if y is not None:
+        cols["label"] = np.asarray(y)
+    return Table(cols)
+
+
+def test_anova_hand_computed_two_groups():
+    # groups {1,2,3} vs {5,6,7}: SSB = 24, SSW = 4, F = 24 / (4/4) = 24
+    X = np.array([[1.0], [2.0], [3.0], [5.0], [6.0], [7.0]])
+    y = np.array([0, 0, 0, 1, 1, 1])
+    out = ANOVATest().transform(_t(X, y))[0]
+    np.testing.assert_allclose(np.asarray(out["fValue"])[0], 24.0,
+                               rtol=1e-5)
+    assert np.asarray(out["degreesOfFreedom"])[0] == 5  # (k-1)+(n-k) = 1+4
+    # p-value for F(1,4)=24: 1 - CDF = 0.0080499 (F survival function)
+    np.testing.assert_allclose(np.asarray(out["pValue"])[0], 0.0080499,
+                               rtol=1e-4)
+
+
+def test_anova_unrelated_feature_high_p():
+    rng = np.random.default_rng(0)
+    X = np.column_stack([rng.normal(size=300),
+                         rng.normal(size=300)])
+    y = np.repeat([0, 1, 2], 100)
+    X[:, 0] += y * 3.0        # strongly separated
+    out = ANOVATest().transform(_t(X, y))[0]
+    p = np.asarray(out["pValue"])
+    assert p[0] < 1e-10 and p[1] > 0.01
+
+
+def test_variance_threshold_selector(tmp_path):
+    X = np.array([[1.0, 5.0, 0.1], [2.0, 5.0, 0.2], [3.0, 5.0, 0.1],
+                  [4.0, 5.0, 0.2]])
+    model = VarianceThresholdSelector().set_variance_threshold(0.05).fit(_t(X))
+    out = model.transform(_t(X))[0]
+    # col1 variance 0 and col2 variance ~0.0033 both drop; col0 stays
+    np.testing.assert_array_equal(np.asarray(out["output"]), X[:, :1])
+
+    path = str(tmp_path / "vts")
+    model.save(path)
+    loaded = VarianceThresholdSelectorModel.load(path)
+    np.testing.assert_array_equal(
+        np.asarray(loaded.transform(_t(X))[0]["output"]), X[:, :1])
+
+
+def test_variance_threshold_default_keeps_nonconstant():
+    X = np.array([[1.0, 7.0], [2.0, 7.0]])
+    model = VarianceThresholdSelector().fit(_t(X))
+    out = model.transform(_t(X))[0]
+    np.testing.assert_array_equal(np.asarray(out["output"]), X[:, :1])
+
+
+def _make_classif_data():
+    rng = np.random.default_rng(1)
+    n = 400
+    y = rng.integers(0, 2, size=n)
+    X = rng.normal(size=(n, 6))
+    X[:, 1] += y * 2.0          # informative
+    X[:, 4] += y * 1.5          # informative
+    return X, y
+
+
+def test_univariate_anova_top_k():
+    X, y = _make_classif_data()
+    sel = (UnivariateFeatureSelector()
+           .set_feature_type("continuous").set_label_type("categorical")
+           .set_selection_mode("numTopFeatures").set_selection_threshold(2))
+    model = sel.fit(_t(X, y))
+    np.testing.assert_array_equal(model._indices, [1, 4])
+    out = model.transform(_t(X))[0]
+    np.testing.assert_array_equal(np.asarray(out["output"]), X[:, [1, 4]])
+
+
+def test_univariate_fpr_fwe_fdr_modes():
+    X, y = _make_classif_data()
+    base = (UnivariateFeatureSelector()
+            .set_feature_type("continuous").set_label_type("categorical"))
+    for mode in ["fpr", "fdr", "fwe"]:
+        model = (base.set_selection_mode(mode)
+                 .set_selection_threshold(0.01).fit(_t(X, y)))
+        np.testing.assert_array_equal(model._indices, [1, 4]), mode
+
+
+def test_univariate_percentile_mode():
+    X, y = _make_classif_data()
+    model = (UnivariateFeatureSelector()
+             .set_feature_type("continuous").set_label_type("categorical")
+             .set_selection_mode("percentile").set_selection_threshold(0.34)
+             .fit(_t(X, y)))
+    np.testing.assert_array_equal(model._indices, [1, 4])  # 6*0.34 -> top 2
+
+
+def test_univariate_chi2_categorical():
+    rng = np.random.default_rng(2)
+    n = 600
+    y = rng.integers(0, 2, size=n)
+    X = np.column_stack([
+        y ^ (rng.random(n) < 0.05),      # nearly determines label
+        rng.integers(0, 3, size=n),      # noise
+    ]).astype(np.float64)
+    model = (UnivariateFeatureSelector()
+             .set_feature_type("categorical").set_label_type("categorical")
+             .set_selection_mode("numTopFeatures").set_selection_threshold(1)
+             .fit(_t(X, y)))
+    np.testing.assert_array_equal(model._indices, [0])
+
+
+def test_univariate_f_regression_continuous():
+    rng = np.random.default_rng(3)
+    n = 500
+    X = rng.normal(size=(n, 4))
+    y = 3.0 * X[:, 2] + rng.normal(scale=0.5, size=n)
+    model = (UnivariateFeatureSelector()
+             .set_feature_type("continuous").set_label_type("continuous")
+             .set_selection_mode("numTopFeatures").set_selection_threshold(1)
+             .fit(_t(X, y)))
+    np.testing.assert_array_equal(model._indices, [2])
+
+
+def test_univariate_unsupported_combination():
+    with pytest.raises(ValueError, match="not supported"):
+        (UnivariateFeatureSelector()
+         .set_feature_type("categorical").set_label_type("continuous")
+         .fit(_t(np.zeros((4, 2)), np.zeros(4))))
+
+
+def test_univariate_requires_types():
+    with pytest.raises(ValueError, match="not be null"):
+        UnivariateFeatureSelector().fit(_t(np.zeros((4, 2)), np.zeros(4)))
+
+
+def test_univariate_save_load(tmp_path):
+    X, y = _make_classif_data()
+    model = (UnivariateFeatureSelector()
+             .set_feature_type("continuous").set_label_type("categorical")
+             .set_selection_mode("numTopFeatures").set_selection_threshold(2)
+             .fit(_t(X, y)))
+    path = str(tmp_path / "ufs")
+    model.save(path)
+    loaded = UnivariateFeatureSelectorModel.load(path)
+    np.testing.assert_array_equal(loaded._indices, [1, 4])
+    assert loaded.get_selection_mode() == "numTopFeatures"
