@@ -25,6 +25,14 @@ from .selectors import (  # noqa: F401
     VarianceThresholdSelector,
     VarianceThresholdSelectorModel,
 )
+from .tokenize import (  # noqa: F401
+    CountVectorizer,
+    CountVectorizerModel,
+    NGram,
+    RegexTokenizer,
+    StopWordsRemover,
+    Tokenizer,
+)
 from .text import (  # noqa: F401
     FeatureHasher,
     HashingTF,

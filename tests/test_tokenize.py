@@ -1,0 +1,150 @@
+"""Tokenizer / RegexTokenizer / NGram / StopWordsRemover /
+CountVectorizer."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature import (
+    CountVectorizer,
+    CountVectorizerModel,
+    NGram,
+    RegexTokenizer,
+    StopWordsRemover,
+    Tokenizer,
+)
+
+
+def _text_table(*docs):
+    return Table({"features": np.asarray(docs, dtype=object)})
+
+
+def _tokens_table(*rows):
+    col = np.empty((len(rows),), object)
+    for i, r in enumerate(rows):
+        col[i] = list(r)
+    return Table({"features": col})
+
+
+def test_tokenizer_lowercases_and_splits():
+    out = Tokenizer().transform(
+        _text_table("Hello  World", "One TWO three", "tail  "))[0]
+    toks = out["output"]
+    # Java split("\\s") semantics: interior empties kept, trailing dropped
+    assert toks[0] == ["hello", "", "world"]
+    assert toks[1] == ["one", "two", "three"]
+    assert toks[2] == ["tail"]
+
+
+def test_regex_tokenizer_gaps_and_matches():
+    t = _text_table("a-b-c d")
+    gaps = (RegexTokenizer().set_pattern(r"[-\s]+")
+            .transform(t)[0]["output"])
+    assert gaps[0] == ["a", "b", "c", "d"]
+
+    words = (RegexTokenizer().set_pattern(r"\w+").set_gaps(False)
+             .transform(_text_table("Foo, bar!  baz?"))[0]["output"])
+    assert words[0] == ["foo", "bar", "baz"]
+
+
+def test_regex_tokenizer_min_length_and_case():
+    out = (RegexTokenizer().set_min_token_length(3).set_to_lowercase(False)
+           .transform(_text_table("An Owl ate my Sandwich"))[0]["output"])
+    assert out[0] == ["Owl", "ate", "Sandwich"]
+
+
+def test_ngram_basic_and_short_rows():
+    out = (NGram().set_n(2)
+           .transform(_tokens_table(["a", "b", "c"], ["x"]))[0]["output"])
+    assert out[0] == ["a b", "b c"]
+    assert out[1] == []
+
+
+def test_stop_words_remover_default_english():
+    out = (StopWordsRemover()
+           .transform(_tokens_table(["The", "red", "balloon", "and", "a",
+                                     "dog"]))[0]["output"])
+    assert out[0] == ["red", "balloon", "dog"]
+
+
+def test_stop_words_remover_case_sensitive_custom():
+    r = (StopWordsRemover().set_stop_words("The", "a")
+         .set_case_sensitive(True))
+    out = r.transform(_tokens_table(["The", "the", "a", "A"]))[0]["output"]
+    assert out[0] == ["the", "A"]
+
+
+def test_stop_words_remover_unknown_language():
+    with pytest.raises(ValueError, match="language"):
+        StopWordsRemover.load_default_stop_words("klingon")
+
+
+def _corpus():
+    return _tokens_table(
+        ["a", "b", "c"],
+        ["a", "b", "b", "c", "a"],
+        ["a"],
+    )
+
+
+def test_count_vectorizer_vocab_order_and_counts():
+    model = CountVectorizer().fit(_corpus())
+    # corpus term freq: a=4, b=3, c=2 -> vocabulary in that order
+    assert model.vocabulary == ["a", "b", "c"]
+    out = np.asarray(model.transform(_corpus())[0]["output"])
+    np.testing.assert_array_equal(out, [[1, 1, 1], [2, 2, 1], [1, 0, 0]])
+
+
+def test_count_vectorizer_vocab_size_and_min_df():
+    model = (CountVectorizer().set_vocabulary_size(2).fit(_corpus()))
+    assert model.vocabulary == ["a", "b"]
+
+    # c appears in 2/3 docs; min_df as a count of 3 excludes it and b (2 docs)
+    model = CountVectorizer().set_min_df(3.0).fit(_corpus())
+    assert model.vocabulary == ["a"]
+
+    # fractional max_df: drop terms in > 90% of docs (a is in all 3)
+    model = CountVectorizer().set_max_df(0.9).fit(_corpus())
+    assert model.vocabulary == ["b", "c"]
+
+
+def test_count_vectorizer_min_tf_and_binary():
+    model = CountVectorizer().fit(_corpus())
+    # min_tf count 2: only terms appearing >= 2x in the doc survive
+    out = np.asarray(
+        model.set_min_tf(2.0).transform(_corpus())[0]["output"])
+    np.testing.assert_array_equal(out[1], [2, 2, 0])
+    np.testing.assert_array_equal(out[0], [0, 0, 0])
+
+    binary = CountVectorizer().set_binary(True).fit(_corpus())
+    bout = np.asarray(binary.transform(_corpus())[0]["output"])
+    assert set(np.unique(bout)) <= {0.0, 1.0}
+
+
+def test_count_vectorizer_unseen_tokens_ignored():
+    model = CountVectorizer().fit(_corpus())
+    out = np.asarray(
+        model.transform(_tokens_table(["z", "a"]))[0]["output"])
+    np.testing.assert_array_equal(out, [[1, 0, 0]])
+
+
+def test_count_vectorizer_save_load(tmp_path):
+    model = CountVectorizer().set_vocabulary_size(2).fit(_corpus())
+    path = str(tmp_path / "cv")
+    model.save(path)
+    loaded = CountVectorizerModel.load(path)
+    assert loaded.vocabulary == ["a", "b"]
+    out = np.asarray(loaded.transform(_corpus())[0]["output"])
+    np.testing.assert_array_equal(out[:, 0], [1, 2, 1])
+
+
+def test_tokenize_pipeline_chains_into_hashing_idf():
+    """Tokenizer -> StopWordsRemover -> NGram chained through one Table."""
+    t = _text_table("the quick brown fox", "the lazy dog sleeps")
+    toks = Tokenizer().set_output_col("tokens").transform(t)[0]
+    kept = (StopWordsRemover().set_features_col("tokens")
+            .set_output_col("kept").transform(toks)[0])
+    grams = (NGram().set_features_col("kept").set_output_col("grams")
+             .transform(kept)[0])
+    assert grams["grams"][0] == ["quick brown", "brown fox"]
+    assert grams["grams"][1] == ["lazy dog", "dog sleeps"]
