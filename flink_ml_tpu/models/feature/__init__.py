@@ -19,6 +19,11 @@ from .scalers import (  # noqa: F401
     StandardScaler,
     StandardScalerModel,
 )
+from .lsh import (  # noqa: F401
+    MinHashLSH,
+    MinHashLSHModel,
+)
+from .randomsplitter import RandomSplitter  # noqa: F401
 from .selectors import (  # noqa: F401
     UnivariateFeatureSelector,
     UnivariateFeatureSelectorModel,

@@ -1,0 +1,210 @@
+"""MinHashLSH — locality-sensitive hashing for Jaccard similarity.
+
+Member of the Flink ML 2.x feature surface (``feature/lsh``; the
+reference snapshot ships no LSH — SURVEY §2.8).  Vectors are treated as
+binary sets (nonzero positions).  Each hash function is the classic
+universal hash ``((1 + i) * a + b) mod P`` minimized over the active
+indices; the model carries ``numHashTables`` tables of
+``numHashFunctionsPerTable`` functions.
+
+TPU split: the min-hash of a whole batch is one jitted reduce — the
+(d, m) hash-value table is precomputed once, and each row takes a masked
+min over its active indices (``where`` + ``min``), so the batch never
+leaves the device.  Candidate bucketing for the approximate queries is
+host-side set arithmetic over the tiny per-table signatures.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import Estimator, Model
+from ...data.table import Table
+from ...linalg import stack_vectors
+from ...params.param import IntParam, ParamValidators
+from ...params.shared import HasSeed
+from ...utils import persist
+from .transforms import _InOutParams
+
+__all__ = ["MinHashLSH", "MinHashLSHModel"]
+
+_MINHASH_PRIME = 2038074743
+
+
+class MinHashLSHParams(_InOutParams, HasSeed):
+    NUM_HASH_TABLES = IntParam(
+        "numHashTables", "Number of hash tables (OR-amplification).",
+        default=1, validator=ParamValidators.gt(0))
+    NUM_HASH_FUNCTIONS_PER_TABLE = IntParam(
+        "numHashFunctionsPerTable",
+        "Hash functions per table (AND-amplification).",
+        default=1, validator=ParamValidators.gt(0))
+
+    def get_num_hash_tables(self) -> int:
+        return self.get(MinHashLSHParams.NUM_HASH_TABLES)
+
+    def set_num_hash_tables(self, value: int):
+        return self.set(MinHashLSHParams.NUM_HASH_TABLES, value)
+
+    def get_num_hash_functions_per_table(self) -> int:
+        return self.get(MinHashLSHParams.NUM_HASH_FUNCTIONS_PER_TABLE)
+
+    def set_num_hash_functions_per_table(self, value: int):
+        return self.set(
+            MinHashLSHParams.NUM_HASH_FUNCTIONS_PER_TABLE, value)
+
+
+@jax.jit
+def _minhash_batch(X, hash_values):
+    """(n, d) binary batch x (d, m) int32 hash table -> (n, m) signatures:
+    min of each hash column over the row's active indices.  Integer math —
+    hash values reach ~2^31 and must compare exactly (f32 would merge
+    distinct buckets at 24-bit mantissa resolution)."""
+    active = X[:, :, None] > 0                       # (n, d, 1)
+    vals = jnp.where(active, hash_values[None, :, :],
+                     jnp.int32(_MINHASH_PRIME + 1))
+    return jnp.min(vals, axis=1)                     # (n, m)
+
+
+def _jaccard_distance(a: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """1 - |A ∩ B| / |A ∪ B| between one binary row and a batch."""
+    a = a > 0
+    B = B > 0
+    inter = (a[None, :] & B).sum(axis=1)
+    union = (a[None, :] | B).sum(axis=1)
+    return 1.0 - inter / np.maximum(union, 1)
+
+
+class MinHashLSHModel(MinHashLSHParams, Model):
+    def __init__(self):
+        super().__init__()
+        self._coeff: Optional[np.ndarray] = None     # (m, 2) [a, b]
+
+    def set_model_data(self, *inputs) -> "MinHashLSHModel":
+        (t,) = inputs
+        self._coeff = np.asarray(t["coefficients"], np.int64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require_model()
+        return [Table({"coefficients": self._coeff})]
+
+    def _require_model(self) -> None:
+        if self._coeff is None:
+            raise RuntimeError("MinHashLSHModel has no model data")
+
+    # -- hashing ------------------------------------------------------------
+    def _signatures(self, X: np.ndarray) -> np.ndarray:
+        """(n, tables, fns) float64 signatures."""
+        self._require_model()
+        if np.any((X > 0).sum(axis=1) == 0):
+            raise ValueError("MinHashLSH requires at least one nonzero "
+                             "entry per vector")
+        d = X.shape[1]
+        idx = np.arange(1, d + 1, dtype=np.int64)[:, None]   # 1-based
+        a, b = self._coeff[:, 0][None, :], self._coeff[:, 1][None, :]
+        table = ((idx * a + b) % _MINHASH_PRIME).astype(np.int32)
+        sig = np.asarray(_minhash_batch(
+            jnp.asarray(X > 0, jnp.float32), jnp.asarray(table)), np.float64)
+        return sig.reshape(X.shape[0], self.get_num_hash_tables(),
+                           self.get_num_hash_functions_per_table())
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()])
+        return [table.with_column(self.get_output_col(),
+                                  self._signatures(X))]
+
+    # -- approximate queries -------------------------------------------------
+    def _bucket_sets(self, sig: np.ndarray) -> List[set]:
+        """Per-row set of hashable per-table bucket ids."""
+        return [{(t, tuple(sig[i, t])) for t in range(sig.shape[1])}
+                for i in range(sig.shape[0])]
+
+    def approx_nearest_neighbors(self, dataset: Table, key: np.ndarray,
+                                 k: int, features_col: Optional[str] = None
+                                 ) -> Table:
+        """Rows of ``dataset`` sharing >= 1 hash bucket with ``key``,
+        ranked by true Jaccard distance, top-k; appends a ``distCol``
+        column (falls back to a full scan when no bucket collides, like
+        the Flink ML implementation's single-probe behavior does not —
+        documented deviation for usability)."""
+        col = features_col or self.get_features_col()
+        X = stack_vectors(dataset[col])
+        key = np.asarray(key, np.float64).ravel()
+        sig = self._signatures(X)
+        key_sig = self._signatures(key[None, :])
+        key_buckets = self._bucket_sets(key_sig)[0]
+        rows = self._bucket_sets(sig)
+        cand = np.asarray([bool(r & key_buckets) for r in rows])
+        if not cand.any():
+            cand = np.ones(len(rows), bool)
+        idx = np.flatnonzero(cand)
+        dist = _jaccard_distance(key, X[idx])
+        order = np.argsort(dist, kind="stable")[:k]
+        out = dataset.select_rows(idx[order])
+        return out.with_column("distCol", dist[order])
+
+    def approx_similarity_join(self, table_a: Table, table_b: Table,
+                               threshold: float, id_col: str) -> Table:
+        """(idA, idB, distCol) for cross pairs sharing >= 1 bucket with
+        Jaccard distance < threshold."""
+        Xa = stack_vectors(table_a[self.get_features_col()])
+        Xb = stack_vectors(table_b[self.get_features_col()])
+        buckets_a = self._bucket_sets(self._signatures(Xa))
+        buckets_b = self._bucket_sets(self._signatures(Xb))
+        by_bucket: dict = {}
+        for j, bs in enumerate(buckets_b):
+            for bucket in bs:
+                by_bucket.setdefault(bucket, []).append(j)
+        ids_a, ids_b, dists = [], [], []
+        for i, bs in enumerate(buckets_a):
+            cand = sorted({j for bucket in bs
+                           for j in by_bucket.get(bucket, [])})
+            if not cand:
+                continue
+            dist = _jaccard_distance(Xa[i], Xb[np.asarray(cand)])
+            for j, dj in zip(cand, dist):
+                if dj < threshold:
+                    ids_a.append(table_a[id_col][i])
+                    ids_b.append(table_b[id_col][j])
+                    dists.append(dj)
+        return Table({"idA": np.asarray(ids_a), "idB": np.asarray(ids_b),
+                      "distCol": np.asarray(dists, np.float64)})
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path: str) -> None:
+        self._require_model()
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(path, "model",
+                                  {"coefficients": self._coeff})
+
+    @classmethod
+    def load(cls, path: str) -> "MinHashLSHModel":
+        model = persist.load_stage_param(path)
+        model._coeff = persist.load_model_arrays(
+            path, "model")["coefficients"].astype(np.int64)
+        return model
+
+
+class MinHashLSH(MinHashLSHParams, Estimator[MinHashLSHModel]):
+    """Draws the (a, b) coefficient pairs uniformly from [1, P) x [0, P)
+    under ``seed`` — the model is data-independent (fit ignores row
+    values, as in the Flink ML MinHashLSH)."""
+
+    def fit(self, *inputs) -> MinHashLSHModel:
+        rng = np.random.default_rng(self.get_seed())
+        m = (self.get_num_hash_tables()
+             * self.get_num_hash_functions_per_table())
+        coeff = np.column_stack([
+            rng.integers(1, _MINHASH_PRIME, size=m),
+            rng.integers(0, _MINHASH_PRIME, size=m),
+        ]).astype(np.int64)
+        model = MinHashLSHModel()
+        model.copy_params_from(self)
+        model._coeff = coeff
+        return model

@@ -1,0 +1,184 @@
+"""Swing — item-item similarity from user-item-user graph structure.
+
+Member of the Flink ML 2.x recommendation surface (``recommendation/
+swing``; the reference snapshot ships no recommenders — SURVEY §2.8).
+AlgoOperator: transform(user-item interaction table) -> one row per item
+with its top-k similar items and scores:
+
+    sim(i, j) = sum over unordered user pairs {u, v} in U_i ∩ U_j of
+                w_u * w_v / (alpha2 + |I_u ∩ I_v|),
+    w_u = (|I_u| + alpha1) ** -beta
+
+TPU design: after host-side id indexing and behavior filtering, the
+whole score tensor is device matmul work over the binary user-item
+matrix B — the user-user co-count matrix ``B @ B.T`` builds the pair
+kernel K once, and each item's row of similarities is
+``colsum((B ⊙ b_i) ⊙ (K @ (B ⊙ b_i)))``, a ``lax.scan`` of MXU matmuls
+rather than the reference family's per-pair hash-set intersections.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import AlgoOperator
+from ...data.table import Table
+from ...params.param import FloatParam, IntParam, ParamValidators
+from .als import ALSModelParams
+
+__all__ = ["Swing"]
+
+
+class SwingParams(AlgoOperator):
+    USER_COL = ALSModelParams.USER_COL
+    ITEM_COL = ALSModelParams.ITEM_COL
+    K = IntParam("k", "Max similar items per item.", default=100,
+                 validator=ParamValidators.gt(0))
+    MIN_USER_BEHAVIOR = IntParam(
+        "minUserBehavior", "Drop users with fewer interactions.", default=10,
+        validator=ParamValidators.gt(0))
+    MAX_USER_BEHAVIOR = IntParam(
+        "maxUserBehavior", "Drop users with more interactions.",
+        default=1000, validator=ParamValidators.gt(0))
+    MAX_USER_NUM_PER_ITEM = IntParam(
+        "maxUserNumPerItem",
+        "Random user subsample per item above this size.", default=1000,
+        validator=ParamValidators.gt(0))
+    ALPHA1 = IntParam("alpha1", "User-weight smoothing.", default=15,
+                      validator=ParamValidators.gt_eq(0))
+    ALPHA2 = IntParam("alpha2", "Pair-kernel smoothing.", default=0,
+                      validator=ParamValidators.gt_eq(0))
+    BETA = FloatParam("beta", "User-weight decay exponent.", default=0.3,
+                      validator=ParamValidators.gt_eq(0.0))
+
+    def get_user_col(self) -> str:
+        return self.get(SwingParams.USER_COL)
+
+    def set_user_col(self, value: str):
+        return self.set(SwingParams.USER_COL, value)
+
+    def get_item_col(self) -> str:
+        return self.get(SwingParams.ITEM_COL)
+
+    def set_item_col(self, value: str):
+        return self.set(SwingParams.ITEM_COL, value)
+
+    def get_k(self) -> int:
+        return self.get(SwingParams.K)
+
+    def set_k(self, value: int):
+        return self.set(SwingParams.K, value)
+
+    def get_min_user_behavior(self) -> int:
+        return self.get(SwingParams.MIN_USER_BEHAVIOR)
+
+    def set_min_user_behavior(self, value: int):
+        return self.set(SwingParams.MIN_USER_BEHAVIOR, value)
+
+    def get_max_user_behavior(self) -> int:
+        return self.get(SwingParams.MAX_USER_BEHAVIOR)
+
+    def set_max_user_behavior(self, value: int):
+        return self.set(SwingParams.MAX_USER_BEHAVIOR, value)
+
+    def get_max_user_num_per_item(self) -> int:
+        return self.get(SwingParams.MAX_USER_NUM_PER_ITEM)
+
+    def set_max_user_num_per_item(self, value: int):
+        return self.set(SwingParams.MAX_USER_NUM_PER_ITEM, value)
+
+    def get_alpha1(self) -> int:
+        return self.get(SwingParams.ALPHA1)
+
+    def set_alpha1(self, value: int):
+        return self.set(SwingParams.ALPHA1, value)
+
+    def get_alpha2(self) -> int:
+        return self.get(SwingParams.ALPHA2)
+
+    def set_alpha2(self, value: int):
+        return self.set(SwingParams.ALPHA2, value)
+
+    def get_beta(self) -> float:
+        return self.get(SwingParams.BETA)
+
+    def set_beta(self, value: float):
+        return self.set(SwingParams.BETA, value)
+
+
+@jax.jit
+def _swing_scores(B, alpha1, alpha2, beta):
+    """(n_users, n_items) binary matrix -> (n_items, n_items) Swing
+    similarity.  Unordered user pairs: ordered-sum / 2 with a zeroed
+    kernel diagonal."""
+    counts = jnp.sum(B, axis=1)                         # |I_u|
+    # zero-count users (filtered out) must carry zero weight — with
+    # alpha1=0 their (0)**-beta would be inf and poison K via 0*inf=NaN
+    w = jnp.where(counts > 0, (counts + alpha1) ** (-beta), 0.0)
+    uu = B @ B.T                                        # |I_u ∩ I_v|
+    # a user pair in U_i ∩ U_j always shares >= 2 items, so uu == 0 pairs
+    # contribute nothing; zeroing them also guards alpha2=0 division
+    K = jnp.where(uu > 0,
+                  (w[:, None] * w[None, :]) / (alpha2 + uu), 0.0)
+    K = K * (1.0 - jnp.eye(B.shape[0], dtype=B.dtype))  # exclude u == v
+
+    def per_item(_, b_i):
+        M = B * b_i[:, None]                            # users of item i
+        sim_i = jnp.sum(M * (K @ M), axis=0)            # (n_items,)
+        return None, sim_i
+
+    _, S = jax.lax.scan(per_item, None, B.T)
+    return S / 2.0
+
+
+class Swing(SwingParams):
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        users_raw = np.asarray(table[self.get_user_col()])
+        items_raw = np.asarray(table[self.get_item_col()])
+        user_vals, u_idx = np.unique(users_raw, return_inverse=True)
+        item_vals, i_idx = np.unique(items_raw, return_inverse=True)
+        n_users, n_items = len(user_vals), len(item_vals)
+
+        B = np.zeros((n_users, n_items), np.float32)
+        B[u_idx, i_idx] = 1.0
+
+        # behavior filtering: users outside [min, max] interactions drop out
+        per_user = B.sum(axis=1)
+        keep = ((per_user >= self.get_min_user_behavior())
+                & (per_user <= self.get_max_user_behavior()))
+        B[~keep] = 0.0
+
+        # per-item user-count cap: deterministic seeded subsample
+        cap = self.get_max_user_num_per_item()
+        rng = np.random.default_rng(0)
+        for j in range(n_items):
+            users_j = np.flatnonzero(B[:, j])
+            if len(users_j) > cap:
+                drop = rng.choice(users_j, len(users_j) - cap, replace=False)
+                B[drop, j] = 0.0
+
+        S = np.asarray(_swing_scores(
+            jnp.asarray(B), jnp.float32(self.get_alpha1()),
+            jnp.float32(self.get_alpha2()),
+            jnp.float32(self.get_beta())), np.float64)
+        np.fill_diagonal(S, 0.0)
+
+        k = self.get_k()
+        sim_items = np.empty((n_items,), object)
+        sim_scores = np.empty((n_items,), object)
+        for j in range(n_items):
+            order = np.argsort(-S[j], kind="stable")
+            order = order[S[j][order] > 0][:k]
+            sim_items[j] = list(item_vals[order])
+            sim_scores[j] = [float(s) for s in S[j][order]]
+
+        return [Table({
+            self.get_item_col(): item_vals,
+            "similar_items": sim_items,
+            "scores": sim_scores,
+        })]

@@ -1,0 +1,200 @@
+"""MinHashLSH / RandomSplitter / Swing."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature import (
+    MinHashLSH,
+    MinHashLSHModel,
+    RandomSplitter,
+)
+from flink_ml_tpu.models.recommendation import Swing
+
+
+# ---------------------------------------------------------------------------
+# RandomSplitter
+# ---------------------------------------------------------------------------
+
+def test_random_splitter_partitions_all_rows():
+    t = Table({"x": np.arange(1000), "y": np.arange(1000) * 2.0})
+    parts = RandomSplitter().set_weights(0.8, 0.2).transform(t)
+    assert len(parts) == 2
+    assert parts[0].num_rows + parts[1].num_rows == 1000
+    # rough proportions under the default seed
+    assert 700 < parts[0].num_rows < 900
+    # no row lost or duplicated
+    merged = np.sort(np.concatenate([parts[0]["x"], parts[1]["x"]]))
+    np.testing.assert_array_equal(merged, np.arange(1000))
+    # y stays aligned with x
+    np.testing.assert_array_equal(parts[1]["y"], parts[1]["x"] * 2.0)
+
+
+def test_random_splitter_deterministic_under_seed():
+    t = Table({"x": np.arange(100)})
+    a = RandomSplitter().set_seed(7).set_weights(1.0, 1.0).transform(t)
+    b = RandomSplitter().set_seed(7).set_weights(1.0, 1.0).transform(t)
+    np.testing.assert_array_equal(a[0]["x"], b[0]["x"])
+
+
+def test_random_splitter_rejects_bad_weights():
+    # the validator sits on the param, so the generic set() path rejects too
+    with pytest.raises(ValueError, match="invalid value"):
+        RandomSplitter().set_weights(1.0, -1.0)
+    with pytest.raises(ValueError, match="invalid value"):
+        RandomSplitter().set_weights(1.0)
+    with pytest.raises(ValueError, match="invalid value"):
+        RandomSplitter().set(RandomSplitter.WEIGHTS, (1.0, -1.0))
+
+
+def test_swing_rejects_negative_smoothing():
+    with pytest.raises(ValueError, match="invalid value"):
+        Swing().set_alpha1(-2)
+    with pytest.raises(ValueError, match="invalid value"):
+        Swing().set_alpha2(-1)
+    with pytest.raises(ValueError, match="invalid value"):
+        Swing().set_beta(-0.5)
+
+
+def test_random_splitter_three_way():
+    t = Table({"x": np.arange(600)})
+    parts = RandomSplitter().set_weights(1.0, 1.0, 1.0).transform(t)
+    assert len(parts) == 3
+    assert sum(p.num_rows for p in parts) == 600
+
+
+# ---------------------------------------------------------------------------
+# MinHashLSH
+# ---------------------------------------------------------------------------
+
+def _binary_table(rows):
+    return Table({"features": np.asarray(rows, np.float64)})
+
+
+def test_minhash_identical_vectors_identical_signatures():
+    t = _binary_table([[1, 0, 1, 0, 1], [1, 0, 1, 0, 1], [0, 1, 0, 1, 0]])
+    model = (MinHashLSH().set_num_hash_tables(3)
+             .set_num_hash_functions_per_table(2).fit(t))
+    sig = np.asarray(model.transform(t)[0]["output"])
+    assert sig.shape == (3, 3, 2)
+    np.testing.assert_array_equal(sig[0], sig[1])
+    assert not np.array_equal(sig[0], sig[2])
+
+
+def test_minhash_signature_is_min_of_active_hashes():
+    model = (MinHashLSH().set_num_hash_tables(1)
+             .set_num_hash_functions_per_table(1).set_seed(3)
+             .fit(_binary_table([[1, 1, 0]])))
+    a, b = model._coeff[0]
+    P = 2038074743
+    t = _binary_table([[1, 1, 0]])
+    sig = np.asarray(model.transform(t)[0]["output"]).ravel()[0]
+    expected = min(((1 + 0) * a + b) % P, ((1 + 1) * a + b) % P)
+    assert sig == expected
+
+
+def test_minhash_rejects_empty_vectors():
+    model = MinHashLSH().fit(_binary_table([[1, 0]]))
+    with pytest.raises(ValueError, match="nonzero"):
+        model.transform(_binary_table([[0, 0]]))
+
+
+def test_minhash_nearest_neighbors_ranks_by_jaccard():
+    rows = [
+        [1, 1, 1, 1, 0, 0, 0, 0],    # jaccard dist to key: 0
+        [1, 1, 1, 0, 0, 0, 0, 0],    # 0.25
+        [0, 0, 0, 0, 1, 1, 1, 1],    # 1.0
+    ]
+    t = _binary_table(rows)
+    model = (MinHashLSH().set_num_hash_tables(5).fit(t))
+    key = np.asarray([1, 1, 1, 1, 0, 0, 0, 0], np.float64)
+    out = model.approx_nearest_neighbors(t, key, k=2)
+    dist = np.asarray(out["distCol"])
+    np.testing.assert_allclose(dist, [0.0, 0.25])
+
+
+def test_minhash_similarity_join():
+    ta = Table({"features": np.asarray(
+        [[1, 1, 1, 0, 0], [0, 0, 1, 1, 1]], np.float64),
+        "id": np.asarray([10, 11])})
+    tb = Table({"features": np.asarray(
+        [[1, 1, 1, 0, 0], [1, 0, 0, 0, 1]], np.float64),
+        "id": np.asarray([20, 21])})
+    model = (MinHashLSH().set_num_hash_tables(8).fit(ta))
+    joined = model.approx_similarity_join(ta, tb, threshold=0.5,
+                                          id_col="id")
+    pairs = set(zip(np.asarray(joined["idA"]).tolist(),
+                    np.asarray(joined["idB"]).tolist()))
+    assert (10, 20) in pairs            # identical rows always join
+    for d in np.asarray(joined["distCol"]):
+        assert d < 0.5
+
+
+def test_minhash_save_load(tmp_path):
+    t = _binary_table([[1, 0, 1], [0, 1, 1]])
+    model = (MinHashLSH().set_num_hash_tables(2).set_seed(5).fit(t))
+    path = str(tmp_path / "lsh")
+    model.save(path)
+    loaded = MinHashLSHModel.load(path)
+    np.testing.assert_array_equal(
+        np.asarray(model.transform(t)[0]["output"]),
+        np.asarray(loaded.transform(t)[0]["output"]))
+
+
+# ---------------------------------------------------------------------------
+# Swing
+# ---------------------------------------------------------------------------
+
+def test_swing_hand_computed_two_items():
+    # u0:{i0,i1} u1:{i0,i1} u2:{i0}; alpha1=0, beta=1 -> w = 1/|I_u|
+    # sim(i0,i1): single user pair {u0,u1}, |I_u0 ∩ I_u1| = 2, alpha2=1
+    #   -> 0.5 * 0.5 / (1 + 2) = 1/12
+    t = Table({
+        "user": np.asarray([0, 0, 1, 1, 2]),
+        "item": np.asarray(["i0", "i1", "i0", "i1", "i0"]),
+    })
+    out = (Swing().set_min_user_behavior(1).set_alpha1(0).set_alpha2(1)
+           .set_beta(1.0).transform(t)[0])
+    items = np.asarray(out["item"])
+    i0 = int(np.flatnonzero(items == "i0")[0])
+    assert out["similar_items"][i0] == ["i1"]
+    np.testing.assert_allclose(out["scores"][i0], [1.0 / 12.0], rtol=1e-5)
+
+
+def test_swing_symmetry_and_topk():
+    rng = np.random.default_rng(0)
+    users = rng.integers(0, 30, size=400)
+    items = rng.integers(0, 8, size=400)
+    t = Table({"user": users, "item": items})
+    out = (Swing().set_min_user_behavior(1).set_k(3).transform(t)[0])
+    assert out.num_rows == len(np.unique(items))
+    for j in range(out.num_rows):
+        assert len(out["similar_items"][j]) <= 3
+        scores = out["scores"][j]
+        assert all(scores[i] >= scores[i + 1]
+                   for i in range(len(scores) - 1))
+
+
+def test_swing_min_user_behavior_filters():
+    # u2 has only 1 interaction; with min=2 it contributes nothing
+    t = Table({
+        "user": np.asarray([0, 0, 1, 1, 2]),
+        "item": np.asarray([0, 1, 0, 1, 0]),
+    })
+    full = (Swing().set_min_user_behavior(1).set_alpha1(0).set_alpha2(1)
+            .set_beta(1.0).transform(t)[0])
+    filt = (Swing().set_min_user_behavior(2).set_alpha1(0).set_alpha2(1)
+            .set_beta(1.0).transform(t)[0])
+    # same pair survives (u0,u1 both have 2 interactions)
+    i0 = 0
+    np.testing.assert_allclose(filt["scores"][i0], full["scores"][i0])
+
+
+def test_swing_no_common_users_no_similarity():
+    t = Table({
+        "user": np.asarray([0, 1]),
+        "item": np.asarray([0, 1]),
+    })
+    out = Swing().set_min_user_behavior(1).transform(t)[0]
+    assert out["similar_items"][0] == []
+    assert out["similar_items"][1] == []
