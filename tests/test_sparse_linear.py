@@ -253,3 +253,110 @@ def test_midtrain_checkpoint_resume_through_estimator(tmp_path, rng):
                            num_features=8, checkpoint=ck,
                            checkpoint_every_steps=2, resume=True)
     assert np.all(np.isfinite(m2._state.coefficients))
+
+
+# ---------------------------------------------------------------------------
+# Blocked (128-lane) gather/scatter path + mixed dense/categorical trainer
+# ---------------------------------------------------------------------------
+
+def test_blocked_gather_scatter_bitwise_equals_elementwise(rng):
+    """d % 128 == 0 switches to the row-blocked path; the arithmetic must
+    be exactly the elementwise gather/scatter."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.models.common import sgd as sgd_mod
+
+    d = 512
+    w = jnp.asarray(rng.normal(size=d), jnp.float32)
+    idx = jnp.asarray(rng.integers(0, d, size=(64, 7)), jnp.int32)
+    upd = jnp.asarray(rng.normal(size=64 * 7), jnp.float32)
+
+    assert sgd_mod._use_blocked(d)
+    np.testing.assert_array_equal(
+        np.asarray(sgd_mod._blocked_gather(w, idx)), np.asarray(w[idx]))
+    np.testing.assert_array_equal(
+        np.asarray(sgd_mod._blocked_scatter_add(w, idx, upd)),
+        np.asarray(w.at[idx.reshape(-1)].add(upd)))
+    assert not sgd_mod._use_blocked(500)
+
+
+def test_sgd_fit_sparse_blocked_dim_matches_dense_oracle(rng):
+    """Same oracle as above but at d=256 so the blocked path is the one
+    exercised."""
+    idx, vals, dense, y = _sparse_problem(rng, n=192, d=256, nnz=5)
+    cfg = SGDConfig(learning_rate=0.5, max_epochs=6, global_batch_size=64,
+                    tol=0, seed=2)
+    dense_state, dense_log = sgd_fit(LOSSES["logistic"], dense, y, None, cfg)
+    sparse_state, sparse_log = sgd_fit_sparse(
+        LOSSES["logistic"], idx, vals, y, None, 256, cfg)
+    np.testing.assert_allclose(sparse_state.coefficients,
+                               dense_state.coefficients, atol=1e-5)
+    np.testing.assert_allclose(sparse_log, dense_log, atol=1e-5)
+
+
+def _mixed_problem(rng, n=256, n_dense=5, n_cat=3, d=256):
+    dense = rng.normal(size=(n, n_dense)).astype(np.float32)
+    cat = rng.integers(n_dense, d, size=(n, n_cat)).astype(np.int32)
+    w_true = rng.normal(size=(d,))
+    margin = dense @ w_true[:n_dense] + w_true[cat].sum(axis=1)
+    y = (margin > 0).astype(np.float64)
+    return dense, cat, y
+
+
+def test_sgd_fit_mixed_matches_sparse_encoding(rng):
+    """The mixed trainer must agree with sgd_fit_sparse on the equivalent
+    (indices, values) encoding: dense slot j -> (j, x_j), cat -> (idx, 1)."""
+    from flink_ml_tpu.models.common.sgd import sgd_fit_mixed
+
+    n, n_dense, n_cat, d = 256, 5, 3, 256
+    dense, cat, y = _mixed_problem(rng, n, n_dense, n_cat, d)
+    idx = np.concatenate(
+        [np.broadcast_to(np.arange(n_dense, dtype=np.int32), (n, n_dense)),
+         cat], axis=1)
+    vals = np.concatenate(
+        [dense, np.ones((n, n_cat), np.float32)], axis=1)
+
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=6, global_batch_size=64,
+                    tol=0, seed=5)
+    sparse_state, sparse_log = sgd_fit_sparse(
+        LOSSES["logistic"], idx, vals, y, None, d, cfg)
+    mixed_state, mixed_log = sgd_fit_mixed(
+        LOSSES["logistic"], dense, cat, y, None, d, cfg)
+    np.testing.assert_allclose(mixed_state.coefficients,
+                               sparse_state.coefficients, atol=1e-5)
+    np.testing.assert_allclose(mixed_state.intercept, sparse_state.intercept,
+                               atol=1e-5)
+    np.testing.assert_allclose(mixed_log, sparse_log, atol=1e-5)
+    # and it learned the problem
+    assert mixed_log[-1] < mixed_log[0] * 0.7
+
+
+def test_sgd_fit_mixed_regularized_matches_sparse(rng):
+    from flink_ml_tpu.models.common.sgd import sgd_fit_mixed
+
+    n, n_dense, n_cat, d = 192, 4, 2, 128
+    dense, cat, y = _mixed_problem(rng, n, n_dense, n_cat, d)
+    idx = np.concatenate(
+        [np.broadcast_to(np.arange(n_dense, dtype=np.int32), (n, n_dense)),
+         cat], axis=1)
+    vals = np.concatenate(
+        [dense, np.ones((n, n_cat), np.float32)], axis=1)
+
+    cfg = SGDConfig(learning_rate=0.3, max_epochs=5, global_batch_size=64,
+                    reg=0.05, elastic_net=0.3, tol=0, seed=7)
+    sparse_state, _ = sgd_fit_sparse(
+        LOSSES["logistic"], idx, vals, y, None, d, cfg)
+    mixed_state, _ = sgd_fit_mixed(
+        LOSSES["logistic"], dense, cat, y, None, d, cfg)
+    np.testing.assert_allclose(mixed_state.coefficients,
+                               sparse_state.coefficients, atol=1e-5)
+
+
+def test_sgd_fit_mixed_rejects_bad_shapes(rng):
+    from flink_ml_tpu.models.common.sgd import sgd_fit_mixed
+
+    dense = rng.normal(size=(16, 8)).astype(np.float32)
+    cat = rng.integers(0, 4, size=(16, 2)).astype(np.int32)
+    with pytest.raises(ValueError, match="exceeds"):
+        sgd_fit_mixed(LOSSES["logistic"], dense, cat,
+                      np.zeros(16), None, 4, SGDConfig())
