@@ -152,7 +152,7 @@ def bench_logreg(results: dict) -> None:
     steps = rows // batch
 
     cfg = SGDConfig(learning_rate=0.5, tol=0)
-    mixed_update = _mixed_update(logistic_loss, cfg, n_dense=13)
+    mixed_update = _mixed_update(logistic_loss, cfg)
     sparse_update = _sparse_update(logistic_loss, cfg)
 
     def make_runner(update):

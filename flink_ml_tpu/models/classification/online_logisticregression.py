@@ -111,6 +111,17 @@ class OnlineLogisticRegression(HasFeaturesCol, HasLabelCol, HasWeightCol,
             y = np.asarray(t[lab], np.float32)
             w = (np.asarray(t[wcol], np.float32) if wcol
                  else np.ones_like(y))
+            if kind == "mixed":
+                # FTRL's update is (indices, values)-shaped; re-encode the
+                # mixed layout as dense slots [0, nd) + unit-value hashed
+                dense, cat = feats
+                nd = dense.shape[1]
+                idx = np.concatenate(
+                    [np.broadcast_to(np.arange(nd, dtype=np.int32),
+                                     dense.shape), cat], axis=1)
+                vals = np.concatenate(
+                    [dense, np.ones(cat.shape, np.float32)], axis=1)
+                return ("sparse", (idx, vals), y, w, 0)
             if kind == "sparse":
                 idx, vals, dim = feats
                 return ("sparse", (idx, vals), y, w, dim)

@@ -299,21 +299,21 @@ def _sparse_update(loss_fn: LossFn, config: SGDConfig):
     return update
 
 
-def _mixed_update(loss_fn: LossFn, config: SGDConfig, n_dense: int):
+def _mixed_update(loss_fn: LossFn, config: SGDConfig):
     """Single-batch update for the Criteo-native layout: ``dense`` features
-    occupying weight slots ``[0, n_dense)`` plus hashed ``cat`` indices with
-    implicit value 1.0 anywhere in ``[0, d)``.  The dense slots score and
-    update through a tiny matvec (no gather/scatter at all — on TPU the
-    random access IS the cost, measured ~8 ns/element), so only the
-    categorical slots pay it; their gradient is just ``dloss/dmargin`` per
-    slot.  Overlapping indices are handled exactly: both contributions
-    simply add."""
+    occupying weight slots ``[0, dense.shape[-1])`` plus hashed ``cat``
+    indices with implicit value 1.0 anywhere in ``[0, d)``.  The dense
+    slots score and update through a tiny matvec (no gather/scatter at all
+    — on TPU the random access IS the cost, measured ~8 ns/element), so
+    only the categorical slots pay it; their gradient is just
+    ``dloss/dmargin`` per slot.  Overlapping indices are handled exactly:
+    both contributions simply add."""
     lr = config.learning_rate
     finish = _finish_sparse_step(config)
 
     def update(params, dense, cat, yb, wb):
         w, b = params["w"], params["b"]
-        n_cat = cat.shape[-1]
+        n_dense, n_cat = dense.shape[-1], cat.shape[-1]
         margin = (dense @ w[:n_dense]
                   + jnp.sum(_gather_weights(w, cat), axis=-1) + b)
         value, pull = jax.vjp(lambda m: loss_fn(m, yb, wb), margin)
@@ -409,7 +409,7 @@ def sgd_fit_mixed(loss_fn: LossFn, dense_features: np.ndarray,
     w = jax.device_put(w, batch_sharded)
 
     params, loss_log = _run_minibatch_epochs(
-        _mixed_update(loss_fn, config, n_dense), (dense, cat, y, w),
+        _mixed_update(loss_fn, config), (dense, cat, y, w),
         {"w": jnp.zeros((num_features,), jnp.float32),
          "b": jnp.zeros((), jnp.float32)}, steps, config, mesh)
     return LinearState(np.asarray(params["w"], np.float64),
@@ -423,6 +423,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                       weight_key: Optional[str] = None,
                       indices_key: Optional[str] = None,
                       values_key: Optional[str] = None,
+                      dense_key: Optional[str] = None,
                       prefetch_depth: int = 2,
                       checkpoint=None,
                       checkpoint_every_steps: int = 0,
@@ -444,8 +445,11 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     With ``indices_key``/``values_key`` set the reader feeds **sparse**
     batches — ``(rows, nnz)`` hashed index/value pairs scored against the
     dense ``(num_features,)`` weight (the :func:`sgd_fit_sparse` layout);
-    ``features_key`` is ignored.  This is the Criteo ingest path: 2^20+
-    dims stream from disk without ever densifying.
+    ``features_key`` is ignored.  With ``dense_key``+``indices_key`` the
+    reader feeds the **mixed** Criteo-native layout instead — a dense
+    block plus hashed categorical indices with implicit value 1.0 (the
+    :func:`sgd_fit_mixed` layout, the fastest LR path on TPU).  Either
+    way 2^20+ dims stream from disk without ever densifying.
 
     Unlike :func:`sgd_fit`, the READER owns the data layout:
     ``config.global_batch_size`` and ``config.seed`` are inert here — batch
@@ -468,10 +472,16 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     """
     mesh = mesh or default_mesh()
     n_dev = int(mesh.shape["data"])
-    sparse = indices_key is not None
+    mixed = dense_key is not None and indices_key is not None
+    sparse = indices_key is not None and not mixed
     if sparse and values_key is None:
-        raise ValueError("indices_key requires values_key")
-    update = (_sparse_update if sparse else _linear_update)(loss_fn, config)
+        raise ValueError("indices_key requires values_key (or dense_key "
+                         "for the mixed layout)")
+    if dense_key is not None and indices_key is None:
+        raise ValueError("dense_key requires indices_key")
+    update = (_mixed_update(loss_fn, config) if mixed
+              else (_sparse_update if sparse
+                    else _linear_update)(loss_fn, config))
     batch_step = jax.jit(update, donate_argnums=0)
 
     manager: Optional[CheckpointManager] = None
@@ -482,7 +492,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
 
     x_sh = NamedSharding(mesh, P("data", None))
     v_sh = NamedSharding(mesh, P("data"))
-    sharding = (x_sh, x_sh, v_sh, v_sh) if sparse else (x_sh, v_sh, v_sh)
+    sharding = ((x_sh, x_sh, v_sh, v_sh) if (sparse or mixed)
+                else (x_sh, v_sh, v_sh))
     batch_rows: list = []   # fixed after first batch
 
     def _pad_rows(arrs, rows):
@@ -499,12 +510,15 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
             for a in arrs)
 
     def to_host_batch(batch):
-        if sparse:
+        if sparse or mixed:
             from .linear import check_sparse_indices
 
             idx = np.asarray(batch[indices_key], np.int32)
             check_sparse_indices(idx, num_features)
-            feats = (idx, np.asarray(batch[values_key], np.float32))
+            if mixed:
+                feats = (np.asarray(batch[dense_key], np.float32), idx)
+            else:
+                feats = (idx, np.asarray(batch[values_key], np.float32))
         else:
             feats = (np.asarray(batch[features_key], np.float32),)
         y = np.asarray(batch[label_key], np.float32)
