@@ -24,11 +24,12 @@ import ctypes
 import json
 import os
 import shutil
-import subprocess
 
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
+
+from ..utils.native_lib import load_native_lib
 
 __all__ = ["DataCacheWriter", "DataCacheReader", "DataCacheSnapshot", "Segment"]
 
@@ -38,9 +39,6 @@ def _col_filename(name: str) -> str:
     resolve through here."""
     return f"col.{name}.bin"
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _LIB = None
 _LIB_TRIED = False
 
@@ -51,19 +49,8 @@ def _native_lib() -> Optional[ctypes.CDLL]:
     if _LIB_TRIED:
         return _LIB
     _LIB_TRIED = True
-    so_path = os.path.join(_NATIVE_DIR, "build", "libdatacache.so")
-    if os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
-        # Always invoke make: it's an incremental no-op when fresh and
-        # guarantees edits to datacache.cpp are picked up (a stale .so would
-        # silently serve old native code otherwise).
-        try:
-            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                           capture_output=True, timeout=120)
-        except Exception:
-            if not os.path.exists(so_path):
-                return None
-    try:
-        lib = ctypes.CDLL(so_path)
+    lib = load_native_lib("datacache")
+    if lib is not None:
         lib.dc_read.restype = ctypes.c_int64
         lib.dc_read.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                 ctypes.c_int64, ctypes.c_void_p]
@@ -77,9 +64,7 @@ def _native_lib() -> Optional[ctypes.CDLL]:
                                     ctypes.c_int64]
         lib.dc_prefetch_drain.restype = None
         lib.dc_prefetch_pending.restype = ctypes.c_int64
-        _LIB = lib
-    except OSError:
-        _LIB = None
+    _LIB = lib
     return _LIB
 
 

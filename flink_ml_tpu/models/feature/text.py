@@ -24,7 +24,7 @@ from ...params.shared import (
     HasInputCols,
     HasOutputCol,
 )
-from ...utils import persist
+from ...utils import native_text, persist
 
 __all__ = ["HashingTF", "IDF", "IDFModel", "FeatureHasher", "IndexToString"]
 
@@ -64,12 +64,17 @@ class HashingTF(HasOutputCol, HasFeaturesCol, Transformer):
         (table,) = inputs
         docs = table[self.get_features_col()]
         m = self.get_num_features()
-        out = np.zeros((len(docs), m), np.float64)
-        for i, doc in enumerate(docs):
-            for token in np.ravel(np.asarray(doc, dtype=object)):
-                out[i, _fnv1a(token) % m] += 1.0
-        if self.get(HashingTF.BINARY):
-            out = (out > 0).astype(np.float64)
+        binary = self.get(HashingTF.BINARY)
+        # native batch fill (bit-identical hashes); per-byte Python loop
+        # only as the no-toolchain fallback
+        out = native_text.hashing_tf(docs, m, binary)
+        if out is None:
+            out = np.zeros((len(docs), m), np.float64)
+            for i, doc in enumerate(docs):
+                for token in np.ravel(np.asarray(doc, dtype=object)):
+                    out[i, _fnv1a(token) % m] += 1.0
+            if binary:
+                out = (out > 0).astype(np.float64)
         return [table.with_column(self.get_output_col(), out)]
 
     def save(self, path: str) -> None:
@@ -199,8 +204,11 @@ class FeatureHasher(HasOutputCol, HasInputCols, Transformer):
                 val_cols.append(values.astype(np.float64))
             else:
                 uniq, inverse = np.unique(values, return_inverse=True)
-                slots = np.asarray([_fnv1a(f"{col}={u}") % m for u in uniq],
-                                   np.int32)
+                keys = [f"{col}={u}" for u in uniq]
+                hashes = native_text.fnv1a_batch(keys)
+                if hashes is None:
+                    hashes = np.asarray([_fnv1a(k) for k in keys], np.uint64)
+                slots = (hashes % np.uint64(m)).astype(np.int32)
                 idx_cols.append(slots[inverse])
                 val_cols.append(np.ones((n,), np.float64))
         return idx_cols, val_cols

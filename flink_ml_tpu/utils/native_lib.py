@@ -1,0 +1,39 @@
+"""Shared build-and-load policy for the native libraries in ``native/``.
+
+One place owns the rules — invoke make incrementally on every first load
+(a no-op when fresh, guarantees .cpp edits are picked up; a stale .so
+would silently serve old native code otherwise), tolerate a failed make
+when a previously built .so exists, and degrade to ``None`` (callers keep
+their pure-Python fallback) when the toolchain is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+__all__ = ["load_native_lib", "NATIVE_DIR"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+
+
+def load_native_lib(lib_name: str) -> Optional[ctypes.CDLL]:
+    """Build (if needed) and load ``native/build/lib{lib_name}.so``;
+    ``None`` means no native path (caller falls back).  Callers cache the
+    result and declare their own symbol signatures."""
+    so_path = os.path.join(NATIVE_DIR, "build", f"lib{lib_name}.so")
+    if os.path.exists(os.path.join(NATIVE_DIR, "Makefile")):
+        try:
+            subprocess.run(["make", "-C", NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            if not os.path.exists(so_path):
+                return None
+    try:
+        return ctypes.CDLL(so_path)
+    except OSError:
+        return None

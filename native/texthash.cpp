@@ -1,0 +1,58 @@
+// Native batch text hashing for host-side featurization.
+//
+// The hashing trick (HashingTF / FeatureHasher, mirroring the Flink ML 2.x
+// feature surface) hashes every token with 64-bit FNV-1a.  In Python that
+// inner loop runs per BYTE per token (~100 ns/byte); this library does the
+// same arithmetic at native speed over one contiguated buffer:
+//   - th_fnv1a_batch: hash n strings given (bytes, offsets)
+//   - th_hashing_tf: the whole HashingTF document-term fill in one call
+// The Python binding (flink_ml_tpu/utils/native_text.py) concatenates the
+// tokens once and falls back to the pure-Python path when the library is
+// unavailable.  Hash values are identical to models/feature/text.py::_fnv1a
+// (64-bit wrap-around), so native and fallback outputs are bit-equal.
+
+#include <cstdint>
+
+extern "C" {
+
+static inline uint64_t fnv1a(const uint8_t* data, int64_t len) {
+  uint64_t h = 14695981039346656037ull;
+  for (int64_t i = 0; i < len; ++i) {
+    h = (h ^ data[i]) * 1099511628211ull;
+  }
+  return h;
+}
+
+// Hash n strings; string i occupies bytes [offsets[i], offsets[i+1]).
+void th_fnv1a_batch(const uint8_t* bytes, const int64_t* offsets, int64_t n,
+                    uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] = fnv1a(bytes + offsets[i], offsets[i + 1] - offsets[i]);
+  }
+}
+
+// HashingTF fill: docs are consecutive runs of tokens — doc i holds
+// doc_counts[i] tokens; token j (global index) occupies
+// bytes [tok_offsets[j], tok_offsets[j+1]).  out is (n_docs, m) row-major,
+// zero-initialized by the caller; binary != 0 marks presence instead of
+// counting.
+void th_hashing_tf(const uint8_t* bytes, const int64_t* tok_offsets,
+                   const int64_t* doc_counts, int64_t n_docs, int64_t m,
+                   int binary, double* out) {
+  int64_t tok = 0;
+  for (int64_t i = 0; i < n_docs; ++i) {
+    double* row = out + i * m;
+    for (int64_t t = 0; t < doc_counts[i]; ++t, ++tok) {
+      uint64_t h = fnv1a(bytes + tok_offsets[tok],
+                         tok_offsets[tok + 1] - tok_offsets[tok]);
+      int64_t slot = static_cast<int64_t>(h % static_cast<uint64_t>(m));
+      if (binary) {
+        row[slot] = 1.0;
+      } else {
+        row[slot] += 1.0;
+      }
+    }
+  }
+}
+
+}  // extern "C"

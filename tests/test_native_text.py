@@ -1,0 +1,84 @@
+"""Native batch hasher (native/texthash.cpp) vs the pure-Python FNV-1a."""
+
+
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.models.feature.text import _fnv1a
+from flink_ml_tpu.utils import native_text
+
+
+pytestmark = pytest.mark.skipif(not native_text.native_available(),
+                                reason="native toolchain unavailable")
+
+
+def test_fnv1a_batch_bit_identical_to_python():
+    strings = ["", "a", "some token", "café ☕", "colname=value",
+               "x" * 1000]
+    native = native_text.fnv1a_batch(strings)
+    expected = np.asarray([_fnv1a(s) for s in strings], np.uint64)
+    np.testing.assert_array_equal(native, expected)
+
+
+def test_hashing_tf_native_matches_python_loop():
+    rng = np.random.default_rng(0)
+    vocab = [f"tok{i}" for i in range(50)]
+    docs = np.empty(20, object)
+    for i in range(20):
+        docs[i] = list(rng.choice(vocab, size=rng.integers(0, 30)))
+    m = 64
+    native = native_text.hashing_tf(docs, m, binary=False)
+    expected = np.zeros((20, m), np.float64)
+    for i, doc in enumerate(docs):
+        for tok in doc:
+            expected[i, _fnv1a(tok) % m] += 1.0
+    np.testing.assert_array_equal(native, expected)
+
+    nb = native_text.hashing_tf(docs, m, binary=True)
+    np.testing.assert_array_equal(nb, (expected > 0).astype(np.float64))
+
+
+def test_hashing_tf_through_stage_uses_native():
+    """HashingTF output is identical whichever path runs (the stage picks
+    native when available — this asserts the integrated result)."""
+    from flink_ml_tpu import Table
+    from flink_ml_tpu.models.feature import HashingTF
+
+    docs = np.empty(3, object)
+    docs[0] = ["a", "b", "a"]
+    docs[1] = []
+    docs[2] = ["café", "b"]
+    out = (HashingTF().set_num_features(32)
+           .transform(Table({"features": docs}))[0])
+    mat = np.asarray(out["output"])
+    assert mat[0, _fnv1a("a") % 32] == 2.0
+    assert mat[1].sum() == 0.0
+    assert mat[2, _fnv1a("café") % 32] == 1.0
+
+
+def test_native_path_engaged_not_fallback():
+    """Regression guard against the binding silently falling back: the lib
+    loads, the batch entry points return real arrays (None IS the fallback
+    signal), and a corpus-scale fill matches the Python loop on a sample.
+    Deliberately not a wall-clock gate — timing assertions flake on loaded
+    hosts; non-None return is the property that guards the regression."""
+    assert native_text.native_available()
+    rng = np.random.default_rng(1)
+    vocab = [f"token_{i:05d}" for i in range(1000)]
+    docs = np.empty(500, object)
+    for i in range(500):
+        docs[i] = list(rng.choice(vocab, size=100))
+
+    native = native_text.hashing_tf(docs, 1 << 12, binary=False)
+    assert native is not None and native.shape == (500, 1 << 12)
+
+    sub = 50
+    expected = np.zeros((sub, 1 << 12), np.float64)
+    for i in range(sub):
+        for tok in docs[i]:
+            expected[i, _fnv1a(tok) % (1 << 12)] += 1.0
+    np.testing.assert_array_equal(native[:sub], expected)
+
+    hashes = native_text.fnv1a_batch(vocab)
+    assert hashes is not None and len(hashes) == len(vocab)
