@@ -24,6 +24,7 @@ from .lsh import (  # noqa: F401
     MinHashLSHModel,
 )
 from .randomsplitter import RandomSplitter  # noqa: F401
+from .sqltransformer import SQLTransformer  # noqa: F401
 from .selectors import (  # noqa: F401
     UnivariateFeatureSelector,
     UnivariateFeatureSelectorModel,

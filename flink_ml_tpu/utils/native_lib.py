@@ -21,12 +21,20 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 
 
+_MAKE_RAN = False
+
+
 def load_native_lib(lib_name: str) -> Optional[ctypes.CDLL]:
     """Build (if needed) and load ``native/build/lib{lib_name}.so``;
     ``None`` means no native path (caller falls back).  Callers cache the
-    result and declare their own symbol signatures."""
+    result and declare their own symbol signatures.  One ``make all``
+    builds every target, so the subprocess runs once per process no
+    matter how many libraries load."""
+    global _MAKE_RAN
     so_path = os.path.join(NATIVE_DIR, "build", f"lib{lib_name}.so")
-    if os.path.exists(os.path.join(NATIVE_DIR, "Makefile")):
+    if not _MAKE_RAN and os.path.exists(os.path.join(NATIVE_DIR,
+                                                     "Makefile")):
+        _MAKE_RAN = True
         try:
             subprocess.run(["make", "-C", NATIVE_DIR], check=True,
                            capture_output=True, timeout=120)

@@ -1,0 +1,119 @@
+"""SQLTransformer — restricted SELECT dialect over Table."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature import SQLTransformer
+
+
+def _t():
+    return Table({
+        "a": np.array([1.0, 2.0, 3.0, 4.0]),
+        "b": np.array([10.0, 20.0, 30.0, 40.0]),
+        "label": np.array([0, 1, 0, 1]),
+    })
+
+
+def _run(stmt, table=None):
+    return SQLTransformer().set_statement(stmt).transform(table or _t())[0]
+
+
+def test_select_star_passthrough():
+    out = _run("SELECT * FROM __THIS__")
+    assert out.column_names == ["a", "b", "label"]
+    np.testing.assert_array_equal(out["a"], [1, 2, 3, 4])
+
+
+def test_select_expressions_with_aliases():
+    out = _run("SELECT a, a + b AS s, a * 2 AS twice, "
+               "SQRT(b) AS root FROM __THIS__")
+    np.testing.assert_array_equal(out["s"], [11, 22, 33, 44])
+    np.testing.assert_array_equal(out["twice"], [2, 4, 6, 8])
+    np.testing.assert_allclose(out["root"], np.sqrt([10, 20, 30, 40]))
+
+
+def test_where_filters_rows():
+    out = _run("SELECT *, a + 1 AS a1 FROM __THIS__ WHERE a > 2")
+    np.testing.assert_array_equal(out["a"], [3, 4])
+    np.testing.assert_array_equal(out["a1"], [4, 5])
+
+
+def test_where_sql_equality_and_boolean_ops():
+    out = _run("SELECT a FROM __THIS__ WHERE label = 1 AND b >= 20")
+    np.testing.assert_array_equal(out["a"], [2, 4])
+    out = _run("SELECT a FROM __THIS__ WHERE NOT (label = 1) OR a = 4")
+    np.testing.assert_array_equal(out["a"], [1, 3, 4])
+
+
+def test_functions_min_max_pow():
+    out = _run("SELECT MIN(a, 2.5) AS lo, POW(a, 2) AS sq FROM __THIS__")
+    np.testing.assert_array_equal(out["lo"], [1, 2, 2.5, 2.5])
+    np.testing.assert_array_equal(out["sq"], [1, 4, 9, 16])
+
+
+def test_scalar_literal_broadcasts():
+    out = _run("SELECT a, 1 AS one FROM __THIS__")
+    np.testing.assert_array_equal(out["one"], [1, 1, 1, 1])
+
+
+def test_vector_columns_flow_through_arithmetic():
+    t = Table({"v": np.arange(8.0).reshape(4, 2), "a": np.arange(4.0)})
+    out = _run("SELECT v * 2 AS v2 FROM __THIS__ WHERE a > 0", t)
+    np.testing.assert_array_equal(out["v2"], np.arange(8.0).reshape(4, 2)[1:] * 2)
+
+
+def test_rejects_malformed_statement():
+    with pytest.raises(ValueError, match="FROM __THIS__"):
+        _run("DELETE FROM __THIS__")
+
+
+def test_rejects_unknown_column_and_function():
+    with pytest.raises(ValueError, match="unknown column"):
+        _run("SELECT missing FROM __THIS__")
+    with pytest.raises(ValueError, match="unknown function"):
+        _run("SELECT open('/etc/passwd') FROM __THIS__")
+
+
+def test_rejects_attribute_access_and_subscripts():
+    with pytest.raises(ValueError, match="unsupported syntax"):
+        _run("SELECT a.dtype FROM __THIS__")
+    with pytest.raises(ValueError, match="unsupported syntax"):
+        _run("SELECT a[0] FROM __THIS__")
+
+
+def test_statement_param_required():
+    with pytest.raises(ValueError, match="not be null"):
+        SQLTransformer().transform(_t())
+
+
+def test_save_load_roundtrip(tmp_path):
+    st = SQLTransformer().set_statement("SELECT a + b AS s FROM __THIS__")
+    path = str(tmp_path / "sqlt")
+    st.save(path)
+    loaded = SQLTransformer.load(path)
+    out = loaded.transform(_t())[0]
+    np.testing.assert_array_equal(out["s"], [11, 22, 33, 44])
+
+
+def test_chained_comparison():
+    out = _run("SELECT a FROM __THIS__ WHERE 1 < a <= 3")
+    np.testing.assert_array_equal(out["a"], [2, 3])
+
+
+def test_string_literals_survive_rewrites():
+    t = Table({"s": np.asarray(["x=y", "a and b", "plain"], dtype=object),
+               "n": np.array([1.0, 2.0, 3.0])})
+    out = _run("SELECT n FROM __THIS__ WHERE s = 'x=y'", t)
+    np.testing.assert_array_equal(out["n"], [1.0])
+    out = _run("SELECT n FROM __THIS__ WHERE s = 'a and b'", t)
+    np.testing.assert_array_equal(out["n"], [2.0])
+    out = _run("SELECT 'a,b' AS c, n FROM __THIS__", t)
+    assert list(out["c"]) == ["a,b"] * 3
+
+
+def test_malformed_expression_raises_value_error():
+    with pytest.raises(ValueError, match="could not parse"):
+        _run("SELECT a + FROM __THIS__")
+    with pytest.raises(ValueError, match="could not parse"):
+        _run("SELECT a FROM __THIS__ WHERE a = 'unterminated")
