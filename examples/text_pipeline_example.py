@@ -1,0 +1,53 @@
+"""Text classification: tokenize -> stop words -> count vectorize ->
+TF-IDF -> logistic regression, all through one Pipeline."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+from flink_ml_tpu import Pipeline, Table
+from flink_ml_tpu.models.classification import LogisticRegression
+from flink_ml_tpu.models.evaluation import BinaryClassificationEvaluator
+from flink_ml_tpu.models.feature import (
+    CountVectorizer,
+    IDF,
+    StopWordsRemover,
+    Tokenizer,
+)
+
+POSITIVE = ["great", "excellent", "wonderful", "amazing", "love"]
+NEGATIVE = ["terrible", "awful", "horrible", "boring", "hate"]
+FILLER = ["the", "movie", "was", "plot", "acting", "really", "a", "film"]
+
+rng = np.random.default_rng(0)
+docs, labels = [], []
+for _ in range(400):
+    y = int(rng.random() < 0.5)
+    lexicon = POSITIVE if y else NEGATIVE
+    words = list(rng.choice(FILLER, size=6)) + \
+        list(rng.choice(lexicon, size=rng.integers(1, 4)))
+    rng.shuffle(words)
+    docs.append(" ".join(words))
+    labels.append(y)
+
+table = Table({"features": np.asarray(docs, dtype=object),
+               "label": np.asarray(labels, np.float64)})
+
+pipeline = Pipeline([
+    Tokenizer().set_output_col("tokens"),
+    StopWordsRemover().set_features_col("tokens").set_output_col("kept"),
+    CountVectorizer().set_features_col("kept").set_output_col("counts"),
+    IDF().set_features_col("counts").set_output_col("tfidf"),
+    LogisticRegression().set_features_col("tfidf").set_max_iter(30)
+        .set_learning_rate(0.5),
+])
+model = pipeline.fit(table)
+scored = model.transform(table)[0]
+
+metrics = (BinaryClassificationEvaluator()
+           .set_metrics("areaUnderROC", "accuracy").transform(scored)[0])
+print("vocabulary size:", len(model.stages[2].vocabulary))
+print("AUC: %.3f  accuracy: %.3f"
+      % (metrics["areaUnderROC"][0], metrics["accuracy"][0]))

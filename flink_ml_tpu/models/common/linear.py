@@ -158,6 +158,12 @@ class LinearModelBase(LinearModelParams, Model):
                 f"{type(self).__name__} has no model data; fit the estimator "
                 "or call set_model_data first")
 
+    @property
+    def loss_log(self) -> list:
+        """Per-epoch training loss recorded by fit (empty when the model
+        was built from set_model_data/load rather than trained)."""
+        return list(getattr(self, "_loss_log", []) or [])
+
     # -- inference ----------------------------------------------------------
     def _margins(self, table: Table) -> np.ndarray:
         self._require_model()
