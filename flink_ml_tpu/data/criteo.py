@@ -1,0 +1,225 @@
+"""Criteo-format TSV ingest: raw click logs -> the mixed training layout.
+
+The BASELINE.md north star is Criteo-1TB LogisticRegression; this module
+owns the first leg of that pipeline: parsing ``label \\t I1..I13 \\t
+C1..C26`` lines into the framework's mixed convention (13 dense f32
+slots + 26 hashed categorical int32 slots with implicit value 1.0) that
+``sgd_fit_outofcore(mixed=True)`` / ``LogisticRegression.fit_outofcore``
+consume directly, or that a ``DataCacheWriter`` persists for replayed
+epochs.
+
+Parsing runs through ``native/criteo.cpp`` (one pass over a byte chunk,
+FNV-1a hashing folded in) with a bit-identical pure-Python fallback.
+Categorical tokens hash as ``C{field}={token}`` — the FeatureHasher salt
+convention — into ``[n_reserved, n_reserved + hash_space)`` so hashed
+slots can never alias the dense weight slots.  Empty categorical fields
+hash the empty token, giving each field a stable "missing" slot.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..utils.native_lib import load_native_lib
+
+__all__ = ["CriteoTSVReader", "parse_chunk"]
+
+N_DENSE = 13
+N_CAT = 26
+
+_FNV_OFFSET = 14695981039346656037
+_FNV_PRIME = 1099511628211
+_FNV_MASK = (1 << 64) - 1
+
+
+def _fnv1a_bytes(data: bytes, h: int = _FNV_OFFSET) -> int:
+    """Raw-bytes FNV-1a (matches ``text._fnv1a`` on ASCII, and matches the
+    native parser on arbitrary bytes — no utf-8 round-trip that could
+    raise on undecodable tokens)."""
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & _FNV_MASK
+    return h
+
+
+_CAT_SALTS = [_fnv1a_bytes(b"C%d=" % (f + 1)) for f in range(N_CAT)]
+
+
+def _int_field(raw: bytes) -> float:
+    """The native parser's integer rules, exactly: optional '-', then
+    digits only; empty, non-digit, or > 18 digits -> 0.0."""
+    if not raw:
+        return 0.0
+    neg = raw[:1] == b"-"
+    body = raw[1:] if neg else raw
+    if not body.isdigit() or len(body) > 18:
+        return 0.0
+    v = int(body)
+    return float(-v if neg else v) if v else 0.0
+
+_LIB = None
+_LIB_TRIED = False
+
+
+def _native_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    lib = load_native_lib("criteo")
+    if lib is not None:
+        lib.ct_parse.restype = ctypes.c_int64
+        lib.ct_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p]
+    _LIB = lib
+    return _LIB
+
+
+def _py_parse_chunk(data: bytes, max_rows: int, hash_space: int,
+                    n_reserved: int):
+    """Pure-Python twin of ``ct_parse`` (bit-identical output)."""
+    dense = np.zeros((max_rows, N_DENSE), np.float32)
+    cat = np.zeros((max_rows, N_CAT), np.int32)
+    label = np.zeros((max_rows,), np.float32)
+    rows = 0
+    consumed = 0
+    pos = 0
+    while rows < max_rows:
+        eol = data.find(b"\n", pos)
+        if eol < 0:
+            break
+        fields = data[pos:eol].split(b"\t")
+        if len(fields) == 40:
+            label[rows] = 1.0 if fields[0][:1] == b"1" else 0.0
+            for f in range(N_DENSE):
+                dense[rows, f] = _int_field(fields[1 + f])
+            for f in range(N_CAT):
+                h = _fnv1a_bytes(fields[14 + f], _CAT_SALTS[f])
+                cat[rows, f] = n_reserved + (h % hash_space)
+            rows += 1
+        pos = eol + 1
+        consumed = pos
+    return dense[:rows], cat[:rows], label[:rows], consumed
+
+
+def parse_chunk(data: bytes, max_rows: int, hash_space: int,
+                n_reserved: int = N_DENSE
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Parse whole lines from ``data`` (up to ``max_rows``); returns
+    (dense (r, 13) f32, cat (r, 26) int32, label (r,) f32, bytes_consumed).
+    A trailing partial line is left unconsumed for the caller to carry
+    into its next chunk."""
+    if hash_space <= 0:
+        raise ValueError(f"hash_space must be positive, got {hash_space}")
+    if n_reserved + hash_space > 1 << 31:
+        raise ValueError(
+            f"n_reserved + hash_space = {n_reserved + hash_space} exceeds "
+            "int32 index range (2^31); use a smaller hash space")
+    lib = _native_lib()
+    if lib is None:
+        return _py_parse_chunk(data, max_rows, hash_space, n_reserved)
+    dense = np.zeros((max_rows, N_DENSE), np.float32)
+    cat = np.zeros((max_rows, N_CAT), np.int32)
+    label = np.zeros((max_rows,), np.float32)
+    consumed = ctypes.c_int64(0)
+    rows = lib.ct_parse(data, len(data), max_rows, hash_space, n_reserved,
+                        dense.ctypes.data, cat.ctypes.data,
+                        label.ctypes.data, ctypes.byref(consumed))
+    return dense[:rows], cat[:rows], label[:rows], int(consumed.value)
+
+
+class CriteoTSVReader:
+    """Iterator of mixed-layout batch dicts over a Criteo TSV file:
+    ``{"{col}_dense": (b, 13) f32, "{col}_indices": (b, 26) int32,
+    "label": (b,) f32}`` — exactly what ``fit_outofcore(mixed=True)``
+    and ``DataCacheWriter.append`` take.  Construct a fresh reader per
+    epoch (the ``make_reader`` protocol).
+
+    ``num_features`` for the downstream trainer is
+    ``n_reserved + hash_space``.
+    """
+
+    def __init__(self, path: str, batch_rows: int, hash_space: int,
+                 n_reserved: int = N_DENSE, features_col: str = "features",
+                 label_col: str = "label", chunk_bytes: int = 1 << 20):
+        if batch_rows <= 0:
+            raise ValueError(f"batch_rows must be positive: {batch_rows}")
+        self.path = path
+        self.batch_rows = batch_rows
+        self.hash_space = hash_space
+        self.n_reserved = n_reserved
+        self.features_col = features_col
+        self.label_col = label_col
+        self.chunk_bytes = max(chunk_bytes, 1 << 12)
+
+    @property
+    def num_features(self) -> int:
+        return self.n_reserved + self.hash_space
+
+    def _rows(self) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        tail = b""
+        with open(self.path, "rb") as f:
+            while True:
+                chunk = f.read(self.chunk_bytes)
+                if not chunk:
+                    break
+                data = tail + chunk
+                pos = 0
+                # drain the chunk in as few calls as possible: a Criteo
+                # line is >= 40 bytes (40 separators), so len//40 rows
+                # always covers the chunk — repeated small-batch calls
+                # would re-slice (copy) the remaining bytes quadratically
+                max_rows = max(self.batch_rows, len(data) // 40)
+                while True:
+                    dense, cat, label, consumed = parse_chunk(
+                        data[pos:], max_rows, self.hash_space,
+                        self.n_reserved)
+                    if consumed == 0:   # no whole line left in the chunk
+                        break
+                    pos += consumed     # advances past skipped bad lines too
+                    if len(label):
+                        yield dense, cat, label
+                tail = data[pos:]
+        if tail.strip():
+            # final line without trailing newline
+            dense, cat, label, _ = parse_chunk(
+                tail + b"\n", self.batch_rows, self.hash_space,
+                self.n_reserved)
+            if len(label):
+                yield dense, cat, label
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        pend_d, pend_c, pend_l = [], [], []
+        pending = 0
+        for dense, cat, label in self._rows():
+            pend_d.append(dense)
+            pend_c.append(cat)
+            pend_l.append(label)
+            pending += len(label)
+            while pending >= self.batch_rows:
+                d = np.concatenate(pend_d)
+                c = np.concatenate(pend_c)
+                y = np.concatenate(pend_l)
+                yield self._batch(d[: self.batch_rows],
+                                  c[: self.batch_rows],
+                                  y[: self.batch_rows])
+                pend_d = [d[self.batch_rows:]]
+                pend_c = [c[self.batch_rows:]]
+                pend_l = [y[self.batch_rows:]]
+                pending -= self.batch_rows
+        if pending:
+            yield self._batch(np.concatenate(pend_d),
+                              np.concatenate(pend_c),
+                              np.concatenate(pend_l))
+
+    def _batch(self, dense, cat, label) -> Dict[str, np.ndarray]:
+        return {
+            f"{self.features_col}_dense": dense,
+            f"{self.features_col}_indices": cat,
+            self.label_col: label,
+        }

@@ -1,0 +1,124 @@
+// Native parser for Criteo-format TSV (the BASELINE.md north-star input):
+//
+//   label \t I1 .. I13 \t C1 .. C26 \n
+//
+// where I* are small integers (possibly empty) and C* are 8-hex-char
+// categorical tokens (possibly empty).  Each call consumes whole lines
+// from a byte buffer and emits the framework's mixed layout directly:
+// dense f32 (13 per row, missing -> 0), hashed categorical int32 (26 per
+// row) and f32 labels.  Categorical hashing is 64-bit FNV-1a over
+// "C{field}={token}" — the same function and salt convention as
+// FeatureHasher (models/feature/text.py) — folded into
+// [n_reserved, n_reserved + hash_space) so hashed slots can never alias
+// the dense weight slots of the mixed layout.  Empty categorical fields
+// hash the empty token (a per-field "missing" slot), matching the Python
+// fallback parser bit for bit.
+//
+// Returns the number of rows parsed; *consumed gets the byte count of the
+// whole lines consumed (callers carry the tail of a chunk into the next
+// read).  Malformed lines (wrong field count) are skipped.
+
+#include <array>
+#include <cstdint>
+#include <cstdio>
+
+extern "C" {
+
+static inline uint64_t fnv1a64(const uint8_t* data, int64_t len,
+                               uint64_t h) {
+  for (int64_t i = 0; i < len; ++i) {
+    h = (h ^ data[i]) * 1099511628211ull;
+  }
+  return h;
+}
+
+static const uint64_t kFnvOffset = 14695981039346656037ull;
+
+// Post-salt FNV states for "C1=".."C26=" — row-invariant, computed once
+// (thread-safe C++11 static init) instead of 26 snprintf+FNV per row.
+static std::array<uint64_t, 26> make_salts() {
+  std::array<uint64_t, 26> salts;
+  for (int f = 0; f < 26; ++f) {
+    char salt[8];
+    int n = std::snprintf(salt, sizeof(salt), "C%d=", f + 1);
+    salts[f] = fnv1a64(reinterpret_cast<const uint8_t*>(salt), n,
+                       kFnvOffset);
+  }
+  return salts;
+}
+
+int64_t ct_parse(const uint8_t* buf, int64_t nbytes, int64_t max_rows,
+                 int64_t hash_space, int64_t n_reserved,
+                 float* dense, int32_t* cat, float* label,
+                 int64_t* consumed) {
+  int64_t rows = 0;
+  int64_t pos = 0;
+  *consumed = 0;
+  while (rows < max_rows) {
+    // find end of line
+    int64_t eol = pos;
+    while (eol < nbytes && buf[eol] != '\n') ++eol;
+    if (eol >= nbytes) break;  // partial line: leave for the next chunk
+
+    // split into 40 tab-separated fields
+    int64_t starts[40], lens[40];
+    int nf = 0;
+    int64_t fs = pos;
+    for (int64_t i = pos; i <= eol && nf < 40; ++i) {
+      if (i == eol || buf[i] == '\t') {
+        starts[nf] = fs;
+        lens[nf] = i - fs;
+        ++nf;
+        fs = i + 1;
+      }
+    }
+    int64_t line_end = eol + 1;
+    // exactly 40 fields: fs must have advanced past the final (eol)
+    // terminator — a 41st field would leave fs <= eol and the line skips,
+    // matching the Python twin's len(fields) == 40 check
+    if (nf == 40 && fs == eol + 1) {
+      static const std::array<uint64_t, 26> kSalts = make_salts();
+      float* drow = dense + rows * 13;
+      int32_t* crow = cat + rows * 26;
+      // label
+      label[rows] = (lens[0] > 0 && buf[starts[0]] == '1') ? 1.0f : 0.0f;
+      // 13 integer fields: optional '-', then digits only; anything else
+      // (or > 18 digits, which would overflow int64) parses as 0 — the
+      // Python twin replicates these exact rules
+      for (int f = 0; f < 13; ++f) {
+        int64_t s = starts[1 + f], len = lens[1 + f];
+        if (len == 0) {
+          drow[f] = 0.0f;
+          continue;
+        }
+        bool neg = buf[s] == '-';
+        int64_t ndig = len - (neg ? 1 : 0);
+        int64_t v = 0;
+        if (ndig >= 1 && ndig <= 18) {
+          for (int64_t i = s + (neg ? 1 : 0); i < s + len; ++i) {
+            if (buf[i] < '0' || buf[i] > '9') { v = 0; break; }
+            v = v * 10 + (buf[i] - '0');
+          }
+        }
+        // v == 0 emits +0.0 (not -0.0) for true bit parity with the twin
+        drow[f] = v == 0 ? 0.0f
+                         : (neg ? -static_cast<float>(v)
+                                : static_cast<float>(v));
+      }
+      // 26 categorical fields: FNV-1a("C{field}=") continued over token
+      for (int f = 0; f < 26; ++f) {
+        uint64_t h = fnv1a64(buf + starts[14 + f], lens[14 + f],
+                             kSalts[f]);
+        crow[f] = static_cast<int32_t>(
+            n_reserved
+            + static_cast<int64_t>(h % static_cast<uint64_t>(hash_space)));
+      }
+      ++rows;
+    }
+    pos = line_end;
+    *consumed = pos;
+  }
+  return rows;
+}
+
+}  // extern "C"
