@@ -276,6 +276,32 @@ def bench_logreg_outofcore(results: dict) -> None:
             (idx.nbytes + vals.nbytes + y.nbytes) / write_s / 1e6, 1),
     }
 
+    # raw-TSV leg of the north-star ingest: Criteo parser MB/s (host-only
+    # measurement, one pass over synthesized real-shape lines).  The
+    # implementation tag matters: the pure-Python fallback is ~50-100x
+    # slower, so an untagged number would silently corrupt the series on
+    # a host without the native toolchain.
+    from flink_ml_tpu.data import criteo
+    from flink_ml_tpu.data.criteo import parse_chunk
+
+    tsv_rows = (1 << 16) if not _smoke() else 1 << 12
+    tsv_rng = np.random.default_rng(11)
+    ints = tsv_rng.integers(0, 1000, size=(tsv_rows, 13))
+    toks = tsv_rng.integers(0, 1 << 32, size=(tsv_rows, 26))
+    tsv = b"".join(
+        b"%d\t%s\t%s\n" % (
+            i & 1,
+            b"\t".join(b"%d" % v for v in ints[i]),
+            b"\t".join(b"%08x" % v for v in toks[i]))
+        for i in range(tsv_rows))
+    t0 = time.perf_counter()
+    _, _, parsed_labels, consumed = parse_chunk(tsv, tsv_rows, LR_DIM - 13)
+    parse_s = time.perf_counter() - t0
+    assert len(parsed_labels) == tsv_rows and consumed == len(tsv)
+    impl = "native" if criteo._native_lib() is not None else "python-fallback"
+    notes["tsv_parse_mb_per_sec"] = round(len(tsv) / parse_s / 1e6, 1)
+    notes["tsv_parse_impl"] = impl
+
     # calibrate: one batch upload + fenced step
     t0 = time.perf_counter()
     one = jnp.asarray(idx[:batch])
