@@ -56,13 +56,88 @@ class LinearState:
 def plan_epoch_layout(n: int, global_batch_size: int, n_dev: int,
                       seed: int) -> Tuple[int, int, np.ndarray]:
     """Size the (steps, batch) epoch grid — batch divisible by the mesh's
-    data axis — and the seeded row shuffle.  THE canonical sizing used by
-    every mini-batch trainer (sgd_fit, WideDeep)."""
+    data axis — and the seeded row shuffle.  THE canonical batch-sizing
+    arithmetic: WideDeep consumes it directly; the linear trainers layer
+    process-sharding on top via :func:`_plan_epoch_layout_for_mesh`, which
+    delegates here so the two can never diverge."""
     batch = max(global_batch_size, n_dev)
     batch += (-batch) % n_dev
     steps = max(1, -(-n // batch))
     perm = np.random.default_rng(seed).permutation(n)
     return steps, batch, perm
+
+
+def _mesh_process_count(mesh) -> int:
+    """Distinct processes owning the mesh's devices (1 = single-host)."""
+    return len({d.process_index for d in mesh.devices.flat})
+
+
+def _plan_epoch_layout_for_mesh(n_local: int, global_batch_size: int,
+                                mesh, seed: int
+                                ) -> Tuple[int, int, np.ndarray]:
+    """Mesh-aware :func:`plan_epoch_layout`: on a mesh spanning P processes
+    each process prepares its LOCAL (steps, batch/P, ...) slice of the
+    global epoch tensor from its own ``n_local`` rows (equal across
+    processes — validated below); single-process meshes reduce to the
+    classic layout exactly."""
+    n_dev = int(mesh.shape["data"])
+    procs = _mesh_process_count(mesh)
+    steps, batch, perm = plan_epoch_layout(
+        n_local, global_batch_size, n_dev, seed)
+    if procs == 1:
+        return steps, batch, perm
+    if batch % procs:
+        raise ValueError(
+            f"global batch {batch} is not divisible by the mesh's "
+            f"{procs} processes (data axis {n_dev}); size the batch and "
+            "data axis as multiples of the process count")
+    local_batch = batch // procs
+    steps = max(1, -(-n_local // local_batch))
+    # Unequal per-process layouts would compile different programs on each
+    # host and deadlock in the collectives; turn that into an immediate
+    # error with one tiny cross-host gather.
+    from jax.experimental import multihost_utils
+
+    layouts = np.asarray(multihost_utils.process_allgather(
+        np.asarray([steps, local_batch], np.int64)))
+    if not np.all(layouts == layouts.reshape(-1, 2)[0]):
+        raise ValueError(
+            "multi-host fit requires every process to contribute the same "
+            f"row count; got per-process (steps, local_batch) = "
+            f"{layouts.reshape(-1, 2).tolist()}")
+    return steps, local_batch, perm
+
+
+def _put_epoch_tensor(arr: np.ndarray, mesh, spec) -> jnp.ndarray:
+    """Place a host epoch tensor on the mesh: plain device_put on a
+    single-host mesh; on a process-spanning mesh each process contributes
+    its local slice (``jax.make_array_from_process_local_data``) and the
+    global batch is the concatenation over processes."""
+    sharding = NamedSharding(mesh, spec)
+    if _mesh_process_count(mesh) > 1:
+        return jax.make_array_from_process_local_data(sharding, arr)
+    return jax.device_put(arr, sharding)
+
+
+def _replicate_params(tree, mesh):
+    """Replicate a param pytree over the mesh, multi-host-safe."""
+    if _mesh_process_count(mesh) > 1:
+        sharding = NamedSharding(mesh, P())
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)), tree)
+    return replicate(tree, mesh)
+
+
+def _fetch_replicated(tree):
+    """device_get that also handles non-fully-addressable replicated
+    arrays (multi-host: read this process's replica)."""
+    def get(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return np.asarray(x.addressable_data(0))
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree_util.tree_map(get, tree)
 
 
 def prepare_epoch_tensor(arr: np.ndarray, perm: np.ndarray, steps: int,
@@ -105,10 +180,9 @@ def sgd_fit_params(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
     weights)`` defines the objective; labels ride the epoch tensor as f32
     (exact for class ids < 2^24 — cast back inside the loss)."""
     mesh = mesh or default_mesh()
-    n_dev = int(mesh.shape["data"])
     n = features.shape[0]
-    steps, batch, perm = plan_epoch_layout(
-        n, config.global_batch_size, n_dev, config.seed)
+    steps, batch, perm = _plan_epoch_layout_for_mesh(
+        n, config.global_batch_size, mesh, config.seed)
 
     X = prepare_epoch_tensor(features.astype(np.float32), perm, steps, batch)
     y = prepare_epoch_tensor(labels.astype(np.float32), perm, steps, batch)
@@ -116,11 +190,9 @@ def sgd_fit_params(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
               else np.ones((n,), np.float32))
     w = prepare_epoch_tensor(w_host, perm, steps, batch, pad_value=0.0)
 
-    batch_sharded = NamedSharding(mesh, P(None, "data"))
-    x_sharded = NamedSharding(mesh, P(None, "data", None))
-    X = jax.device_put(X, x_sharded)
-    y = jax.device_put(y, batch_sharded)
-    w = jax.device_put(w, batch_sharded)
+    X = _put_epoch_tensor(X, mesh, P(None, "data", None))
+    y = _put_epoch_tensor(y, mesh, P(None, "data"))
+    w = _put_epoch_tensor(w, mesh, P(None, "data"))
 
     update = _linear_update(loss_fn, config)
     return _run_minibatch_epochs(update, (X, y, w), init_params, steps,
@@ -134,6 +206,14 @@ def _run_minibatch_epochs(update, data: tuple, init_params, steps: int,
     (steps, batch, ...) device tensors in ``data``, wrapped in a fused
     ``iterate`` with tol termination.  One copy of the termination /
     loss-log logic so the three trainers can never diverge."""
+    if _mesh_process_count(mesh) > 1 and config.tol > 0:
+        # the criteria-driven fused path returns num_epochs as a replicated
+        # device scalar; int() of a non-fully-addressable array raises
+        # AFTER training completed — fail before any work instead
+        raise ValueError(
+            "multi-host fit requires tol=0 (epoch-loss termination needs a "
+            "per-epoch cross-host scalar read); set SGDConfig(tol=0) and "
+            "control epochs with max_epochs")
 
     def epoch_body(state, epoch, data):
         params, prev_loss, loss_log = state
@@ -153,7 +233,7 @@ def _run_minibatch_epochs(update, data: tuple, init_params, steps: int,
         return IterationBodyResult(
             feedback=(params, epoch_loss, loss_log), termination=termination)
 
-    init_state = (replicate(init_params, mesh),
+    init_state = (_replicate_params(init_params, mesh),
                   jnp.asarray(jnp.inf, jnp.float32),
                   jnp.full((config.max_epochs,), jnp.nan, jnp.float32))
 
@@ -163,8 +243,8 @@ def _run_minibatch_epochs(update, data: tuple, init_params, steps: int,
         config=IterationConfig(mode="fused"),
     )
     params, _final_loss, loss_buf = result.state
-    params = jax.device_get(params)
-    loss_log = list(np.asarray(jax.device_get(loss_buf))[:result.num_epochs])
+    params = _fetch_replicated(params)
+    loss_log = list(_fetch_replicated(loss_buf)[:result.num_epochs])
     return params, loss_log
 
 
@@ -342,10 +422,9 @@ def sgd_fit_sparse(loss_fn: LossFn, indices: np.ndarray, values: np.ndarray,
 
     check_sparse_indices(indices, num_features)
     mesh = mesh or default_mesh()
-    n_dev = int(mesh.shape["data"])
     n = indices.shape[0]
-    steps, batch, perm = plan_epoch_layout(
-        n, config.global_batch_size, n_dev, config.seed)
+    steps, batch, perm = _plan_epoch_layout_for_mesh(
+        n, config.global_batch_size, mesh, config.seed)
 
     idx = prepare_epoch_tensor(indices.astype(np.int32), perm, steps, batch)
     vals = prepare_epoch_tensor(values.astype(np.float32), perm, steps, batch)
@@ -354,12 +433,10 @@ def sgd_fit_sparse(loss_fn: LossFn, indices: np.ndarray, values: np.ndarray,
               else np.ones((n,), np.float32))
     w = prepare_epoch_tensor(w_host, perm, steps, batch, pad_value=0.0)
 
-    batch_sharded = NamedSharding(mesh, P(None, "data"))
-    row_sharded = NamedSharding(mesh, P(None, "data", None))
-    idx = jax.device_put(idx, row_sharded)
-    vals = jax.device_put(vals, row_sharded)
-    y = jax.device_put(y, batch_sharded)
-    w = jax.device_put(w, batch_sharded)
+    idx = _put_epoch_tensor(idx, mesh, P(None, "data", None))
+    vals = _put_epoch_tensor(vals, mesh, P(None, "data", None))
+    y = _put_epoch_tensor(y, mesh, P(None, "data"))
+    w = _put_epoch_tensor(w, mesh, P(None, "data"))
 
     params, loss_log = _run_minibatch_epochs(
         _sparse_update(loss_fn, config), (idx, vals, y, w),
@@ -378,7 +455,14 @@ def sgd_fit_mixed(loss_fn: LossFn, dense_features: np.ndarray,
     (n, n_cat) are hashed slots with implicit value 1.0.  The dense slots
     never pay the per-element random-access cost (see
     :func:`_mixed_update`), which is why this layout is the fastest LR
-    path on TPU for mixed dense/categorical data."""
+    path on TPU for mixed dense/categorical data.
+
+    Multi-host: pass a process-spanning mesh (``distributed.global_mesh``)
+    and call from EVERY process with that process's own equal-sized row
+    shard; the global batch is the concatenation over processes and the
+    gradient reduction rides ICI/DCN.  Use ``tol=0`` (epoch-loss
+    termination would read a cross-host scalar per epoch).  The same
+    contract applies to :func:`sgd_fit` / :func:`sgd_fit_sparse`."""
     from .linear import check_sparse_indices
 
     check_sparse_indices(cat_indices, num_features)
@@ -387,10 +471,9 @@ def sgd_fit_mixed(loss_fn: LossFn, dense_features: np.ndarray,
         raise ValueError(f"n_dense={n_dense} exceeds "
                          f"num_features={num_features}")
     mesh = mesh or default_mesh()
-    n_dev = int(mesh.shape["data"])
     n = dense_features.shape[0]
-    steps, batch, perm = plan_epoch_layout(
-        n, config.global_batch_size, n_dev, config.seed)
+    steps, batch, perm = _plan_epoch_layout_for_mesh(
+        n, config.global_batch_size, mesh, config.seed)
 
     dense = prepare_epoch_tensor(dense_features.astype(np.float32), perm,
                                  steps, batch)
@@ -401,12 +484,10 @@ def sgd_fit_mixed(loss_fn: LossFn, dense_features: np.ndarray,
               else np.ones((n,), np.float32))
     w = prepare_epoch_tensor(w_host, perm, steps, batch, pad_value=0.0)
 
-    batch_sharded = NamedSharding(mesh, P(None, "data"))
-    row_sharded = NamedSharding(mesh, P(None, "data", None))
-    dense = jax.device_put(dense, row_sharded)
-    cat = jax.device_put(cat, row_sharded)
-    y = jax.device_put(y, batch_sharded)
-    w = jax.device_put(w, batch_sharded)
+    dense = _put_epoch_tensor(dense, mesh, P(None, "data", None))
+    cat = _put_epoch_tensor(cat, mesh, P(None, "data", None))
+    y = _put_epoch_tensor(y, mesh, P(None, "data"))
+    w = _put_epoch_tensor(w, mesh, P(None, "data"))
 
     params, loss_log = _run_minibatch_epochs(
         _mixed_update(loss_fn, config), (dense, cat, y, w),

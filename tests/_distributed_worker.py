@@ -82,12 +82,89 @@ def main() -> None:
                       max_epochs=5, config=IterationConfig(mode="hosted"),
                       checkpoint=CheckpointConfig(ck), resume=True)
 
+    # multi-host trainer: sgd_fit_mixed over the process-spanning mesh.
+    # Every process passes ITS shard; the result must equal a manual
+    # single-program update loop over the concatenated global batches
+    # (both shards are deterministic functions of pid, so every process
+    # can compute the oracle locally).
+    from flink_ml_tpu.models.common.losses import LOSSES
+    from flink_ml_tpu.models.common.sgd import (
+        SGDConfig,
+        _mixed_update,
+        sgd_fit_mixed,
+    )
+
+    def shard(p):
+        srng = np.random.default_rng(100 + p)
+        nloc, nd, nc, dim = 64, 3, 2, 256
+        dense = srng.normal(size=(nloc, nd)).astype(np.float32)
+        cat = srng.integers(nd, dim, size=(nloc, nc)).astype(np.int32)
+        y = (dense[:, 0] > 0).astype(np.float64)
+        return dense, cat, y
+
+    cfg = SGDConfig(learning_rate=0.3, max_epochs=3, tol=0, seed=0,
+                    global_batch_size=16)
+    dense_l, cat_l, y_l = shard(pid)
+    state, log = sgd_fit_mixed(LOSSES["logistic"], dense_l, cat_l, y_l,
+                               None, 256, cfg, mesh=mesh)
+
+    # tol > 0 must fail FAST on a multi-host mesh (the criteria path would
+    # otherwise crash after training on a non-addressable num_epochs)
+    try:
+        sgd_fit_mixed(LOSSES["logistic"], dense_l, cat_l, y_l, None, 256,
+                      SGDConfig(learning_rate=0.3, max_epochs=2, tol=1e-6,
+                                global_batch_size=16), mesh=mesh)
+    except ValueError as e:
+        assert "tol=0" in str(e)
+    else:
+        raise AssertionError("expected multi-host tol>0 rejection")
+
+    # oracle: global batch = [proc0 local batch | proc1 local batch] per
+    # step, each locally shuffled by the same seed (the layout
+    # _plan_epoch_layout_for_mesh produces)
+    from flink_ml_tpu.models.common.sgd import prepare_epoch_tensor
+
+    local_batch = 16 // nprocs
+    steps = 64 // local_batch
+    parts = []
+    for p in range(nprocs):
+        dp, cp, yp = shard(p)
+        perm = np.random.default_rng(cfg.seed).permutation(64)
+        parts.append((
+            prepare_epoch_tensor(dp, perm, steps, local_batch),
+            prepare_epoch_tensor(cp, perm, steps, local_batch),
+            prepare_epoch_tensor(yp.astype(np.float32), perm, steps,
+                                 local_batch)))
+    g_dense = np.concatenate([q[0] for q in parts], axis=1)
+    g_cat = np.concatenate([q[1] for q in parts], axis=1)
+    g_y = np.concatenate([q[2] for q in parts], axis=1)
+
+    update = jax.jit(_mixed_update(LOSSES["logistic"], cfg))
+    params = {"w": jnp.zeros((256,), jnp.float32),
+              "b": jnp.zeros((), jnp.float32)}
+    ones = np.ones((16,), np.float32)
+    oracle_log = []
+    for _ in range(cfg.max_epochs):
+        losses = []
+        for s in range(steps):
+            params, value = update(params, g_dense[s], g_cat[s], g_y[s],
+                                   ones)
+            losses.append(float(value))
+        oracle_log.append(float(np.mean(losses)))
+    np.testing.assert_allclose(state.coefficients,
+                               np.asarray(params["w"], np.float64),
+                               atol=1e-5)
+    np.testing.assert_allclose(log, oracle_log, atol=1e-5)
+    assert log[-1] < log[0]
+
     out = {
         "pid": pid,
         "global_devices": info.global_device_count,
         "total": total,
         "final": float(np.asarray(jax.device_get(res.state))),
         "resumed": float(np.asarray(jax.device_get(resumed.state))),
+        "mixed_lr_final_loss": float(log[-1]),
+        "mixed_lr_w0": float(state.coefficients[0]),
     }
     with open(os.path.join(outdir, f"result_{pid}.json"), "w") as f:
         json.dump(out, f)
