@@ -42,7 +42,13 @@ from ...params.shared import (
     HasPredictionCol,
     HasSeed,
 )
-from ...parallel.mesh import default_mesh, data_sharding, replicate
+from ...parallel.mesh import (
+    default_mesh,
+    fetch_replicated,
+    mesh_process_count,
+    put_sharded,
+    replicate,
+)
 from ...utils import persist
 from ...utils.padding import pad_rows_with_mask
 
@@ -69,11 +75,29 @@ class KMeansParams(KMeansModelParams, HasSeed, HasMaxIter):
 def _prepare_points(points: np.ndarray, mesh,
                     row_multiple: int = 1, fill: str = "first_row") -> tuple:
     """Host -> device: pad rows to a multiple of the data-axis size (and of
-    ``row_multiple`` per shard; mask marks real rows), shard the batch dim."""
-    multiple = int(mesh.shape["data"]) * row_multiple
-    padded, mask = pad_rows_with_mask(points, multiple, fill=fill)
-    sharding = data_sharding(mesh)
-    return jax.device_put(padded, sharding), jax.device_put(mask, sharding)
+    ``row_multiple`` per shard; mask marks real rows), shard the batch dim.
+
+    On a process-spanning mesh ``points`` is THIS process's shard (equal
+    row counts across processes — validated); each host pads to its local
+    device multiple and the global array assembles over processes."""
+    from jax.sharding import PartitionSpec as P
+
+    procs = mesh_process_count(mesh)
+    n_dev = int(mesh.shape["data"])
+    local_devs = n_dev // procs if procs > 1 else n_dev
+    padded, mask = pad_rows_with_mask(points, local_devs * row_multiple,
+                                      fill=fill)
+    if procs > 1:
+        from jax.experimental import multihost_utils
+
+        rows = np.asarray(multihost_utils.process_allgather(
+            np.asarray([padded.shape[0]], np.int64))).reshape(-1)
+        if not np.all(rows == rows[0]):
+            raise ValueError(
+                "multi-host KMeans requires equal padded row counts per "
+                f"process; got {rows.tolist()}")
+    return (put_sharded(padded, mesh, P("data")),
+            put_sharded(mask, mesh, P("data")))
 
 
 @partial(jax.jit, static_argnums=0)
@@ -189,9 +213,30 @@ class KMeans(KMeansParams, Estimator["KMeansModel"]):
 
         host_points = stack_vectors(table[self.get_features_col()]).astype(
             np.float32)
-        init = select_random_centroids(host_points, k, self.get_seed())
+        n_for_plan = host_points.shape[0]
+        if mesh_process_count(mesh) > 1:
+            # every process passed its own shard: all hosts must start from
+            # the SAME centroids (host 0's selection becomes the global
+            # init — selecting ONLY there: a non-coordinator shard smaller
+            # than k must not raise before the broadcast collective and
+            # strand the other hosts in it) and must plan the SAME impl
+            # (a per-host row count straddling the Pallas threshold would
+            # compile mismatched collective programs -> deadlock), so the
+            # plan uses the allgathered global row count.
+            from jax.experimental import multihost_utils
 
-        impl, block_n = _plan_fit_impl(host_points.shape[0],
+            from ...parallel.distributed import broadcast_from_host0
+
+            init = (select_random_centroids(host_points, k, self.get_seed())
+                    if jax.process_index() == 0
+                    else np.zeros((k, host_points.shape[1]), np.float32))
+            init = np.asarray(broadcast_from_host0(init))
+            n_for_plan = int(np.sum(multihost_utils.process_allgather(
+                np.asarray([host_points.shape[0]], np.int64))))
+        else:
+            init = select_random_centroids(host_points, k, self.get_seed())
+
+        impl, block_n = _plan_fit_impl(n_for_plan,
                                        host_points.shape[1], k, measure, mesh)
         if impl == "pallas":
             points, mask = _prepare_points(host_points, mesh,
@@ -209,7 +254,7 @@ class KMeans(KMeansParams, Estimator["KMeansModel"]):
             max_epochs=self.get_max_iter(),
             config=IterationConfig(mode="fused"),
         )
-        centroids = np.asarray(jax.device_get(result.state))
+        centroids = np.asarray(fetch_replicated(result.state))
 
         model = KMeansModel()
         model.copy_params_from(self)

@@ -26,7 +26,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ...data.prefetch import prefetch_to_device
 from ...iteration import IterationBodyResult, IterationConfig, iterate
 from ...iteration.checkpoint import CheckpointConfig, CheckpointManager
-from ...parallel.mesh import default_mesh, replicate
+from ...parallel.mesh import (
+    default_mesh,
+    fetch_replicated as _fetch_replicated,
+    mesh_process_count as _mesh_process_count,
+    put_sharded as _put_epoch_tensor,
+    replicate,
+)
 
 __all__ = ["SGDConfig", "sgd_fit", "sgd_fit_params", "sgd_fit_sparse",
            "sgd_fit_mixed", "sgd_fit_outofcore", "LinearState",
@@ -67,11 +73,6 @@ def plan_epoch_layout(n: int, global_batch_size: int, n_dev: int,
     return steps, batch, perm
 
 
-def _mesh_process_count(mesh) -> int:
-    """Distinct processes owning the mesh's devices (1 = single-host)."""
-    return len({d.process_index for d in mesh.devices.flat})
-
-
 def _plan_epoch_layout_for_mesh(n_local: int, global_batch_size: int,
                                 mesh, seed: int
                                 ) -> Tuple[int, int, np.ndarray]:
@@ -106,38 +107,6 @@ def _plan_epoch_layout_for_mesh(n_local: int, global_batch_size: int,
             f"row count; got per-process (steps, local_batch) = "
             f"{layouts.reshape(-1, 2).tolist()}")
     return steps, local_batch, perm
-
-
-def _put_epoch_tensor(arr: np.ndarray, mesh, spec) -> jnp.ndarray:
-    """Place a host epoch tensor on the mesh: plain device_put on a
-    single-host mesh; on a process-spanning mesh each process contributes
-    its local slice (``jax.make_array_from_process_local_data``) and the
-    global batch is the concatenation over processes."""
-    sharding = NamedSharding(mesh, spec)
-    if _mesh_process_count(mesh) > 1:
-        return jax.make_array_from_process_local_data(sharding, arr)
-    return jax.device_put(arr, sharding)
-
-
-def _replicate_params(tree, mesh):
-    """Replicate a param pytree over the mesh, multi-host-safe."""
-    if _mesh_process_count(mesh) > 1:
-        sharding = NamedSharding(mesh, P())
-        return jax.tree_util.tree_map(
-            lambda x: jax.make_array_from_process_local_data(
-                sharding, np.asarray(x)), tree)
-    return replicate(tree, mesh)
-
-
-def _fetch_replicated(tree):
-    """device_get that also handles non-fully-addressable replicated
-    arrays (multi-host: read this process's replica)."""
-    def get(x):
-        if isinstance(x, jax.Array) and not x.is_fully_addressable:
-            return np.asarray(x.addressable_data(0))
-        return np.asarray(jax.device_get(x))
-
-    return jax.tree_util.tree_map(get, tree)
 
 
 def prepare_epoch_tensor(arr: np.ndarray, perm: np.ndarray, steps: int,
@@ -233,7 +202,7 @@ def _run_minibatch_epochs(update, data: tuple, init_params, steps: int,
         return IterationBodyResult(
             feedback=(params, epoch_loss, loss_log), termination=termination)
 
-    init_state = (_replicate_params(init_params, mesh),
+    init_state = (replicate(init_params, mesh),
                   jnp.asarray(jnp.inf, jnp.float32),
                   jnp.full((config.max_epochs,), jnp.nan, jnp.float32))
 

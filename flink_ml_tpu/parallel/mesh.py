@@ -30,6 +30,9 @@ __all__ = [
     "MODEL_AXIS",
     "device_mesh",
     "data_sharding",
+    "fetch_replicated",
+    "mesh_process_count",
+    "put_sharded",
     "replicated",
     "shard_batch",
     "replicate",
@@ -141,7 +144,41 @@ def shard_batch(tree: Any, mesh: Optional[Mesh] = None, *,
     return jax.tree_util.tree_map(put, tree)
 
 
+def mesh_process_count(mesh: Mesh) -> int:
+    """Distinct processes owning the mesh's devices (1 = single-host)."""
+    return len({d.process_index for d in mesh.devices.flat})
+
+
+def put_sharded(arr: np.ndarray, mesh: Mesh, spec: P):
+    """Place a host array on the mesh under ``spec``: plain device_put on a
+    single-host mesh; on a process-spanning mesh each process contributes
+    its LOCAL slice along the sharded dims
+    (``jax.make_array_from_process_local_data``) and the global array is
+    the assembly over processes."""
+    sharding = NamedSharding(mesh, spec)
+    if mesh_process_count(mesh) > 1:
+        return jax.make_array_from_process_local_data(sharding, arr)
+    return jax.device_put(arr, sharding)
+
+
+def fetch_replicated(tree: Any) -> Any:
+    """device_get that also handles non-fully-addressable replicated
+    arrays (multi-host: read this process's local replica)."""
+    def get(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return np.asarray(x.addressable_data(0))
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree_util.tree_map(get, tree)
+
+
 def replicate(tree: Any, mesh: Optional[Mesh] = None) -> Any:
-    """device_put a pytree fully replicated over the mesh."""
+    """device_put a pytree fully replicated over the mesh (multi-host-safe:
+    on a process-spanning mesh every process must pass identical values)."""
+    mesh = mesh or default_mesh()
     sharding = replicated(mesh)
+    if mesh_process_count(mesh) > 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)), tree)
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)

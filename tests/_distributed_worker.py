@@ -157,6 +157,47 @@ def main() -> None:
     np.testing.assert_allclose(log, oracle_log, atol=1e-5)
     assert log[-1] < log[0]
 
+    # multi-host KMeans: each host holds a different half of 4 separated
+    # clusters; the replicated centroids must recover all 4 means on BOTH
+    # hosts (host 0's local selection seeds the global init).
+    from flink_ml_tpu import Table
+    from flink_ml_tpu.models.clustering import KMeans
+    from flink_ml_tpu.parallel.mesh import use_mesh
+
+    # The distributed-correctness assert: multi-host KMeans must equal a
+    # manual single-program Lloyd's run on the concatenated shards with
+    # the same init (clustering QUALITY is a property of Lloyd's, not of
+    # the distribution — only exact equivalence catches sharding bugs).
+    centers = np.asarray([[10.0, 0.0], [-10.0, 0.0],
+                          [0.0, 10.0], [0.0, -10.0]], np.float32)
+
+    def kshard(p):
+        srng = np.random.default_rng(7 + p)
+        return np.concatenate([
+            c + srng.normal(scale=0.3, size=(16, 2)).astype(np.float32)
+            for c in centers])
+
+    pts = kshard(pid)
+    with use_mesh(mesh):
+        km_model = (KMeans().set_k(4).set_max_iter(20).set_seed(3)
+                    .fit(Table({"features": pts})))
+    got = np.asarray(km_model.get_model_data()[0]["centroids"][0])
+
+    from flink_ml_tpu.distance import DistanceMeasure
+    from flink_ml_tpu.models.clustering.kmeans import (
+        kmeans_epoch_step,
+        select_random_centroids,
+    )
+
+    all_pts = np.concatenate([kshard(p) for p in range(nprocs)])
+    oracle_c = jnp.asarray(select_random_centroids(kshard(0), 4, 3))
+    body = kmeans_epoch_step(DistanceMeasure.get_instance("euclidean"), 4)
+    omask = jnp.ones((len(all_pts),), jnp.float32)
+    opts = jnp.asarray(all_pts)
+    for _ in range(20):
+        oracle_c = body(oracle_c, 0, (opts, omask)).feedback
+    np.testing.assert_allclose(got, np.asarray(oracle_c), atol=1e-4)
+
     out = {
         "pid": pid,
         "global_devices": info.global_device_count,
@@ -165,6 +206,7 @@ def main() -> None:
         "resumed": float(np.asarray(jax.device_get(resumed.state))),
         "mixed_lr_final_loss": float(log[-1]),
         "mixed_lr_w0": float(state.coefficients[0]),
+        "kmeans_c00": float(got[0, 0]),
     }
     with open(os.path.join(outdir, f"result_{pid}.json"), "w") as f:
         json.dump(out, f)
