@@ -37,6 +37,7 @@ from typing import Any, Callable, Iterator, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .body import (
     EpochContext,
@@ -397,7 +398,12 @@ def _iterate_fused(body: BodyFn, initial_state, provider: _DataProvider,
                          jnp.asarray(True)))
 
     final_state, outputs, num_epochs, _ = run(initial_state, data)
-    return IterationResult(final_state, outputs, int(num_epochs), {})
+    # on a process-spanning mesh the loop counter comes back as a
+    # non-fully-addressable replicated scalar; read this host's replica
+    from ..parallel.mesh import fetch_replicated
+
+    return IterationResult(final_state, outputs,
+                           int(np.asarray(fetch_replicated(num_epochs))), {})
 
 
 # ---------------------------------------------------------------------------

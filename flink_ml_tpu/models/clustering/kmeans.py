@@ -72,22 +72,36 @@ class KMeansParams(KMeansModelParams, HasSeed, HasMaxIter):
         return self.set(KMeansParams.K, value)
 
 
-def _prepare_points(points: np.ndarray, mesh,
-                    row_multiple: int = 1, fill: str = "first_row") -> tuple:
+def _local_row_multiple(mesh, row_multiple: int = 1) -> int:
+    """Per-process row-padding multiple, with a clear error for mesh
+    shapes whose data axis does not divide over the processes."""
+    procs = mesh_process_count(mesh)
+    n_dev = int(mesh.shape["data"])
+    if procs > 1 and (n_dev % procs or n_dev < procs):
+        raise ValueError(
+            f"data axis {n_dev} does not divide over the mesh's {procs} "
+            "processes; shape the mesh with data as a multiple of the "
+            "process count")
+    local_devs = n_dev // procs if procs > 1 else n_dev
+    return local_devs * row_multiple
+
+
+def _prepare_points(points: np.ndarray, mesh, row_multiple: int = 1,
+                    fill: str = "first_row",
+                    cross_host_checked: bool = False) -> tuple:
     """Host -> device: pad rows to a multiple of the data-axis size (and of
     ``row_multiple`` per shard; mask marks real rows), shard the batch dim.
 
-    On a process-spanning mesh ``points`` is THIS process's shard (equal
-    row counts across processes — validated); each host pads to its local
-    device multiple and the global array assembles over processes."""
+    On a process-spanning mesh ``points`` is THIS process's shard; each
+    host pads to its local device multiple and the global array assembles
+    over processes.  Equal padded counts are required — validated here
+    unless the caller already allgathered row counts
+    (``cross_host_checked``)."""
     from jax.sharding import PartitionSpec as P
 
-    procs = mesh_process_count(mesh)
-    n_dev = int(mesh.shape["data"])
-    local_devs = n_dev // procs if procs > 1 else n_dev
-    padded, mask = pad_rows_with_mask(points, local_devs * row_multiple,
-                                      fill=fill)
-    if procs > 1:
+    multiple = _local_row_multiple(mesh, row_multiple)
+    padded, mask = pad_rows_with_mask(points, multiple, fill=fill)
+    if mesh_process_count(mesh) > 1 and not cross_host_checked:
         from jax.experimental import multihost_utils
 
         rows = np.asarray(multihost_utils.process_allgather(
@@ -214,37 +228,52 @@ class KMeans(KMeansParams, Estimator["KMeansModel"]):
         host_points = stack_vectors(table[self.get_features_col()]).astype(
             np.float32)
         n_for_plan = host_points.shape[0]
-        if mesh_process_count(mesh) > 1:
-            # every process passed its own shard: all hosts must start from
-            # the SAME centroids (host 0's selection becomes the global
-            # init — selecting ONLY there: a non-coordinator shard smaller
-            # than k must not raise before the broadcast collective and
-            # strand the other hosts in it) and must plan the SAME impl
-            # (a per-host row count straddling the Pallas threshold would
-            # compile mismatched collective programs -> deadlock), so the
-            # plan uses the allgathered global row count.
+        multi_host = mesh_process_count(mesh) > 1
+        if multi_host:
+            # Every process passed its own shard.  ONE allgather of the
+            # raw row counts runs before any other collective so every
+            # host takes identical branches from identical facts: the
+            # impl plan uses the GLOBAL row count (per-host planning
+            # straddling the Pallas threshold would compile mismatched
+            # collective programs -> deadlock), the host-0-shard-too-small
+            # error raises on ALL hosts (raising on one strands the rest
+            # in the init broadcast), and padded-count equality is
+            # validated here rather than re-gathered downstream.
             from jax.experimental import multihost_utils
 
+            rows = np.asarray(multihost_utils.process_allgather(
+                np.asarray([host_points.shape[0]], np.int64))).reshape(-1)
+            n_for_plan = int(rows.sum())
+            if rows[0] < k:
+                raise ValueError(
+                    f"multi-host KMeans selects initial centroids from "
+                    f"host 0's shard, which holds {int(rows[0])} rows "
+                    f"< k={k}; give host 0 at least k rows")
+
+        impl, block_n = _plan_fit_impl(n_for_plan,
+                                       host_points.shape[1], k, measure, mesh)
+        row_multiple, fill = (block_n, "zero") if impl == "pallas" else (1, "first_row")
+        if multi_host:
             from ...parallel.distributed import broadcast_from_host0
 
+            multiple = _local_row_multiple(mesh, row_multiple)
+            padded_rows = -(-rows // multiple) * multiple
+            if not np.all(padded_rows == padded_rows[0]):
+                raise ValueError(
+                    "multi-host KMeans requires equal padded row counts "
+                    f"per process; got {padded_rows.tolist()}")
             init = (select_random_centroids(host_points, k, self.get_seed())
                     if jax.process_index() == 0
                     else np.zeros((k, host_points.shape[1]), np.float32))
             init = np.asarray(broadcast_from_host0(init))
-            n_for_plan = int(np.sum(multihost_utils.process_allgather(
-                np.asarray([host_points.shape[0]], np.int64))))
         else:
             init = select_random_centroids(host_points, k, self.get_seed())
 
-        impl, block_n = _plan_fit_impl(n_for_plan,
-                                       host_points.shape[1], k, measure, mesh)
-        if impl == "pallas":
-            points, mask = _prepare_points(host_points, mesh,
-                                           row_multiple=block_n, fill="zero")
-            body = kmeans_epoch_step_pallas(k, mesh, block_n=block_n)
-        else:
-            points, mask = _prepare_points(host_points, mesh)
-            body = kmeans_epoch_step(measure, k)
+        points, mask = _prepare_points(host_points, mesh,
+                                       row_multiple=row_multiple, fill=fill,
+                                       cross_host_checked=True)
+        body = (kmeans_epoch_step_pallas(k, mesh, block_n=block_n)
+                if impl == "pallas" else kmeans_epoch_step(measure, k))
         init_dev = replicate(init, mesh)
 
         result = iterate(

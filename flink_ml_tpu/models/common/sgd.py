@@ -174,15 +174,10 @@ def _run_minibatch_epochs(update, data: tuple, init_params, steps: int,
     sgd_fit_mixed: an inner scan of ``update`` over per-step slices of the
     (steps, batch, ...) device tensors in ``data``, wrapped in a fused
     ``iterate`` with tol termination.  One copy of the termination /
-    loss-log logic so the three trainers can never diverge."""
-    if _mesh_process_count(mesh) > 1 and config.tol > 0:
-        # the criteria-driven fused path returns num_epochs as a replicated
-        # device scalar; int() of a non-fully-addressable array raises
-        # AFTER training completed — fail before any work instead
-        raise ValueError(
-            "multi-host fit requires tol=0 (epoch-loss termination needs a "
-            "per-epoch cross-host scalar read); set SGDConfig(tol=0) and "
-            "control epochs with max_epochs")
+    loss-log logic so the three trainers can never diverge.  Multi-host:
+    the tol-termination vote is computed identically on every host inside
+    the fused while_loop (replicated scalars), so early stopping works
+    without any cross-host round-trip per epoch."""
 
     def epoch_body(state, epoch, data):
         params, prev_loss, loss_log = state
@@ -429,9 +424,8 @@ def sgd_fit_mixed(loss_fn: LossFn, dense_features: np.ndarray,
     Multi-host: pass a process-spanning mesh (``distributed.global_mesh``)
     and call from EVERY process with that process's own equal-sized row
     shard; the global batch is the concatenation over processes and the
-    gradient reduction rides ICI/DCN.  Use ``tol=0`` (epoch-loss
-    termination would read a cross-host scalar per epoch).  The same
-    contract applies to :func:`sgd_fit` / :func:`sgd_fit_sparse`."""
+    gradient reduction rides ICI/DCN.  The same contract applies to
+    :func:`sgd_fit` / :func:`sgd_fit_sparse`."""
     from .linear import check_sparse_indices
 
     check_sparse_indices(cat_indices, num_features)
