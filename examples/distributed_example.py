@@ -1,0 +1,94 @@
+"""Multi-host training demo: 2 OS processes, each holding its own data
+shard, jointly fit LogisticRegression (mixed Criteo layout) and KMeans
+over one process-spanning mesh.
+
+Run with no arguments: the script spawns itself twice as jax.distributed
+participants (2 CPU devices each — the MiniCluster-style local stand-in
+for 2 TPU hosts) and prints both processes' identical results.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def worker(coord: str, nprocs: int, pid: int) -> None:
+    import jax
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    import numpy as np
+
+    from flink_ml_tpu import Table
+    from flink_ml_tpu.models.clustering import KMeans
+    from flink_ml_tpu.parallel import distributed as dist
+    from flink_ml_tpu.parallel.mesh import use_mesh
+
+    dist.initialize(coordinator_address=coord, num_processes=nprocs,
+                    process_id=pid)
+    mesh = dist.global_mesh()
+
+    # each process contributes ITS OWN 512-row shard; the global batch is
+    # the concatenation and the gradient reduction crosses hosts
+    rng = np.random.default_rng(pid)
+    dense = rng.normal(size=(512, 13)).astype(np.float32)
+    cat = rng.integers(32, 1 << 16, size=(512, 26)).astype(np.int32)
+    label = rng.integers(0, 2, size=512).astype(np.float64)
+    cat[:, 0] = np.where(label == 1, 16, 17)
+
+    from flink_ml_tpu.models.common.losses import LOSSES
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_mixed
+
+    state, log = sgd_fit_mixed(
+        LOSSES["logistic"], dense, cat, label, None, 1 << 16,
+        SGDConfig(learning_rate=0.5, max_epochs=6, tol=0,
+                  global_batch_size=128), mesh=mesh)
+
+    # KMeans over per-host shards of the same 3 clusters
+    centers = np.asarray([[8.0, 0.0], [-8.0, 8.0], [0.0, -8.0]], np.float32)
+    pts = np.concatenate([c + rng.normal(scale=0.4, size=(40, 2))
+                          for c in centers]).astype(np.float32)
+    with use_mesh(mesh):
+        km = KMeans().set_k(3).set_max_iter(15).fit(Table({"features": pts}))
+    got = np.sort(np.asarray(km.get_model_data()[0]["centroids"][0]), axis=0)
+
+    print(f"[process {pid}] LR loss {log[0]:.3f}->{log[-1]:.3f}  "
+          f"w[16]={state.coefficients[16]:+.3f} w[17]="
+          f"{state.coefficients[17]:+.3f}  kmeans c0={got[0].round(1)}")
+    dist.barrier("done")
+
+
+def main() -> None:
+    if len(sys.argv) > 1:
+        worker(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+        return
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_COORDINATOR_ADDRESS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), coord, "2", str(p)],
+        env=env) for p in range(2)]
+    try:
+        for p in procs:
+            p.wait(timeout=300)
+            assert p.returncode == 0, f"worker exited {p.returncode}"
+    finally:
+        # one worker dying strands its peer in a collective; never leave
+        # an orphan spinning
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    print("both processes agreed; multi-host fit complete")
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,7 @@ from ...params.shared import (
 )
 from ...parallel.mesh import (
     default_mesh,
+    local_axis_multiple,
     fetch_replicated,
     mesh_process_count,
     put_sharded,
@@ -72,20 +73,6 @@ class KMeansParams(KMeansModelParams, HasSeed, HasMaxIter):
         return self.set(KMeansParams.K, value)
 
 
-def _local_row_multiple(mesh, row_multiple: int = 1) -> int:
-    """Per-process row-padding multiple, with a clear error for mesh
-    shapes whose data axis does not divide over the processes."""
-    procs = mesh_process_count(mesh)
-    n_dev = int(mesh.shape["data"])
-    if procs > 1 and (n_dev % procs or n_dev < procs):
-        raise ValueError(
-            f"data axis {n_dev} does not divide over the mesh's {procs} "
-            "processes; shape the mesh with data as a multiple of the "
-            "process count")
-    local_devs = n_dev // procs if procs > 1 else n_dev
-    return local_devs * row_multiple
-
-
 def _prepare_points(points: np.ndarray, mesh, row_multiple: int = 1,
                     fill: str = "first_row",
                     cross_host_checked: bool = False) -> tuple:
@@ -99,7 +86,7 @@ def _prepare_points(points: np.ndarray, mesh, row_multiple: int = 1,
     (``cross_host_checked``)."""
     from jax.sharding import PartitionSpec as P
 
-    multiple = _local_row_multiple(mesh, row_multiple)
+    multiple = local_axis_multiple(mesh, row_multiple=row_multiple)
     padded, mask = pad_rows_with_mask(points, multiple, fill=fill)
     if mesh_process_count(mesh) > 1 and not cross_host_checked:
         from jax.experimental import multihost_utils
@@ -256,7 +243,7 @@ class KMeans(KMeansParams, Estimator["KMeansModel"]):
         if multi_host:
             from ...parallel.distributed import broadcast_from_host0
 
-            multiple = _local_row_multiple(mesh, row_multiple)
+            multiple = local_axis_multiple(mesh, row_multiple=row_multiple)
             padded_rows = -(-rows // multiple) * multiple
             if not np.all(padded_rows == padded_rows[0]):
                 raise ValueError(

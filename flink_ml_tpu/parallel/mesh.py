@@ -29,8 +29,10 @@ __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
     "device_mesh",
+    "axis_process_count",
     "data_sharding",
     "fetch_replicated",
+    "local_axis_multiple",
     "mesh_process_count",
     "put_sharded",
     "replicated",
@@ -125,21 +127,39 @@ def _pad_rows(arr: np.ndarray, multiple: int) -> np.ndarray:
 
 def shard_batch(tree: Any, mesh: Optional[Mesh] = None, *,
                 axis: str = DATA_AXIS, pad: bool = True) -> Any:
-    """device_put a pytree of host arrays with the leading dim sharded over
+    """Place a pytree of host arrays with the leading dim sharded over
     ``axis``.  With ``pad=True`` rows are padded (repeating row 0) to a
-    multiple of the axis size — callers carrying a mask should use
-    ``Table.pad_to_multiple`` instead to keep the mask."""
+    multiple of the PER-PROCESS axis size — callers carrying a mask should
+    use ``Table.pad_to_multiple`` instead to keep the mask.  On a
+    process-spanning mesh each process passes its own rows (the
+    :func:`put_sharded` contract)."""
     mesh = mesh or default_mesh()
     if axis not in mesh.shape:
         raise ValueError(f"Mesh has no axis {axis!r}; axes: {list(mesh.shape)}")
-    n = int(mesh.shape[axis])
-    sharding = NamedSharding(mesh, P(axis))
+    n = local_axis_multiple(mesh, axis)
+    spec = P(axis)
 
     def put(x):
         arr = np.asarray(x)
         if pad and arr.shape and arr.shape[0] % n:
             arr = _pad_rows(arr, n)
-        return jax.device_put(arr, sharding)
+        return put_sharded(arr, mesh, spec)
+
+    if axis_process_count(mesh, axis) > 1:
+        # unequal per-process shards would infer different global shapes
+        # on each host and deadlock the first collective; check up front
+        from jax.experimental import multihost_utils
+
+        first = next(iter(jax.tree_util.tree_leaves(tree)), None)
+        if first is not None:
+            rows = np.asarray(first).shape[0]
+            rows += (-rows) % n if pad else 0
+            gathered = np.asarray(multihost_utils.process_allgather(
+                np.asarray([rows], np.int64))).reshape(-1)
+            if not np.all(gathered == gathered[0]):
+                raise ValueError(
+                    "shard_batch on a process-spanning axis requires equal "
+                    f"padded row counts per process; got {gathered.tolist()}")
 
     return jax.tree_util.tree_map(put, tree)
 
@@ -147,6 +167,29 @@ def shard_batch(tree: Any, mesh: Optional[Mesh] = None, *,
 def mesh_process_count(mesh: Mesh) -> int:
     """Distinct processes owning the mesh's devices (1 = single-host)."""
     return len({d.process_index for d in mesh.devices.flat})
+
+
+def axis_process_count(mesh: Mesh, axis: str) -> int:
+    """Distinct processes along ONE mesh axis (an axis laid out entirely
+    within each host counts 1 even on a multi-host mesh)."""
+    ax = list(mesh.axis_names).index(axis)
+    devs = np.moveaxis(np.asarray(mesh.devices), ax, 0)
+    line = devs.reshape(devs.shape[0], -1)[:, 0]
+    return len({d.process_index for d in line})
+
+
+def local_axis_multiple(mesh: Mesh, axis: str = DATA_AXIS,
+                        row_multiple: int = 1) -> int:
+    """Per-process row-padding multiple for arrays sharded over ``axis``,
+    with a clear error for axes that do not divide over their processes."""
+    n_axis = int(mesh.shape[axis])
+    procs = axis_process_count(mesh, axis)
+    if procs > 1 and (n_axis % procs or n_axis < procs):
+        raise ValueError(
+            f"axis {axis!r} of size {n_axis} does not divide over the "
+            f"{procs} processes it spans; shape the mesh with the axis as "
+            "a multiple of the process count")
+    return (n_axis // procs) * row_multiple
 
 
 def put_sharded(arr: np.ndarray, mesh: Mesh, spec: P):

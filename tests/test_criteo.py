@@ -184,3 +184,39 @@ def test_parse_non_utf8_token_hashes_raw_bytes():
 def test_parse_rejects_oversized_hash_space():
     with pytest.raises(ValueError, match="int32"):
         parse_chunk(b"", 1, hash_space=1 << 31)
+
+
+def test_tsv_to_datacache_to_outofcore_replay(tmp_path):
+    """The full documented ingest pipeline: TSV -> CriteoTSVReader ->
+    DataCacheWriter (persisted once) -> DataCacheReader replay per epoch
+    -> fit_outofcore(mixed=True).  Caching must not change the fit: the
+    coefficients match streaming the TSV directly with the same batch
+    order."""
+    from flink_ml_tpu.data.datacache import DataCacheReader, DataCacheWriter
+    from flink_ml_tpu.models.classification import LogisticRegression
+
+    rng = np.random.default_rng(5)
+    path = tmp_path / "day.tsv"
+    _make_tsv(path, 256, rng)
+    hash_space = 1 << 12
+    batch = 64
+
+    cache = str(tmp_path / "cache")
+    writer = DataCacheWriter(cache, segment_rows=128)
+    for b in CriteoTSVReader(str(path), batch_rows=batch,
+                             hash_space=hash_space):
+        writer.append(b)
+    writer.finish()
+
+    def fit(make_reader):
+        lr = (LogisticRegression().set_max_iter(3).set_learning_rate(0.5)
+              .set_tol(0))
+        return lr.fit_outofcore(make_reader,
+                                num_features=13 + hash_space, mixed=True)
+
+    cached = fit(lambda: DataCacheReader(cache, batch_rows=batch))
+    direct = fit(lambda: CriteoTSVReader(str(path), batch_rows=batch,
+                                         hash_space=hash_space))
+    np.testing.assert_allclose(cached._state.coefficients,
+                               direct._state.coefficients, atol=1e-6)
+    assert cached.loss_log[-1] < cached.loss_log[0]
