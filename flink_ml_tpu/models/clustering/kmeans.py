@@ -118,24 +118,38 @@ def select_random_centroids(points: np.ndarray, k: int, seed: int) -> np.ndarray
     return points[idx]
 
 
+def _assign_stats(measure: DistanceMeasure, k: int, points, mask,
+                  centroids):
+    """THE Lloyd's statistics: (sums (k, d), counts (k,)) of the masked
+    points by nearest centroid — shared by the in-core epoch body and the
+    out-of-core per-batch accumulation so the two can never diverge."""
+    dists = measure.pairwise(points, centroids)            # (n, k)
+    assign = jnp.argmin(dists, axis=1)                     # (n,)
+    onehot = jax.nn.one_hot(assign, k, dtype=points.dtype) # (n, k)
+    onehot = onehot * mask[:, None]                        # drop padding
+    sums = jnp.einsum("nk,nd->kd", onehot, points)         # MXU reduce
+    return sums, jnp.sum(onehot, axis=0)
+
+
+def _update_centroids(centroids, sums, counts, xp=jnp):
+    """Empty clusters keep their previous centroid (the reference's
+    keyed-reduce would silently drop them; keeping is strictly better and
+    identical when all clusters are non-empty, as in KMeansTest).
+    ``xp`` lets the out-of-core path apply the identical policy on its
+    host float64 accumulators (jnp would silently downcast to f32)."""
+    counts = counts[:, None]
+    return xp.where(counts > 0, sums / xp.maximum(counts, 1.0), centroids)
+
+
 def kmeans_epoch_step(measure: DistanceMeasure, k: int):
     """One Lloyd's iteration as a pure jnp function (points, mask are closed
     over by ``iterate``'s static data)."""
 
     def body(centroids, epoch, data):
         points, mask = data
-        dists = measure.pairwise(points, centroids)            # (n, k)
-        assign = jnp.argmin(dists, axis=1)                     # (n,)
-        onehot = jax.nn.one_hot(assign, k, dtype=points.dtype) # (n, k)
-        onehot = onehot * mask[:, None]                        # drop padding
-        sums = jnp.einsum("nk,nd->kd", onehot, points)         # MXU reduce
-        counts = jnp.sum(onehot, axis=0)[:, None]              # (k, 1)
-        # Empty clusters keep their previous centroid (the reference's
-        # keyed-reduce would silently drop them; keeping is strictly better
-        # and identical when all clusters are non-empty, as in KMeansTest).
-        new_centroids = jnp.where(counts > 0,
-                                  sums / jnp.maximum(counts, 1.0), centroids)
-        return IterationBodyResult(feedback=new_centroids)
+        sums, counts = _assign_stats(measure, k, points, mask, centroids)
+        return IterationBodyResult(
+            feedback=_update_centroids(centroids, sums, counts))
 
     return body
 
@@ -199,6 +213,112 @@ def _plan_fit_impl(n: int, d: int, k: int, measure: DistanceMeasure,
     # any supported block size works; pick_block_n takes the largest.
     bn = kp.pick_block_n(None, d, k)
     return ("pallas", bn) if bn is not None else ("xla", None)
+
+
+def kmeans_fit_outofcore(make_reader, k: int, *,
+                         measure_name: str = "euclidean",
+                         max_iter: int = 20, seed: int = 0, mesh=None,
+                         features_key: str = "features",
+                         prefetch_depth: int = 2) -> np.ndarray:
+    """Out-of-core Lloyd's: the dataset streams from ``make_reader()``
+    (a fresh per-epoch iterator of host batch dicts — the same protocol as
+    ``sgd_fit_outofcore``) instead of living in HBM; this is the
+    replay-per-epoch semantics of the reference's ReplayOperator
+    (``operator/ReplayOperator.java:62-311``) at beyond-memory scale.
+
+    Each epoch accumulates per-batch (sums, counts) partial statistics on
+    device — batch N+1's host read and transfer overlap batch N's compute
+    via ``prefetch_to_device`` — and the centroid update applies once per
+    epoch (exact Lloyd's: identical result to the in-core fit on the same
+    concatenated rows, asserted in tests).  Initial centroids are a
+    seeded shuffle-take-k of the FIRST batch.
+
+    Returns the final (k, d) centroids (host float32)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ...data.prefetch import prefetch_to_device
+
+    mesh = mesh or default_mesh()
+    if mesh_process_count(mesh) > 1:
+        raise ValueError(
+            "kmeans_fit_outofcore is single-host (the prefetch transfer "
+            "and init read are per-process); run the reader on each host "
+            "and use KMeans.fit with per-process shards for multi-host")
+    measure = DistanceMeasure.get_instance(measure_name)
+
+    multiple = local_axis_multiple(mesh)
+    sharding = NamedSharding(mesh, P("data"))
+    batch_rows: list = []   # fixed after the first batch (static shapes)
+
+    def to_host_batch(batch):
+        pts = np.asarray(batch[features_key], np.float32)
+        padded, mask = pad_rows_with_mask(pts, multiple, fill="zero")
+        if not batch_rows:
+            batch_rows.append(padded.shape[0])
+        rows = batch_rows[0]
+        if padded.shape[0] > rows:
+            raise ValueError(
+                f"reader produced a growing batch ({padded.shape[0]} rows "
+                f"after {rows}); fixed-size batches are required")
+        if padded.shape[0] < rows:   # final partial batch: zero rows
+            pad = rows - padded.shape[0]
+            padded = np.concatenate(
+                [padded, np.zeros((pad,) + padded.shape[1:], padded.dtype)])
+            mask = np.concatenate([mask, np.zeros((pad,), mask.dtype)])
+        return padded, mask
+
+    batch_stats = jax.jit(lambda c, pts, mask:
+                          _assign_stats(measure, k, pts, mask, c))
+    add2 = jax.jit(lambda a, b, c, d: (a + c, b + d))
+
+    centroids = None
+    for _ in range(max_iter):
+        # Two-level accumulation: f32 on device within a window sized so
+        # counts stay in f32's exact-integer range (2^24), folded into a
+        # host float64 total — billions of rows per epoch cannot silently
+        # round away per-batch contributions.
+        host_sums = host_counts = None
+        sums = counts = None
+        window_used = 0
+        window = None
+
+        def fold():
+            nonlocal host_sums, host_counts, sums, counts, window_used
+            if sums is None:
+                return
+            s64 = np.asarray(jax.device_get(sums), np.float64)
+            c64 = np.asarray(jax.device_get(counts), np.float64)
+            host_sums = s64 if host_sums is None else host_sums + s64
+            host_counts = c64 if host_counts is None else host_counts + c64
+            sums = counts = None
+            window_used = 0
+
+        for pts, mask in prefetch_to_device(
+                make_reader(), depth=prefetch_depth,
+                transform=to_host_batch,
+                sharding=(sharding, sharding)):
+            if centroids is None:
+                # init: seeded shuffle-take-k of the first batch's rows
+                first = np.asarray(pts)[np.asarray(mask) > 0]
+                centroids = jnp.asarray(
+                    select_random_centroids(first, k, seed))
+            if window is None:
+                window = max(1, (1 << 23) // batch_rows[0])
+            s, c = batch_stats(centroids, pts, mask)
+            if sums is None:
+                sums, counts = s, c
+            else:
+                sums, counts = add2(sums, counts, s, c)
+            window_used += 1
+            if window_used >= window:
+                fold()
+        fold()
+        if host_sums is None:
+            raise ValueError("make_reader() returned an empty epoch")
+        centroids = jnp.asarray(_update_centroids(
+            np.asarray(jax.device_get(centroids), np.float64),
+            host_sums, host_counts, xp=np).astype(np.float32))
+    return np.asarray(jax.device_get(centroids), np.float32)
 
 
 class KMeans(KMeansParams, Estimator["KMeansModel"]):
@@ -276,6 +396,22 @@ class KMeans(KMeansParams, Estimator["KMeansModel"]):
         model.copy_params_from(self)
         model.set_model_data(
             Table({"centroids": centroids[None, :, :]}))  # 1 row of (k, d)
+        return model
+
+    def fit_outofcore(self, make_reader, *, mesh=None,
+                      features_key: str = None) -> "KMeansModel":
+        """Out-of-core ``fit`` (see :func:`kmeans_fit_outofcore`): the
+        dataset streams from ``make_reader()`` — a fresh per-epoch
+        iterator of host batch dicts (e.g. a re-seeked ``DataCacheReader``)
+        — instead of living in RAM/HBM."""
+        centroids = kmeans_fit_outofcore(
+            make_reader, self.get_k(),
+            measure_name=self.get_distance_measure(),
+            max_iter=self.get_max_iter(), seed=self.get_seed(), mesh=mesh,
+            features_key=features_key or self.get_features_col())
+        model = KMeansModel()
+        model.copy_params_from(self)
+        model.set_model_data(Table({"centroids": centroids[None, :, :]}))
         return model
 
     def save(self, path: str) -> None:

@@ -241,3 +241,74 @@ def test_pallas_step_fractional_split_counts_divide_exactly():
     # the mean must still be exactly p0.
     np.testing.assert_allclose(new[0], p[0], atol=1e-5)
     np.testing.assert_allclose(new[1], p[0], atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# out-of-core fit (replay-per-epoch, the ReplayOperator analog at scale)
+# ---------------------------------------------------------------------------
+
+def _ooc_batches(pts, batch):
+    def gen():
+        for s in range(0, len(pts), batch):
+            yield {"features": pts[s:s + batch]}
+    return gen
+
+
+def test_kmeans_outofcore_matches_incore_math():
+    """Per-batch accumulation must reproduce the full-batch Lloyd's update
+    exactly (same init): streaming is a layout change, not a math change."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.distance import DistanceMeasure
+    from flink_ml_tpu.models.clustering.kmeans import (
+        kmeans_epoch_step,
+        kmeans_fit_outofcore,
+        select_random_centroids,
+    )
+
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(257, 5)).astype(np.float32)  # odd row count
+    k, iters, batch = 4, 6, 64
+
+    got = kmeans_fit_outofcore(_ooc_batches(pts, batch), k,
+                               max_iter=iters, seed=3)
+
+    body = kmeans_epoch_step(DistanceMeasure.get_instance("euclidean"), k)
+    c = jnp.asarray(select_random_centroids(pts[:batch], k, 3))
+    mask = jnp.ones((len(pts),), jnp.float32)
+    for _ in range(iters):
+        c = body(c, 0, (jnp.asarray(pts), mask)).feedback
+    np.testing.assert_allclose(got, np.asarray(c), atol=1e-5)
+
+
+def test_kmeans_outofcore_estimator_clusters(tmp_path):
+    from flink_ml_tpu.data.datacache import DataCacheReader, DataCacheWriter
+
+    rng = np.random.default_rng(1)
+    centers = np.asarray([[6.0, 6.0], [-6.0, -6.0]], np.float32)
+    pts = np.concatenate([c + rng.normal(scale=0.3, size=(150, 2))
+                          for c in centers]).astype(np.float32)
+    pts = pts[rng.permutation(len(pts))]
+
+    cache = str(tmp_path / "cache")
+    writer = DataCacheWriter(cache, segment_rows=128)
+    for s in range(0, len(pts), 64):
+        writer.append({"features": pts[s:s + 64]})
+    writer.finish()
+
+    model = (KMeans().set_k(2).set_max_iter(10)
+             .fit_outofcore(lambda: DataCacheReader(cache, batch_rows=64)))
+    got = np.sort(np.asarray(model.get_model_data()[0]["centroids"][0]),
+                  axis=0)
+    np.testing.assert_allclose(got, np.sort(centers, axis=0), atol=0.2)
+
+    pred = np.asarray(
+        model.transform(Table({"features": pts}))[0]["prediction"])
+    assert len(np.unique(pred)) == 2
+
+
+def test_kmeans_outofcore_empty_reader_raises():
+    from flink_ml_tpu.models.clustering.kmeans import kmeans_fit_outofcore
+
+    with pytest.raises(ValueError, match="empty"):
+        kmeans_fit_outofcore(lambda: iter(()), 2, max_iter=2)
