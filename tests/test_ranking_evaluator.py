@@ -1,0 +1,91 @@
+"""RankingEvaluator — hand-computed top-k metric fixtures."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.evaluation import RankingEvaluator
+
+
+def _lists_table(preds, labels):
+    p = np.empty(len(preds), object)
+    r = np.empty(len(labels), object)
+    for i, (a, b) in enumerate(zip(preds, labels)):
+        p[i], r[i] = list(a), list(b)
+    return Table({"prediction": p, "label": r})
+
+
+def test_hand_computed_single_row():
+    # ranked [a, b, c, d], relevant {a, c, x}; k = 4
+    t = _lists_table([["a", "b", "c", "d"]], [["a", "c", "x"]])
+    out = RankingEvaluator().set_k(4).transform(t)[0]
+    assert out["precisionAtK"][0] == pytest.approx(2 / 4)
+    assert out["recallAtK"][0] == pytest.approx(2 / 3)
+    assert out["hitRateAtK"][0] == 1.0
+    # DCG = 1/log2(2) + 1/log2(4) = 1.5; IDCG(3 relevant, k=4) =
+    # 1/log2(2)+1/log2(3)+1/log2(4) = 2.1309
+    expected_ndcg = 1.5 / (1 + 1 / np.log2(3) + 0.5)
+    assert out["ndcgAtK"][0] == pytest.approx(expected_ndcg, rel=1e-6)
+    # AP@4 = (1/1 + 2/3) / min(3, 4)
+    assert out["mapAtK"][0] == pytest.approx((1 + 2 / 3) / 3, rel=1e-6)
+
+
+def test_perfect_and_worthless_rankings_average():
+    t = _lists_table(
+        [["a", "b"], ["x", "y"]],      # row 1 perfect, row 2 all misses
+        [["a", "b"], ["a", "b"]])
+    out = RankingEvaluator().set_k(2).transform(t)[0]
+    assert out["precisionAtK"][0] == pytest.approx(0.5)
+    assert out["recallAtK"][0] == pytest.approx(0.5)
+    assert out["hitRateAtK"][0] == pytest.approx(0.5)
+    assert out["ndcgAtK"][0] == pytest.approx(0.5)
+    assert out["mapAtK"][0] == pytest.approx(0.5)
+
+
+def test_k_truncates_predictions():
+    t = _lists_table([["x", "y", "a"]], [["a"]])
+    out2 = RankingEvaluator().set_k(2).transform(t)[0]
+    assert out2["hitRateAtK"][0] == 0.0         # a is ranked third
+    out3 = RankingEvaluator().set_k(3).transform(t)[0]
+    assert out3["hitRateAtK"][0] == 1.0
+
+
+def test_rows_without_relevant_items_skipped():
+    t = _lists_table([["a"], ["b"]], [["a"], []])
+    out = RankingEvaluator().set_k(1).transform(t)[0]
+    assert out["precisionAtK"][0] == 1.0        # only row 1 counted
+    with pytest.raises(ValueError, match="no rows"):
+        RankingEvaluator().transform(_lists_table([["a"]], [[]]))
+
+
+def test_metric_subset_and_validation():
+    t = _lists_table([["a"]], [["a"]])
+    out = (RankingEvaluator().set_metrics("ndcgAtK", "mapAtK")
+           .set_k(1).transform(t)[0])
+    assert out.column_names == ["ndcgAtK", "mapAtK"]
+    with pytest.raises(ValueError, match="invalid value"):
+        RankingEvaluator().set_metrics("nope")
+
+
+def test_integer_item_ids():
+    t = _lists_table([[3, 1, 2]], [[2, 9]])
+    out = RankingEvaluator().set_k(3).transform(t)[0]
+    assert out["recallAtK"][0] == pytest.approx(0.5)
+
+
+def test_duplicate_predictions_count_once():
+    t = _lists_table([["a", "a"]], [["a"]])
+    out = RankingEvaluator().set_k(2).transform(t)[0]
+    assert out["recallAtK"][0] == pytest.approx(1.0)
+    assert out["mapAtK"][0] == pytest.approx(1.0)
+    assert out["ndcgAtK"][0] <= 1.0
+
+
+def test_none_label_cell_skipped():
+    p = np.empty(2, object)
+    p[0], p[1] = ["a"], ["b"]
+    r = np.empty(2, object)
+    r[0], r[1] = ["a"], None
+    out = (RankingEvaluator().set_k(1)
+           .transform(Table({"prediction": p, "label": r}))[0])
+    assert out["precisionAtK"][0] == pytest.approx(1.0)
