@@ -156,26 +156,14 @@ def _chi2_scores(X: np.ndarray, y: np.ndarray) -> np.ndarray:
     return _p_values(np.asarray(stats), np.asarray(dofs))
 
 
-@jax.jit
-def _pearson_r(X, y):
-    Xc = X - jnp.mean(X, axis=0, keepdims=True)
-    yc = y - jnp.mean(y)
-    num = Xc.T @ yc
-    den = jnp.sqrt(jnp.sum(Xc * Xc, axis=0) * jnp.sum(yc * yc))
-    return num / jnp.maximum(den, 1e-30)
-
-
 def _f_regression_scores(X: np.ndarray, y: np.ndarray) -> np.ndarray:
-    """Per-feature F-regression p-values (continuous X, continuous y):
-    F = r^2 / (1 - r^2) * (n - 2) with dof (1, n - 2)."""
-    n, d = X.shape
-    r = np.asarray(_pearson_r(jnp.asarray(X, jnp.float32),
-                              jnp.asarray(y, jnp.float32)), np.float64)
-    r = np.clip(r, -1.0, 1.0)
-    dfd = n - 2
-    with np.errstate(divide="ignore", invalid="ignore"):
-        f = r * r / np.maximum(1.0 - r * r, 1e-300) * dfd
-    return f_p_values(f, np.ones(d), np.full(d, dfd))
+    """Per-feature F-regression p-values — THE implementation lives in
+    ``stats.fvaluetest`` (the FValueTest AlgoOperator); the selector only
+    consumes the p-values."""
+    from ..stats.fvaluetest import f_regression_scores
+
+    _, p, _ = f_regression_scores(X, y)
+    return p
 
 
 _DEFAULT_THRESHOLDS = {"numTopFeatures": 50.0, "percentile": 0.1,

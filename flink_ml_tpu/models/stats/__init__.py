@@ -1,2 +1,3 @@
 from .anovatest import ANOVATest  # noqa: F401
 from .chisqtest import ChiSqTest  # noqa: F401
+from .fvaluetest import FValueTest  # noqa: F401

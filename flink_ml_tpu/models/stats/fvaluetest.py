@@ -1,0 +1,76 @@
+"""FValueTest — F-regression test, continuous feature vs continuous label.
+
+Member of the Flink ML 2.x stats surface (``org.apache.flink.ml.stats``
+family alongside ChiSqTest and ANOVATest; the reference snapshot ships
+none — SURVEY §2.8).  AlgoOperator: one output row per feature column
+with (pValue, degreesOfFreedom, fValue), where
+``F = r^2 / (1 - r^2) * (n - 2)`` from the Pearson correlation r.
+
+TPU split (same stance as ANOVATest): the O(n*d) correlation reduction
+is one jitted pass on device; the F ratio and its survival-function
+p-value finish on host in float64.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import AlgoOperator
+from ...data.table import Table
+from ...linalg import stack_vectors
+from ...params.shared import HasFeaturesCol, HasLabelCol
+from .anovatest import f_p_values
+
+__all__ = ["FValueTest", "f_regression_scores"]
+
+
+@jax.jit
+def _pearson_r(X, y):
+    Xc = X - jnp.mean(X, axis=0, keepdims=True)
+    yc = y - jnp.mean(y)
+    num = Xc.T @ yc
+    den = jnp.sqrt(jnp.sum(Xc * Xc, axis=0) * jnp.sum(yc * yc))
+    return num / jnp.maximum(den, 1e-30)
+
+
+def f_regression_scores(X: np.ndarray, y: np.ndarray
+                        ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """(f_values (d,), p_values (d,), dfd) for continuous features X
+    against a continuous label y: F = r^2/(1-r^2) * (n-2), dof (1, n-2)."""
+    n, d = X.shape
+    r = np.asarray(_pearson_r(jnp.asarray(X, jnp.float32),
+                              jnp.asarray(y, jnp.float32)), np.float64)
+    r = np.clip(r, -1.0, 1.0)
+    dfd = n - 2
+    with np.errstate(divide="ignore", invalid="ignore"):
+        # the 1e-300 floor keeps perfect correlation (r = +-1) FINITE and
+        # astronomically large -> survival function underflows to p = 0;
+        # a NaN r (degenerate input) stays NaN, which f_p_values maps to
+        # p = 1 — so fValue and pValue always tell the same story
+        f = r * r / np.maximum(1.0 - r * r, 1e-300) * dfd
+    return f, f_p_values(f, np.ones(d), np.full(d, dfd)), dfd
+
+
+class FValueTest(HasFeaturesCol, HasLabelCol, AlgoOperator):
+    """transform(table) -> one Table with a row per feature column:
+    (featureIndex, pValue, degreesOfFreedom, fValue).  Features and label
+    are continuous."""
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
+        y = np.asarray(table[self.get_label_col()], np.float64)
+        f, p, dfd = f_regression_scores(X, y)
+        d = X.shape[1]
+        return [Table({
+            "featureIndex": np.arange(d, dtype=np.int64),
+            "pValue": np.asarray(p, np.float64),
+            # the reference family reports numSamples - 2 here (the
+            # denominator dof), unlike ANOVA's summed-dofs convention
+            "degreesOfFreedom": np.full(d, dfd, np.int64),
+            "fValue": np.asarray(f, np.float64),
+        })]

@@ -156,3 +156,37 @@ def test_univariate_save_load(tmp_path):
     loaded = UnivariateFeatureSelectorModel.load(path)
     np.testing.assert_array_equal(loaded._indices, [1, 4])
     assert loaded.get_selection_mode() == "numTopFeatures"
+
+
+def test_fvaluetest_hand_computed():
+    """y = 2x exactly: r = 1 -> F = inf -> p = 0 for the correlated column;
+    noise column gets a large p."""
+    from flink_ml_tpu.models.stats import FValueTest
+
+    rng = np.random.default_rng(4)
+    n = 200
+    x = rng.normal(size=n)
+    X = np.column_stack([x, rng.normal(size=n)])
+    y = 2.0 * x
+    out = FValueTest().transform(
+        Table({"features": X, "label": y}))[0]
+    p = np.asarray(out["pValue"])
+    assert p[0] < 1e-12 and p[1] > 0.01
+    # the reference family reports numSamples - 2 (denominator dof)
+    assert np.asarray(out["degreesOfFreedom"])[0] == n - 2
+    assert np.asarray(out["fValue"])[0] > 1e6  # finite even at r = +-1
+
+
+def test_fvaluetest_known_f_value():
+    # fixed tiny fixture: x = [1..6], y = x + alternating noise
+    X = np.arange(1.0, 7.0)[:, None]
+    y = X[:, 0] + np.asarray([0.1, -0.1, 0.1, -0.1, 0.1, -0.1])
+    from flink_ml_tpu.models.stats import FValueTest
+
+    out = FValueTest().transform(Table({"features": X, "label": y}))[0]
+    # r computed by hand via numpy.corrcoef in float64 (f32 device pass
+    # keeps ~6 digits)
+    r = np.corrcoef(X[:, 0], y)[0, 1]
+    expected_f = r * r / (1 - r * r) * 4
+    np.testing.assert_allclose(np.asarray(out["fValue"])[0], expected_f,
+                               rtol=1e-3)
