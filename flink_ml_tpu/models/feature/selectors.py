@@ -28,8 +28,9 @@ from ...linalg import stack_vectors
 from ...params.param import FloatParam, ParamValidators, StringParam
 from ...params.shared import HasLabelCol
 from ...utils import persist
-from ..stats.anovatest import anova_f_scores, f_p_values
+from ..stats.anovatest import anova_f_scores
 from ..stats.chisqtest import _chi2_from_contingency, _p_values
+from ..stats.fvaluetest import f_regression_scores
 from .transforms import _InOutParams
 
 __all__ = [
@@ -160,8 +161,6 @@ def _f_regression_scores(X: np.ndarray, y: np.ndarray) -> np.ndarray:
     """Per-feature F-regression p-values — THE implementation lives in
     ``stats.fvaluetest`` (the FValueTest AlgoOperator); the selector only
     consumes the p-values."""
-    from ..stats.fvaluetest import f_regression_scores
-
     _, p, _ = f_regression_scores(X, y)
     return p
 

@@ -11,14 +11,17 @@ with its top-k similar items and scores:
 
 TPU design: after host-side id indexing and behavior filtering, the
 whole score tensor is device matmul work over the binary user-item
-matrix B — the user-user co-count matrix ``B @ B.T`` builds the pair
-kernel K once, and each item's row of similarities is
-``colsum((B ⊙ b_i) ⊙ (K @ (B ⊙ b_i)))``, a ``lax.scan`` of MXU matmuls
-rather than the reference family's per-pair hash-set intersections.
+matrix B.  The user-pair kernel ``K[u,v] = w_u w_v / (alpha2 +
+|I_u ∩ I_v|)`` is accumulated in USER CHUNKS — each chunk builds only a
+(chunk, n_users) co-count slice, so memory stays O(chunk * n_users)
+instead of the full O(n_users^2) kernel — and each item's similarity
+row is a ``lax.scan`` of MXU matmuls over the chunk, rather than the
+reference family's per-pair hash-set intersections.
 """
 
 from __future__ import annotations
 
+from functools import partial
 from typing import List
 
 import jax
@@ -110,28 +113,66 @@ class SwingParams(AlgoOperator):
         return self.set(SwingParams.BETA, value)
 
 
-@jax.jit
-def _swing_scores(B, alpha1, alpha2, beta):
+# user-chunk size for the pair kernel: memory is O(chunk * n_users)
+# instead of the full O(n_users^2) K matrix, so user counts in the 10^5+
+# range stay well under HBM while each chunk is still MXU-sized work
+_USER_CHUNK = 2048
+
+
+@partial(jax.jit, static_argnums=(4,))
+def _swing_scores(B, alpha1, alpha2, beta, user_chunk=_USER_CHUNK):
     """(n_users, n_items) binary matrix -> (n_items, n_items) Swing
     similarity.  Unordered user pairs: ordered-sum / 2 with a zeroed
-    kernel diagonal."""
+    kernel diagonal.
+
+    The user-pair kernel ``K[u, v] = w_u w_v / (alpha2 + |I_u ∩ I_v|)``
+    is never materialised whole: ``S = Σ_chunks Mᶜᵀ (Kᶜ M)`` accumulates
+    over user chunks, where ``M[u, i] = B[u, i]`` masked per item — each
+    chunk needs only a (chunk, n_users) slice of co-counts."""
+    n_users, n_items = B.shape
+    # small inputs take one right-sized chunk instead of padding to the
+    # full default (B.shape is static at trace time)
+    user_chunk = min(user_chunk, n_users)
     counts = jnp.sum(B, axis=1)                         # |I_u|
     # zero-count users (filtered out) must carry zero weight — with
     # alpha1=0 their (0)**-beta would be inf and poison K via 0*inf=NaN
     w = jnp.where(counts > 0, (counts + alpha1) ** (-beta), 0.0)
-    uu = B @ B.T                                        # |I_u ∩ I_v|
-    # a user pair in U_i ∩ U_j always shares >= 2 items, so uu == 0 pairs
-    # contribute nothing; zeroing them also guards alpha2=0 division
-    K = jnp.where(uu > 0,
-                  (w[:, None] * w[None, :]) / (alpha2 + uu), 0.0)
-    K = K * (1.0 - jnp.eye(B.shape[0], dtype=B.dtype))  # exclude u == v
 
-    def per_item(_, b_i):
-        M = B * b_i[:, None]                            # users of item i
-        sim_i = jnp.sum(M * (K @ M), axis=0)            # (n_items,)
-        return None, sim_i
+    pad = (-n_users) % user_chunk
+    Bp = jnp.pad(B, ((0, pad), (0, 0)))
+    wp = jnp.pad(w, (0, pad))
+    n_chunks = Bp.shape[0] // user_chunk
+    Bc = Bp.reshape(n_chunks, user_chunk, n_items)
+    wc = wp.reshape(n_chunks, user_chunk)
+    offs = jnp.arange(n_chunks) * user_chunk
 
-    _, S = jax.lax.scan(per_item, None, B.T)
+    def per_chunk(acc, chunk):
+        Bi, wi, off = chunk                              # (c, n_items), (c,)
+        uu = Bi @ B.T                                    # (c, n_users)
+        # a user pair in U_i ∩ U_j always shares >= 2 items, so uu == 0
+        # pairs contribute nothing; zeroing also guards alpha2=0 division
+        K = jnp.where(uu > 0, (wi[:, None] * w[None, :]) / (alpha2 + uu),
+                      0.0)
+        # exclude u == v (the diagonal lives where global index matches)
+        cols = jnp.arange(n_users)[None, :]
+        rows = off + jnp.arange(user_chunk)[:, None]
+        K = jnp.where(rows == cols, 0.0, K)
+
+        def per_item(_, b_i_padded):
+            # b_i over padded users: static head = all users, dynamic
+            # window = this chunk's users of item i
+            b_i = b_i_padded[:n_users]
+            Mv = B * b_i[:, None]                        # (n_users, items)
+            KM = K @ Mv                                  # (c, items)
+            chunk_b = jax.lax.dynamic_slice_in_dim(b_i_padded, off,
+                                                   user_chunk)
+            return None, jnp.sum(chunk_b[:, None] * Bi * KM, axis=0)
+
+        _, Sc = jax.lax.scan(per_item, None, Bp.T)       # (items, items)
+        return acc + Sc, None
+
+    S0 = jnp.zeros((n_items, n_items), B.dtype)
+    S, _ = jax.lax.scan(per_chunk, S0, (Bc, wc, offs))
     return S / 2.0
 
 

@@ -198,3 +198,21 @@ def test_swing_no_common_users_no_similarity():
     out = Swing().set_min_user_behavior(1).transform(t)[0]
     assert out["similar_items"][0] == []
     assert out["similar_items"][1] == []
+
+
+def test_swing_chunked_kernel_equals_unchunked():
+    """The user-chunked pair kernel must give identical scores whatever
+    the chunk size (incl. non-dividing chunks that pad)."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.models.recommendation.swing import _swing_scores
+
+    rng = np.random.default_rng(3)
+    B = jnp.asarray((rng.random((37, 6)) < 0.3).astype(np.float32))
+    full = _swing_scores(B, jnp.float32(15), jnp.float32(0),
+                         jnp.float32(0.3), 64)     # one chunk
+    for chunk in (4, 16, 37):
+        part = _swing_scores(B, jnp.float32(15), jnp.float32(0),
+                             jnp.float32(0.3), chunk)
+        np.testing.assert_allclose(np.asarray(part), np.asarray(full),
+                                   rtol=1e-5, atol=1e-7)
