@@ -24,6 +24,27 @@ NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _MAKE_RAN = False
 
 
+# The lock lives OUTSIDE native/build: `make clean` rm -rf's build/, and
+# unlinking a held lock file would let a second process lock a fresh inode
+# and compile concurrently — the exact race the lock prevents.
+_LOCK_PATH = os.path.join(NATIVE_DIR, ".make.lock")
+
+
+def _run_make_locked() -> None:
+    """make under an exclusive file lock: concurrent processes (the
+    multi-host workers, parallel test runs) must not race two compilers
+    onto the same .so — the loser would dlopen a half-written library."""
+    import fcntl
+
+    with open(_LOCK_PATH, "w") as lock_file:
+        fcntl.flock(lock_file, fcntl.LOCK_EX)
+        try:
+            subprocess.run(["make", "-C", NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
+        finally:
+            fcntl.flock(lock_file, fcntl.LOCK_UN)
+
+
 def load_native_lib(lib_name: str) -> Optional[ctypes.CDLL]:
     """Build (if needed) and load ``native/build/lib{lib_name}.so``;
     ``None`` means no native path (caller falls back).  Callers cache the
@@ -36,12 +57,21 @@ def load_native_lib(lib_name: str) -> Optional[ctypes.CDLL]:
                                                      "Makefile")):
         _MAKE_RAN = True
         try:
-            subprocess.run(["make", "-C", NATIVE_DIR], check=True,
-                           capture_output=True, timeout=120)
+            _run_make_locked()
         except Exception:
             if not os.path.exists(so_path):
                 return None
     try:
-        return ctypes.CDLL(so_path)
+        # shared lock around dlopen: a concurrent process rebuilding the
+        # library (exclusive lock) writes -o straight onto this path, and
+        # loading mid-write would tear the mapping
+        import fcntl
+
+        with open(_LOCK_PATH, "w") as lock_file:
+            fcntl.flock(lock_file, fcntl.LOCK_SH)
+            try:
+                return ctypes.CDLL(so_path)
+            finally:
+                fcntl.flock(lock_file, fcntl.LOCK_UN)
     except OSError:
         return None

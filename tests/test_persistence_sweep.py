@@ -19,7 +19,9 @@ from flink_ml_tpu.models import classification as C
 from flink_ml_tpu.models import clustering as CL
 from flink_ml_tpu.models import feature as F
 from flink_ml_tpu.models import recommendation as REC
+from flink_ml_tpu.models import evaluation as E
 from flink_ml_tpu.models import regression as R
+from flink_ml_tpu.models import stats as S
 
 # Every factory seeds its own generator: test data is identical whether a
 # case runs in the full sweep, in isolation, or on an xdist worker.
@@ -262,3 +264,78 @@ def test_estimator_model_save_load_roundtrip(name, factory, table_fn,
                 and np.isnan(v1) and np.isnan(v2):
             continue
         assert v1 == v2, (key, v1, v2)
+
+
+# -- AlgoOperators with analytic outputs: save/load the stage and the
+#    transform result must be identical (params-only persistence)
+
+def _labeled_table():
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(40, 3))
+    return Table({"features": X,
+                  "label": rng.integers(0, 2, size=40).astype(np.float64),
+                  "clabel": (X[:, 0] + rng.normal(size=40))})
+
+
+def _ranked_table():
+    p = np.empty(3, object)
+    r = np.empty(3, object)
+    for i in range(3):
+        p[i] = ["a", "b", "c"]
+        r[i] = ["a", "x"]
+    return Table({"prediction": p, "label": r})
+
+
+def _cat_table():
+    rng = np.random.default_rng(9)
+    return Table({"features": rng.integers(0, 3, size=(40, 2))
+                  .astype(np.float64),
+                  "label": rng.integers(0, 2, size=40)})
+
+
+def _scored_table():
+    rng = np.random.default_rng(11)
+    y = rng.integers(0, 3, size=40).astype(np.float64)
+    return Table({
+        "features": rng.normal(size=(40, 2)),
+        "label": y,
+        "prediction": np.where(rng.random(40) < 0.8, y,
+                               (y + 1) % 3).astype(np.float64),
+        "rawPrediction": rng.random(40),
+    })
+
+
+ALGO_CASES = [
+    ("ChiSqTest", lambda: S.ChiSqTest(), _cat_table),
+    ("ANOVATest", lambda: S.ANOVATest(), _labeled_table),
+    ("FValueTest", lambda: S.FValueTest().set_label_col("clabel"),
+     _labeled_table),
+    ("RankingEvaluator", lambda: E.RankingEvaluator().set_k(2),
+     _ranked_table),
+    ("BinaryClassificationEvaluator",
+     lambda: E.BinaryClassificationEvaluator().set_metrics(
+         "areaUnderROC", "accuracy"),
+     lambda: Table({"label": (np.random.default_rng(12)
+                              .random(40) < 0.5).astype(np.float64),
+                    "rawPrediction": np.random.default_rng(13)
+                    .random(40)})),
+    ("MulticlassClassificationEvaluator",
+     lambda: E.MulticlassClassificationEvaluator(), _scored_table),
+    ("RegressionEvaluator",
+     lambda: E.RegressionEvaluator(), _scored_table),
+    ("ClusteringEvaluator",
+     lambda: E.ClusteringEvaluator(), _scored_table),
+]
+
+
+@pytest.mark.parametrize("name,factory,table_fn", ALGO_CASES,
+                         ids=[c[0] for c in ALGO_CASES])
+def test_algo_operator_save_load_roundtrip(name, factory, table_fn,
+                                           tmp_path):
+    op = factory()
+    table = table_fn()
+    before = op.transform(table)[0]
+    path = str(tmp_path / name)
+    op.save(path)
+    loaded = type(op).load(path)
+    _tables_equal(before, loaded.transform(table)[0])
