@@ -23,6 +23,7 @@ from .lsh import (  # noqa: F401
     MinHashLSH,
     MinHashLSHModel,
 )
+from .pca import PCA, PCAModel  # noqa: F401
 from .randomsplitter import RandomSplitter  # noqa: F401
 from .sqltransformer import SQLTransformer  # noqa: F401
 from .selectors import (  # noqa: F401

@@ -1,0 +1,154 @@
+"""PCA — principal component analysis, TPU-native.
+
+A universally expected member of the feature surface (the reference
+family's broader ecosystem ships it; the snapshot's lib is KMeans-only —
+SURVEY §2.8).  Estimator/Model pair: fit computes the covariance as ONE
+``X^T X`` MXU matmul over the centered batch plus a (d, d) device
+``eigh`` (symmetric eigendecomposition — d is feature count, small);
+transform is one projection matmul.  Components carry a deterministic
+sign (largest-|loading| coordinate positive) so refits and reloads score
+identically.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api.stage import Estimator, Model
+from ...data.table import Table
+from ...linalg import stack_vectors
+from ...params.param import IntParam, ParamValidators
+from ...utils import persist
+from .transforms import _InOutParams
+
+__all__ = ["PCA", "PCAModel"]
+
+
+class PCAParams(_InOutParams):
+    K = IntParam("k", "Number of principal components.", default=2,
+                 validator=ParamValidators.gt(0))
+
+    def get_k(self) -> int:
+        return self.get(PCAParams.K)
+
+    def set_k(self, value: int):
+        return self.set(PCAParams.K, value)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _fit_pca(X, k):
+    """Centered covariance -> top-k eigenvectors (descending variance)."""
+    n = X.shape[0]
+    mean = jnp.mean(X, axis=0)
+    Xc = X - mean[None, :]
+    cov = (Xc.T @ Xc) / jnp.maximum(n - 1, 1)          # (d, d) MXU
+    eigvals, eigvecs = jnp.linalg.eigh(cov)            # ascending
+    order = jnp.argsort(-eigvals)[:k]
+    components = eigvecs[:, order].T                   # (k, d)
+    variances = jnp.maximum(eigvals[order], 0.0)
+    # deterministic sign: the largest-|loading| coordinate is positive
+    pivot = jnp.argmax(jnp.abs(components), axis=1)
+    signs = jnp.sign(jnp.take_along_axis(components, pivot[:, None],
+                                         axis=1))
+    components = components * jnp.where(signs == 0, 1.0, signs)
+    total = jnp.maximum(jnp.sum(jnp.maximum(eigvals, 0.0)), 1e-30)
+    return mean, components, variances, variances / total
+
+
+@jax.jit
+def _project(X, mean, components):
+    return (X - mean[None, :]) @ components.T
+
+
+class PCAModel(PCAParams, Model):
+    """Holds (mean, components (k, d), explained variance [ratio])."""
+
+    def __init__(self):
+        super().__init__()
+        self._mean: Optional[np.ndarray] = None
+        self._components: Optional[np.ndarray] = None
+        self._variance: Optional[np.ndarray] = None
+        self._variance_ratio: Optional[np.ndarray] = None
+
+    def set_model_data(self, *inputs) -> "PCAModel":
+        (t,) = inputs
+        # single-row layout (each cell holds the whole array), matching
+        # the KMeansModel convention — Table requires equal row counts
+        self._mean = np.asarray(t["mean"][0], np.float64)
+        self._components = np.asarray(t["components"][0], np.float64)
+        self._variance = np.asarray(t["explainedVariance"][0], np.float64)
+        self._variance_ratio = np.asarray(
+            t["explainedVarianceRatio"][0], np.float64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        self._require_model()
+        return [Table({
+            "mean": self._mean[None, :],
+            "components": self._components[None, :, :],
+            "explainedVariance": self._variance[None, :],
+            "explainedVarianceRatio": self._variance_ratio[None, :],
+        })]
+
+    @property
+    def explained_variance_ratio(self) -> np.ndarray:
+        self._require_model()
+        return self._variance_ratio.copy()
+
+    def _require_model(self) -> None:
+        if self._components is None:
+            raise RuntimeError("PCAModel has no model data; fit a PCA or "
+                               "call set_model_data first")
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        self._require_model()
+        X = stack_vectors(table[self.get_features_col()])
+        out = np.asarray(_project(
+            jnp.asarray(X, jnp.float32),
+            jnp.asarray(self._mean, jnp.float32),
+            jnp.asarray(self._components, jnp.float32)), np.float64)
+        return [table.with_column(self.get_output_col(), out)]
+
+    def save(self, path: str) -> None:
+        self._require_model()
+        persist.save_metadata(self, path)
+        persist.save_model_arrays(path, "model", {
+            "mean": self._mean, "components": self._components,
+            "explainedVariance": self._variance,
+            "explainedVarianceRatio": self._variance_ratio,
+        })
+
+    @classmethod
+    def load(cls, path: str) -> "PCAModel":
+        model = persist.load_stage_param(path)
+        data = persist.load_model_arrays(path, "model")
+        model._mean = data["mean"].astype(np.float64)
+        model._components = data["components"].astype(np.float64)
+        model._variance = data["explainedVariance"].astype(np.float64)
+        model._variance_ratio = data["explainedVarianceRatio"].astype(
+            np.float64)
+        return model
+
+
+class PCA(PCAParams, Estimator[PCAModel]):
+    def fit(self, *inputs) -> PCAModel:
+        (table,) = inputs
+        X = stack_vectors(table[self.get_features_col()]).astype(np.float32)
+        k = self.get_k()
+        if k > X.shape[1]:
+            raise ValueError(
+                f"k={k} exceeds the feature dimension {X.shape[1]}")
+        mean, components, variance, ratio = _fit_pca(jnp.asarray(X), k)
+        model = PCAModel()
+        model.copy_params_from(self)
+        model._mean = np.asarray(mean, np.float64)
+        model._components = np.asarray(components, np.float64)
+        model._variance = np.asarray(variance, np.float64)
+        model._variance_ratio = np.asarray(ratio, np.float64)
+        return model

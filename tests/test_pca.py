@@ -1,0 +1,98 @@
+"""PCA — oracle vs numpy SVD, variance ordering, persistence."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature import PCA, PCAModel
+
+
+def _t(X):
+    return Table({"features": np.asarray(X, np.float64)})
+
+
+def _anisotropic(rng, n=500):
+    """Data with a known dominant direction."""
+    base = rng.normal(size=(n, 3)) * np.asarray([5.0, 1.0, 0.2])
+    rot, _ = np.linalg.qr(rng.normal(size=(3, 3)))
+    return base @ rot.T, rot
+
+
+def test_components_match_numpy_svd_oracle():
+    rng = np.random.default_rng(0)
+    X, _ = _anisotropic(rng)
+    model = PCA().set_k(3).fit(_t(X))
+
+    Xc = X - X.mean(axis=0)
+    _, _, vt = np.linalg.svd(Xc, full_matrices=False)
+    for row, oracle in zip(model._components, vt):
+        # eigenvectors match up to sign
+        assert min(np.abs(row - oracle).max(),
+                   np.abs(row + oracle).max()) < 1e-4
+
+
+def test_explained_variance_ordering_and_ratio():
+    rng = np.random.default_rng(1)
+    X, _ = _anisotropic(rng)
+    model = PCA().set_k(3).fit(_t(X))
+    v = model._variance
+    assert v[0] > v[1] > v[2] > 0
+    ratio = model.explained_variance_ratio
+    np.testing.assert_allclose(ratio.sum(), 1.0, atol=1e-5)
+    assert ratio[0] > 0.8        # the 5x direction dominates
+
+
+def test_projection_decorrelates_and_centers():
+    rng = np.random.default_rng(2)
+    X, _ = _anisotropic(rng)
+    out = np.asarray(PCA().set_k(2).fit(_t(X)).transform(_t(X))[0]["output"])
+    assert out.shape == (len(X), 2)
+    np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-3)
+    corr = np.corrcoef(out.T)
+    assert abs(corr[0, 1]) < 0.05
+
+
+def test_deterministic_sign_across_refits():
+    rng = np.random.default_rng(3)
+    X, _ = _anisotropic(rng)
+    a = PCA().set_k(2).fit(_t(X))._components
+    b = PCA().set_k(2).fit(_t(X))._components
+    np.testing.assert_array_equal(a, b)
+    # pivot coordinate positive
+    for row in a:
+        assert row[np.argmax(np.abs(row))] > 0
+
+
+def test_k_validation():
+    with pytest.raises(ValueError, match="exceeds"):
+        PCA().set_k(5).fit(_t(np.zeros((4, 3))))
+    with pytest.raises(ValueError, match="invalid value"):
+        PCA().set_k(0)
+
+
+def test_save_load_roundtrip(tmp_path):
+    rng = np.random.default_rng(4)
+    X, _ = _anisotropic(rng)
+    model = PCA().set_k(2).fit(_t(X))
+    before = np.asarray(model.transform(_t(X))[0]["output"])
+    path = str(tmp_path / "pca")
+    model.save(path)
+    loaded = PCAModel.load(path)
+    np.testing.assert_allclose(
+        np.asarray(loaded.transform(_t(X))[0]["output"]), before,
+        atol=1e-6)
+    np.testing.assert_allclose(loaded.explained_variance_ratio,
+                               model.explained_variance_ratio)
+
+
+def test_model_data_roundtrip():
+    """The generic set_model_data(*get_model_data()) contract every
+    sibling model honors."""
+    rng = np.random.default_rng(5)
+    X, _ = _anisotropic(rng)
+    model = PCA().set_k(2).fit(_t(X))
+    clone = PCAModel().set_model_data(*model.get_model_data())
+    clone.copy_params_from(model)
+    np.testing.assert_allclose(
+        np.asarray(clone.transform(_t(X))[0]["output"]),
+        np.asarray(model.transform(_t(X))[0]["output"]), atol=1e-6)

@@ -141,6 +141,7 @@ TRANSFORMER_CASES = [
 # the MODEL, compare transform output.
 ESTIMATOR_CASES = [
     ("Imputer", lambda: F.Imputer(), _num_table, F.ImputerModel),
+    ("PCA", lambda: F.PCA().set_k(2), _num_table, F.PCAModel),
     ("KBinsDiscretizer", lambda: F.KBinsDiscretizer().set_num_bins(3),
      _num_table, F.KBinsDiscretizerModel),
     ("VectorIndexer", lambda: F.VectorIndexer().set_max_categories(50),
