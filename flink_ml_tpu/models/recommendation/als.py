@@ -265,6 +265,73 @@ class ALSModel(ALSModelParams, Model):
         return [table.with_column(self.get_prediction_col(),
                                   preds.astype(np.float64))]
 
+    def recommend_for_users(self, users, k: int,
+                            exclude: Optional[Table] = None) -> Table:
+        """Top-k items per user: ONE ``U_sel @ V.T`` MXU matmul scores
+        everything, then a host ``argpartition`` (O(items), not a full
+        sort) ranks the k winners — the producer shape
+        ``RankingEvaluator`` consumes (each output cell is that user's
+        ranked item-id list).
+
+        ``exclude`` optionally REMOVES already-seen (user, item) pairs
+        (the usual train-interaction filter) given as a Table carrying
+        this model's user/item columns; a user with fewer than k
+        non-excluded items gets a shorter list.  Unknown user ids
+        raise."""
+        self._require_model()
+        if k <= 0:
+            raise ValueError(f"k must be positive, got {k}")
+        k = min(k, len(self._item_ids))
+        users = np.asarray(users)
+        u_idx, known = self._lookup(users, self._user_ids)
+        if not known.all():
+            raise ValueError(
+                f"unknown user id {users[~known][0]!r}; recommendations "
+                "need users seen at fit time")
+
+        # np.array (copy): the device result is read-only and the exclude
+        # mask writes -inf in place
+        scores = np.array(
+            jnp.asarray(self._user_factors)[jnp.asarray(u_idx)]
+            @ jnp.asarray(self._item_factors).T)
+        if exclude is not None:
+            eu_idx, eu_known = self._lookup(
+                np.asarray(exclude[self.get_user_col()]), self._user_ids)
+            ei_idx, ei_known = self._lookup(
+                np.asarray(exclude[self.get_item_col()]), self._item_ids)
+            valid = eu_known & ei_known
+            eu, ei = eu_idx[valid], ei_idx[valid]
+            # vectorized (pair -> request rows) expansion: request rows
+            # sorted by user, each exclude pair covers its searchsorted
+            # range (the ragged-range trick — no per-pair Python loop)
+            order = np.argsort(u_idx, kind="stable")
+            su = u_idx[order]
+            left = np.searchsorted(su, eu, side="left")
+            right = np.searchsorted(su, eu, side="right")
+            counts = right - left
+            total = int(counts.sum())
+            if total:
+                starts = np.repeat(left, counts)
+                offsets = np.arange(total) - np.repeat(
+                    np.cumsum(counts) - counts, counts)
+                rows = order[starts + offsets]
+                scores[rows, np.repeat(ei, counts)] = -np.inf
+
+        part = np.argpartition(-scores, kth=k - 1, axis=1)[:, :k]
+        part_scores = np.take_along_axis(scores, part, axis=1)
+        rank = np.argsort(-part_scores, axis=1, kind="stable")
+        top = np.take_along_axis(part, rank, axis=1)
+        top_scores = np.take_along_axis(part_scores, rank, axis=1)
+
+        recs = np.empty(len(users), object)
+        rec_scores = np.empty(len(users), object)
+        for r in range(len(users)):
+            keep = ~np.isneginf(top_scores[r])   # drop excluded items
+            recs[r] = list(self._item_ids[top[r][keep]])
+            rec_scores[r] = [float(s) for s in top_scores[r][keep]]
+        return Table({self.get_user_col(): users,
+                      "recommendations": recs, "scores": rec_scores})
+
     def save(self, path: str) -> None:
         self._require_model()
         persist.save_metadata(self, path)

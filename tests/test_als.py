@@ -183,3 +183,50 @@ def test_implicit_fractional_weights_consistent():
                      jnp.asarray([2.0, 1.0, 1.0], jnp.float32), 2, 0.1,
                      True, 2.0)
     np.testing.assert_allclose(np.asarray(dup), np.asarray(wt), atol=1e-5)
+
+
+def test_recommend_for_users_topk_and_exclude():
+    """recommend_for_users: matmul top-k, train-pair exclusion, and the
+    RankingEvaluator-consumable output shape."""
+    users = np.repeat(np.arange(8), 5)
+    items = np.tile(np.arange(5), 8)
+    # user u loves item u % 5 (rating 5), others 1
+    ratings = np.where(items == (users % 5), 5.0, 1.0)
+    t = Table({"user": users, "item": items, "rating": ratings})
+    model = (ALS().set_rank(4).set_max_iter(10).set_reg_param(0.05)
+             .fit(t))
+
+    recs = model.recommend_for_users(np.arange(8), k=2)
+    assert recs.num_rows == 8
+    for u in range(8):
+        top = recs["recommendations"][u]
+        assert len(top) == 2
+        # rank-4 factorization is approximate: the loved item must at
+        # least make the top 2, and scores come back ranked
+        assert (u % 5) in top
+        scores = recs["scores"][u]
+        assert scores[0] >= scores[1]
+
+    # every user rated ALL 5 items, so excluding the training
+    # interactions leaves nothing to recommend: lists come back EMPTY
+    # (excluded items are removed, never padded back in)
+    excl = model.recommend_for_users(np.arange(8), k=5, exclude=t)
+    for u in range(8):
+        assert excl["recommendations"][u] == []
+        assert excl["scores"][u] == []
+
+    # partial exclusion: drop only item (u % 5); it must vanish from the
+    # list while the rest stay ranked
+    part = model.recommend_for_users(
+        np.arange(8), k=5,
+        exclude=Table({"user": np.arange(8), "item": np.arange(8) % 5}))
+    for u in range(8):
+        got = part["recommendations"][u]
+        assert len(got) == 4 and (u % 5) not in got
+        s = part["scores"][u]
+        assert all(s[i] >= s[i + 1] for i in range(len(s) - 1))
+
+    with pytest.raises(ValueError, match="unknown user"):
+        model.recommend_for_users([999], k=1)
+    with pytest.raises(ValueError, match="positive"):
+        model.recommend_for_users([0], k=0)
