@@ -19,7 +19,8 @@ hash the empty token, giving each field a stable "missing" slot.
 from __future__ import annotations
 
 import ctypes
-from typing import Dict, Iterator, Optional, Tuple
+import os
+from typing import Dict, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -134,22 +135,30 @@ def parse_chunk(data: bytes, max_rows: int, hash_space: int,
 
 
 class CriteoTSVReader:
-    """Iterator of mixed-layout batch dicts over a Criteo TSV file:
-    ``{"{col}_dense": (b, 13) f32, "{col}_indices": (b, 26) int32,
-    "label": (b,) f32}`` — exactly what ``fit_outofcore(mixed=True)``
-    and ``DataCacheWriter.append`` take.  Construct a fresh reader per
-    epoch (the ``make_reader`` protocol).
+    """Iterator of mixed-layout batch dicts over one Criteo TSV file or a
+    SEQUENCE of files (the Criteo-1TB corpus is day_0..day_23; they
+    stream back-to-back in the given order, batches crossing file
+    boundaries): ``{"{col}_dense": (b, 13) f32, "{col}_indices": (b, 26)
+    int32, "label": (b,) f32}`` — exactly what
+    ``fit_outofcore(mixed=True)`` and ``DataCacheWriter.append`` take.
+    Construct a fresh reader per epoch (the ``make_reader`` protocol).
 
     ``num_features`` for the downstream trainer is
     ``n_reserved + hash_space``.
     """
 
-    def __init__(self, path: str, batch_rows: int, hash_space: int,
+    def __init__(self, path: "str | bytes | os.PathLike | Sequence[str]",
+                 batch_rows: int, hash_space: int,
                  n_reserved: int = N_DENSE, features_col: str = "features",
                  label_col: str = "label", chunk_bytes: int = 1 << 20):
         if batch_rows <= 0:
             raise ValueError(f"batch_rows must be positive: {batch_rows}")
-        self.path = path
+        # one path or a sequence (the Criteo-1TB corpus is day_0..day_23
+        # files; they stream back-to-back in the given order)
+        self.paths = ([path] if isinstance(path, (str, bytes, os.PathLike))
+                      else list(path))
+        if not self.paths:
+            raise ValueError("need at least one path")
         self.batch_rows = batch_rows
         self.hash_space = hash_space
         self.n_reserved = n_reserved
@@ -162,8 +171,13 @@ class CriteoTSVReader:
         return self.n_reserved + self.hash_space
 
     def _rows(self) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        for path in self.paths:
+            yield from self._file_rows(path)
+
+    def _file_rows(self, path
+                   ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
         tail = b""
-        with open(self.path, "rb") as f:
+        with open(path, "rb") as f:
             while True:
                 chunk = f.read(self.chunk_bytes)
                 if not chunk:

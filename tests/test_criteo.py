@@ -220,3 +220,29 @@ def test_tsv_to_datacache_to_outofcore_replay(tmp_path):
     np.testing.assert_allclose(cached._state.coefficients,
                                direct._state.coefficients, atol=1e-6)
     assert cached.loss_log[-1] < cached.loss_log[0]
+
+
+def test_reader_streams_multiple_files(tmp_path):
+    """The Criteo-1TB layout is day_0..day_N files; a path list streams
+    them back-to-back with batches crossing file boundaries."""
+    rng = np.random.default_rng(7)
+    p1, p2 = tmp_path / "day_0.tsv", tmp_path / "day_1.tsv"
+    _make_tsv(p1, 20, rng)
+    _make_tsv(p2, 13, rng)
+
+    multi = list(CriteoTSVReader([str(p1), str(p2)], batch_rows=8,
+                                 hash_space=64))
+    assert sum(len(b["label"]) for b in multi) == 33
+    # batch 2 straddles the file boundary (rows 16..23 span 20-row file 1)
+    straddle = multi[2]
+    assert len(straddle["label"]) == 8
+
+    # concatenating per-file reads gives the identical stream
+    single = list(CriteoTSVReader(str(p1), batch_rows=8, hash_space=64)) + \
+        list(CriteoTSVReader(str(p2), batch_rows=8, hash_space=64))
+    cat_multi = np.concatenate([b["features_indices"] for b in multi])
+    cat_single = np.concatenate([b["features_indices"] for b in single])
+    np.testing.assert_array_equal(cat_multi, cat_single)
+
+    with pytest.raises(ValueError, match="at least one"):
+        CriteoTSVReader([], batch_rows=8, hash_space=64)
