@@ -99,17 +99,41 @@ class StringIndexerModel(_ColsParams, Model):
 
 
 class StringIndexer(_ColsParams, Estimator[StringIndexerModel]):
-    """Vocabulary = distinct values by descending frequency (ties by value),
-    the common StringIndexer ordering."""
+    """Vocabulary ordering follows ``stringOrderType`` (the Flink ML
+    StringIndexer param): frequencyDesc (default; ties by value
+    ascending), frequencyAsc, alphabetAsc, alphabetDesc."""
+
+    STRING_ORDER_TYPE = StringParam(
+        "stringOrderType",
+        "frequencyDesc | frequencyAsc | alphabetAsc | alphabetDesc.",
+        default="frequencyDesc",
+        validator=lambda v: v in ("frequencyDesc", "frequencyAsc",
+                                  "alphabetAsc", "alphabetDesc"))
+
+    def get_string_order_type(self) -> str:
+        return self.get(StringIndexer.STRING_ORDER_TYPE)
+
+    def set_string_order_type(self, value: str):
+        return self.set(StringIndexer.STRING_ORDER_TYPE, value)
 
     def fit(self, *inputs) -> StringIndexerModel:
         (table,) = inputs
         in_cols, _ = _check_cols(self)
+        order_type = self.get_string_order_type()
         model = StringIndexerModel()
         model.copy_params_from(self)
         for col in in_cols:
+            # np.unique returns values already ascending-sorted, so the
+            # alphabet orders are identity / reverse
             values, counts = np.unique(table[col], return_counts=True)
-            order = np.lexsort((values, -counts))
+            if order_type == "frequencyDesc":
+                order = np.lexsort((values, -counts))
+            elif order_type == "frequencyAsc":
+                order = np.lexsort((values, counts))
+            elif order_type == "alphabetAsc":
+                order = np.arange(len(values))
+            else:                                   # alphabetDesc
+                order = np.arange(len(values))[::-1]
             model._vocab[col] = [values[i].item() if hasattr(values[i], "item")
                                  else values[i] for i in order]
         return model

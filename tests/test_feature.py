@@ -218,3 +218,21 @@ def test_string_indexer_no_truncation():
     with pytest.raises(ValueError):
         (model.set("handleInvalid", "error")
          .transform(Table.from_rows([("cats",)], ["w"])))
+
+
+def test_string_indexer_order_types():
+    """The four stringOrderType orderings (the Flink ML param)."""
+    t = Table({"c": np.asarray(["b", "a", "b", "c", "b", "a"], dtype=object)})
+
+    def vocab(order):
+        m = (StringIndexer().set_input_cols("c").set_output_cols("i")
+             .set_string_order_type(order).fit(t))
+        return m._vocab["c"]
+
+    assert vocab("frequencyDesc") == ["b", "a", "c"]   # 3, 2, 1
+    assert vocab("frequencyAsc") == ["c", "a", "b"]
+    assert vocab("alphabetAsc") == ["a", "b", "c"]
+    assert vocab("alphabetDesc") == ["c", "b", "a"]
+
+    with pytest.raises(ValueError):
+        StringIndexer().set_string_order_type("nope")
