@@ -30,7 +30,7 @@ from ...params.param import (
     ParamValidators,
     StringParam,
 )
-from ...params.shared import HasInputCols, HasOutputCol
+from ...params.shared import HasInputCols, HasOutputCol, HasSeed
 from ...utils import persist
 from .transforms import _InOutParams, _SimpleTransformer
 
@@ -176,7 +176,7 @@ def _dct_apply(X, C, inverse):
 # KBinsDiscretizer
 # ---------------------------------------------------------------------------
 
-class KBinsDiscretizerParams(_InOutParams):
+class KBinsDiscretizerParams(_InOutParams, HasSeed):
     NUM_BINS = IntParam("numBins", "Number of bins per column.", default=5,
                         validator=ParamValidators.gt_eq(2))
     STRATEGY = StringParam(
@@ -294,20 +294,23 @@ class KBinsDiscretizer(KBinsDiscretizerParams,
         X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
         sub = self.get_sub_samples()
         if 0 < sub < X.shape[0]:
-            sel = np.random.default_rng(0).choice(X.shape[0], sub,
-                                                  replace=False)
+            sel = np.random.default_rng(self.get_seed()).choice(
+                X.shape[0], sub, replace=False)
             X = X[sel]
         k = self.get_num_bins()
         strategy = self.get_strategy()
         per_col: List[np.ndarray] = []
         for j in range(X.shape[1]):
             col = X[:, j]
-            if strategy == "uniform":
+            if col.min() == col.max():
+                # constant column: one [min, min+1) bin for EVERY strategy
+                # (uniform's linspace would yield k+1 identical edges and
+                # searchsorted would bucket everything into bin k-1)
+                edges = np.array([col.min(), col.max() + 1.0])
+            elif strategy == "uniform":
                 edges = np.linspace(col.min(), col.max(), k + 1)
             elif strategy == "quantile":
                 edges = np.unique(np.quantile(col, np.linspace(0, 1, k + 1)))
-                if len(edges) < 2:   # constant column: single degenerate bin
-                    edges = np.array([col.min(), col.max() + 1.0])
             else:
                 edges = _kmeans_1d_edges(col, k)
             per_col.append(edges)

@@ -31,12 +31,13 @@ import numpy as np
 from ...api.stage import AlgoOperator
 from ...data.table import Table
 from ...params.param import FloatParam, IntParam, ParamValidators
+from ...params.shared import HasSeed
 from .als import ALSModelParams
 
 __all__ = ["Swing"]
 
 
-class SwingParams(AlgoOperator):
+class SwingParams(AlgoOperator, HasSeed):
     USER_COL = ALSModelParams.USER_COL
     ITEM_COL = ALSModelParams.ITEM_COL
     K = IntParam("k", "Max similar items per item.", default=100,
@@ -128,7 +129,15 @@ def _swing_scores(B, alpha1, alpha2, beta, user_chunk=_USER_CHUNK):
     The user-pair kernel ``K[u, v] = w_u w_v / (alpha2 + |I_u ∩ I_v|)``
     is never materialised whole: ``S = Σ_chunks Mᶜᵀ (Kᶜ M)`` accumulates
     over user chunks, where ``M[u, i] = B[u, i]`` masked per item — each
-    chunk needs only a (chunk, n_users) slice of co-counts."""
+    chunk needs only a (chunk, n_users) slice of co-counts.
+
+    Compute scaling: the per-item ``K @ Mv`` inside the chunk scan makes
+    the total FLOPs ``O(n_users^2 * n_items^2)`` — the chunking bounds
+    MEMORY, not compute.  Practical reach on one v5e chip is therefore
+    ~10^4 users x ~10^3 items (minutes); the documented 10^5-user range
+    needs ``maxUserNumPerItem`` to thin B first (which is exactly its
+    purpose).  A compute-bounded reformulation (accumulating via masked
+    three-way products per item-pair block) is future work."""
     n_users, n_items = B.shape
     # small inputs take one right-sized chunk instead of padding to the
     # full default (B.shape is static at trace time)
@@ -196,7 +205,7 @@ class Swing(SwingParams):
 
         # per-item user-count cap: deterministic seeded subsample
         cap = self.get_max_user_num_per_item()
-        rng = np.random.default_rng(0)
+        rng = np.random.default_rng(self.get_seed())
         for j in range(n_items):
             users_j = np.flatnonzero(B[:, j])
             if len(users_j) > cap:

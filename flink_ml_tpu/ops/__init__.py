@@ -1,3 +1,9 @@
+from .ell_scatter import (  # noqa: F401
+    EllLayout,
+    ell_layout,
+    ell_layout_device,
+    ell_scatter_apply,
+)
 from .kmeans_pallas import (  # noqa: F401
     kmeans_assign_reduce,
     kmeans_update_stats,

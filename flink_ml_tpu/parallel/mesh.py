@@ -171,11 +171,23 @@ def mesh_process_count(mesh: Mesh) -> int:
 
 def axis_process_count(mesh: Mesh, axis: str) -> int:
     """Distinct processes along ONE mesh axis (an axis laid out entirely
-    within each host counts 1 even on a multi-host mesh)."""
+    within each host counts 1 even on a multi-host mesh).
+
+    Every line along the axis must cross the same number of processes —
+    sampling one line on an irregular layout would mis-size per-process
+    padding and surface later as an opaque collective/shape error, so
+    irregularity raises here instead."""
     ax = list(mesh.axis_names).index(axis)
     devs = np.moveaxis(np.asarray(mesh.devices), ax, 0)
-    line = devs.reshape(devs.shape[0], -1)[:, 0]
-    return len({d.process_index for d in line})
+    lines = devs.reshape(devs.shape[0], -1)
+    counts = {len({d.process_index for d in lines[:, i]})
+              for i in range(lines.shape[1])}
+    if len(counts) > 1:
+        raise ValueError(
+            f"irregular process layout along mesh axis {axis!r}: lines "
+            f"cross {sorted(counts)} distinct processes; lay the mesh out "
+            "so every line along the axis spans the same process count")
+    return counts.pop()
 
 
 def local_axis_multiple(mesh: Mesh, axis: str = DATA_AXIS,

@@ -1,0 +1,179 @@
+"""ELL static-routing scatter (`ops/ell_scatter.py`) — the Pallas hot
+path behind the mixed-layout LR trainer.
+
+Tier-1 (CPU) coverage: layout construction (host + device builders must
+agree, overflow and heavy-hitter routing must be exact), the csum/pick
+math against a plain numpy scatter, and the full `_mixed_update_ell`
+step against the `_mixed_update` oracle.  The Mosaic kernel itself is
+compiled and parity-checked on real TPU by bench.py before anything is
+timed (same stance as the KMeans kernel, bench.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flink_ml_tpu.ops.ell_scatter import (
+    ELL_WIDTH,
+    ell_layout,
+    ell_layout_device,
+    ell_scatter_apply_xla,
+    supported,
+)
+
+
+def _scatter_reference(d, layout, r_ext, lr, step=0):
+    """Dense scatter the ELL + overflow routing back to a flat weight."""
+    w = np.zeros(d, np.float64)
+    src = np.asarray(layout.src[step])
+    pos = np.asarray(layout.pos[step])
+    mask = np.asarray(layout.mask[step])
+    rows = src.shape[0]
+    # reconstruct per-slot updates the kernel would apply
+    u = -lr * r_ext[src]
+    csum = np.cumsum(u, axis=1)
+    G = np.take_along_axis(csum, pos, axis=1) * mask
+    delta = G - np.concatenate([np.zeros((rows, 1)), G[:, :-1]], axis=1)
+    w += delta.reshape(-1)
+    np.add.at(w, np.asarray(layout.ovf_idx[step]),
+              -lr * r_ext[np.asarray(layout.ovf_src[step])])
+    return w
+
+
+def _direct_scatter(d, cat, r, lr):
+    w = np.zeros(d, np.float64)
+    np.add.at(w, cat.reshape(-1),
+              np.repeat(-lr * r, cat.shape[-1]))
+    return w
+
+
+class TestLayout:
+    def test_supported(self):
+        assert supported(1 << 20)
+        assert supported(128 * 128)
+        assert not supported(1000)       # not lane-divisible
+        assert not supported(128 * 64)   # too few rows
+
+    def test_routing_matches_direct_scatter(self):
+        rng = np.random.default_rng(0)
+        d, batch, nnz = 128 * 128, 64, 7
+        cat = rng.integers(0, d, size=(2, batch, nnz)).astype(np.int32)
+        r = rng.normal(size=batch).astype(np.float32)
+        layout = ell_layout(cat, d)
+        r_ext = np.concatenate([r, np.zeros(1, np.float32)])
+        for step in range(2):
+            got = _scatter_reference(d, layout, r_ext, 0.3, step)
+            want = _direct_scatter(d, cat[step], r, 0.3)
+            np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_heavy_hitter_overflows(self):
+        # one index receives every slot: ELL keeps 128, rest overflow
+        d, batch, nnz = 128 * 128, 300, 2
+        cat = np.full((1, batch, nnz), 777, np.int32)
+        r = np.ones(batch, np.float32)
+        layout = ell_layout(cat, d)
+        n_ovf = int((np.asarray(layout.ovf_src[0]) != batch).sum())
+        assert n_ovf == batch * nnz - ELL_WIDTH
+        r_ext = np.concatenate([r, np.zeros(1, np.float32)])
+        got = _scatter_reference(d, layout, r_ext, 1.0)
+        want = _direct_scatter(d, cat[0], r, 1.0)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_device_builder_agrees_with_host(self):
+        rng = np.random.default_rng(1)
+        d, batch, nnz = 128 * 128, 96, 5
+        cat = rng.integers(0, d, size=(3, batch, nnz)).astype(np.int32)
+        # include a heavy hitter to exercise the device overflow path
+        cat[:, :, 0] = 12345
+        host = ell_layout(cat, d)
+        dev = ell_layout_device(jnp.asarray(cat), d, ovf_cap=1024)
+        r = rng.normal(size=batch).astype(np.float32)
+        r_ext = np.concatenate([r, np.zeros(1, np.float32)])
+        for step in range(3):
+            got_h = _scatter_reference(d, host, r_ext, 0.5, step)
+            got_d = _scatter_reference(d, dev, r_ext, 0.5, step)
+            np.testing.assert_allclose(got_h, got_d, atol=1e-5)
+
+
+class TestApplyXla:
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(2)
+        d, batch, nnz = 128 * 128, 128, 9
+        cat = rng.integers(0, d, size=(1, batch, nnz)).astype(np.int32)
+        layout = ell_layout(cat, d)
+        r = rng.normal(size=batch).astype(np.float32)
+        r_ext = jnp.concatenate([jnp.asarray(r), jnp.zeros(1)])
+        u = -0.2 * np.asarray(r_ext)[np.asarray(layout.src[0])]
+        w0 = rng.normal(size=d).astype(np.float32)
+        got = np.asarray(ell_scatter_apply_xla(
+            jnp.asarray(w0), jnp.asarray(u), layout.pos[0],
+            layout.mask[0]))
+        want = w0.astype(np.float64) + _scatter_reference(
+            d, layout, np.asarray(r_ext), 0.2)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+class TestMixedUpdateEll:
+    def test_step_matches_xla_oracle(self):
+        from flink_ml_tpu.models.common.losses import logistic_loss
+        from flink_ml_tpu.models.common.sgd import (
+            SGDConfig, _mixed_update, _mixed_update_ell)
+
+        rng = np.random.default_rng(3)
+        d, batch, nnz, nd = 128 * 128, 64, 6, 4
+        dense = rng.normal(size=(batch, nd)).astype(np.float32)
+        cat = rng.integers(0, d, size=(1, batch, nnz)).astype(np.int32)
+        y = rng.integers(0, 2, size=batch).astype(np.float32)
+        wb = np.ones(batch, np.float32)
+        layout = ell_layout(cat, d)
+
+        for cfg in (SGDConfig(learning_rate=0.4, tol=0),
+                    SGDConfig(learning_rate=0.4, reg=0.05,
+                              elastic_net=0.3, tol=0)):
+            params = {"w": jnp.asarray(rng.normal(size=d), jnp.float32),
+                      "b": jnp.asarray(0.1, jnp.float32)}
+            oracle = _mixed_update(logistic_loss, cfg)
+            want, want_loss = oracle(params, jnp.asarray(dense),
+                                     jnp.asarray(cat[0]), jnp.asarray(y),
+                                     jnp.asarray(wb))
+            ell = _mixed_update_ell(logistic_loss, cfg, use_pallas=False)
+            got, got_loss = ell(params, jnp.asarray(dense),
+                                jnp.asarray(cat[0]), layout.src[0],
+                                layout.pos[0], layout.mask[0],
+                                layout.ovf_idx[0], layout.ovf_src[0],
+                                jnp.asarray(y), jnp.asarray(wb))
+            np.testing.assert_allclose(np.asarray(got_loss),
+                                       np.asarray(want_loss), rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(got["w"]),
+                                       np.asarray(want["w"]), atol=1e-5)
+            np.testing.assert_allclose(np.asarray(got["b"]),
+                                       np.asarray(want["b"]), rtol=1e-5)
+
+    def test_sgd_fit_mixed_plans_xla_on_cpu(self):
+        from flink_ml_tpu.models.common.sgd import plan_mixed_impl
+        from flink_ml_tpu.parallel.mesh import default_mesh
+
+        assert plan_mixed_impl(1 << 20, default_mesh()) == "xla"
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="Mosaic kernel needs TPU")
+class TestApplyPallas:
+    def test_kernel_matches_xla_twin(self):
+        from flink_ml_tpu.ops.ell_scatter import ell_scatter_apply
+
+        rng = np.random.default_rng(4)
+        d = 128 * 128
+        rows = d // 128
+        u = rng.normal(size=(rows, 128)).astype(np.float32)
+        cat = rng.integers(0, d, size=(1, 64, 8)).astype(np.int32)
+        layout = ell_layout(cat, d)
+        w0 = rng.normal(size=d).astype(np.float32)
+        got = np.asarray(ell_scatter_apply(
+            jnp.asarray(w0), jnp.asarray(u), layout.pos[0],
+            layout.mask[0]))
+        want = np.asarray(ell_scatter_apply_xla(
+            jnp.asarray(w0), jnp.asarray(u), layout.pos[0],
+            layout.mask[0]))
+        np.testing.assert_allclose(got, want, atol=1e-4)

@@ -112,6 +112,29 @@ def test_kbins_quantile_collapses_duplicate_edges():
     assert out.max() < 5 and out.min() == 0
 
 
+def test_kbins_constant_column_single_bin_all_strategies():
+    # ADVICE r2: uniform on a constant column used to emit k+1 identical
+    # edges, bucketing every value into bin k-1 while quantile gave bin 0;
+    # all strategies now agree on the single-bin degenerate layout
+    X = np.concatenate([np.full((20, 1), 3.0), np.arange(20)[:, None]],
+                       axis=1)
+    for strategy in ("uniform", "quantile", "kmeans"):
+        model = (KBinsDiscretizer().set_num_bins(4).set_strategy(strategy)
+                 .fit(_t(X)))
+        out = np.asarray(model.transform(_t(X))[0]["output"])
+        assert np.all(out[:, 0] == 0), strategy
+        assert out[:, 1].max() > 0, strategy  # varying column still bins
+
+
+def test_kbins_seed_param_controls_subsample():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(5000, 1))
+    fits = [(KBinsDiscretizer().set_num_bins(4).set_sub_samples(100)
+             .set_seed(s).fit(_t(X)))._edges for s in (1, 1, 2)]
+    np.testing.assert_array_equal(fits[0], fits[1])   # reproducible
+    assert not np.array_equal(fits[0], fits[2])       # seed-sensitive
+
+
 def test_kbins_kmeans_separated_clusters():
     X = np.concatenate([np.full(10, 0.0), np.full(10, 5.0),
                         np.full(10, 10.0)])[:, None]
