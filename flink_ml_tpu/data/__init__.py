@@ -1,3 +1,4 @@
-from .prefetch import prefetch_to_device  # noqa: F401
+from .prefetch import PrefetchStats, prefetch_to_device  # noqa: F401
 from .stream import CountWindows, EventTimeWindows, windows_of  # noqa: F401
 from .table import Table  # noqa: F401
+from .wal import WindowLog  # noqa: F401

@@ -150,7 +150,8 @@ class CriteoTSVReader:
     def __init__(self, path: "str | bytes | os.PathLike | Sequence[str]",
                  batch_rows: int, hash_space: int,
                  n_reserved: int = N_DENSE, features_col: str = "features",
-                 label_col: str = "label", chunk_bytes: int = 1 << 20):
+                 label_col: str = "label", chunk_bytes: int = 1 << 20,
+                 workers: int = 0):
         if batch_rows <= 0:
             raise ValueError(f"batch_rows must be positive: {batch_rows}")
         # one path or a sequence (the Criteo-1TB corpus is day_0..day_23
@@ -165,14 +166,146 @@ class CriteoTSVReader:
         self.features_col = features_col
         self.label_col = label_col
         self.chunk_bytes = max(chunk_bytes, 1 << 12)
+        # workers=0: auto (one parse thread per core beyond the first,
+        # capped; 1-core hosts parse inline).  The reference's data plane
+        # is parallel by construction — every operator runs at
+        # parallelism P with P readers (``Iterations.java:188-209``);
+        # here the analog is byte-range sharding of the day-files across
+        # a thread pool (ct_parse releases the GIL through ctypes, so
+        # threads scale on real cores).  Output order is DETERMINISTIC
+        # (ranges re-assemble in file order) so cursor-based resume and
+        # seeded shuffles stay exact regardless of worker count.
+        if workers < 0:
+            raise ValueError(f"workers must be >= 0, got {workers}")
+        self.workers = (min(8, max(1, (os.cpu_count() or 1) - 1))
+                        if workers == 0 else workers)
 
     @property
     def num_features(self) -> int:
         return self.n_reserved + self.hash_space
 
     def _rows(self) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        if self.workers > 1:
+            yield from self._rows_parallel()
+            return
         for path in self.paths:
             yield from self._file_rows(path)
+
+    # -- parallel range-sharded parse --------------------------------------
+
+    def _range_tasks(self, range_bytes: int = 32 << 20):
+        """Split the file set into byte-range tasks.  Range boundaries are
+        arbitrary; each task starts after the first newline past its start
+        (unless at file offset 0) and runs through the first newline past
+        its end, so every line belongs to exactly one task."""
+        for path in self.paths:
+            size = os.path.getsize(path)
+            start = 0
+            while start < size:
+                yield (path, start, min(start + range_bytes, size))
+                start += range_bytes
+
+    def _parse_range(self, path, start: int, end: int
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Parse [start, end)'s lines (ownership rule above) into one
+        concatenated (dense, cat, label) triple."""
+        ds, cs, ys = [], [], []
+        with open(path, "rb") as f:
+            f.seek(max(0, start - 1))
+            tail = b""
+            # a range owns lines whose FIRST byte lies in [start, end); if
+            # byte start-1 is a newline, start IS a line start and nothing
+            # is skipped
+            at_line_start = start == 0 or f.read(1) == b"\n"
+            f.seek(start)
+            if not at_line_start:
+                # skip the partial line owned by the previous range
+                while True:
+                    probe = f.read(1 << 16)
+                    if not probe:
+                        return (np.zeros((0, N_DENSE), np.float32),
+                                np.zeros((0, N_CAT), np.int32),
+                                np.zeros((0,), np.float32))
+                    nl = probe.find(b"\n")
+                    if nl >= 0:
+                        start += nl + 1
+                        break
+                    start += len(probe)
+                if start >= end:
+                    # the whole range sat inside one line owned by the
+                    # previous range
+                    return (np.zeros((0, N_DENSE), np.float32),
+                            np.zeros((0, N_CAT), np.int32),
+                            np.zeros((0,), np.float32))
+                f.seek(start)   # re-read from the owned line start
+            pos_in_file = start
+            while True:
+                data = tail
+                take = end - pos_in_file
+                if take > 0:
+                    chunk = f.read(min(self.chunk_bytes, take))
+                    if chunk:
+                        data = tail + chunk
+                        pos_in_file += len(chunk)
+                    else:
+                        take = 0
+                if take <= 0:
+                    if not data:
+                        break  # ended exactly on a line boundary
+                    # past end: extend through the first newline (this
+                    # range owns its final partial line)
+                    if b"\n" not in data:
+                        extra = f.read(1 << 16)
+                        while extra:
+                            data += extra
+                            if b"\n" in extra:
+                                break
+                            extra = f.read(1 << 16)
+                    nl = data.find(b"\n")
+                    if nl < 0:  # EOF without newline: final line
+                        data = data + b"\n" if data.strip() else b""
+                        nl = len(data) - 1
+                    data = data[:nl + 1]
+                    if data:
+                        d, c, y, _ = parse_chunk(
+                            data, max(1, len(data) // 40),
+                            self.hash_space, self.n_reserved)
+                        if len(y):
+                            ds.append(d); cs.append(c); ys.append(y)
+                    break
+                max_rows = max(1, len(data) // 40)
+                d, c, y, consumed = parse_chunk(
+                    data, max_rows, self.hash_space, self.n_reserved)
+                if len(y):
+                    ds.append(d); cs.append(c); ys.append(y)
+                tail = data[consumed:]
+        if not ds:
+            return (np.zeros((0, N_DENSE), np.float32),
+                    np.zeros((0, N_CAT), np.int32),
+                    np.zeros((0,), np.float32))
+        return (np.concatenate(ds), np.concatenate(cs), np.concatenate(ys))
+
+    def _rows_parallel(self
+                       ) -> Iterator[Tuple[np.ndarray, np.ndarray,
+                                           np.ndarray]]:
+        """Ordered assembly over a thread pool: a sliding window of
+        in-flight range tasks bounds memory at ~2x workers ranges."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        tasks = self._range_tasks()
+        with ThreadPoolExecutor(max_workers=self.workers,
+                                thread_name_prefix="criteo-parse") as pool:
+            window: list = []
+            for task in tasks:
+                window.append(pool.submit(self._parse_range, *task))
+                if len(window) >= 2 * self.workers:
+                    dense, cat, label = window.pop(0).result()
+                    if len(label):
+                        yield dense, cat, label
+            for fut in window:
+                dense, cat, label = fut.result()
+                if len(label):
+                    yield dense, cat, label
 
     def _file_rows(self, path
                    ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:

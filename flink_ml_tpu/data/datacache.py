@@ -100,11 +100,23 @@ class Segment:
 
 
 class DataCacheWriter:
-    """Append columnar batches; rotate segments at ``segment_rows``."""
+    """Append columnar batches; rotate segments at ``segment_rows``.
 
-    def __init__(self, directory: str, segment_rows: int = 1 << 20):
+    ``workers > 1`` writes whole segments on a background thread pool
+    (the reference's data plane writes with operator parallelism P,
+    ``Iterations.java:188-209``; here the analog is segment-parallel
+    pwrite, which overlaps disk IO with the producer's parse/decode and
+    scales on multi-queue storage).  Batches buffer in memory until a
+    segment fills, bounded to ``workers + 2`` segments in flight; the
+    manifest still lists segments in arrival order, so the reader's view
+    is identical for any worker count."""
+
+    def __init__(self, directory: str, segment_rows: int = 1 << 20,
+                 workers: int = 1):
         if segment_rows <= 0:
             raise ValueError("segment_rows must be positive")
+        if workers < 1:
+            raise ValueError("workers must be >= 1")
         self.directory = directory
         self.segment_rows = segment_rows
         os.makedirs(directory, exist_ok=True)
@@ -123,6 +135,17 @@ class DataCacheWriter:
         self._current_dir: Optional[str] = None
         self._finished = False
         self._broken = False
+        self._workers = workers
+        self._pool = None
+        self._futures: List = []        # (segment_index, Future[Segment])
+        self._pending: List = []        # buffered arrays for current seg
+        self._pending_rows = 0
+        self._next_seg = 0
+        if workers > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="datacache-write")
 
     def _check_schema(self, batch: Dict[str, np.ndarray]) -> None:
         schema = {name: (tuple(arr.shape[1:]), str(arr.dtype))
@@ -159,6 +182,9 @@ class DataCacheWriter:
             if arr.shape[0] != rows:
                 raise ValueError("Ragged batch: columns disagree on rows")
         self._check_schema(batch)
+        if self._pool is not None:
+            self._append_parallel(batch, rows)
+            return
 
         written = 0
         lib = _native_lib()
@@ -192,11 +218,82 @@ class DataCacheWriter:
     def column_path_for_current(self, name: str) -> str:
         return os.path.join(self._current_dir, _col_filename(name))
 
+    # -- segment-parallel path (workers > 1) -------------------------------
+
+    def _append_parallel(self, batch: Dict[str, np.ndarray],
+                         rows: int) -> None:
+        written = 0
+        while written < rows:
+            take = min(rows - written, self.segment_rows - self._pending_rows)
+            # COPY the slice: append() returns before the background write
+            # runs, so a view into a caller-reused buffer would let the
+            # next batch's bytes land in this segment
+            self._pending.append(
+                {k: v[written:written + take].copy()
+                 for k, v in batch.items()})
+            self._pending_rows += take
+            written += take
+            if self._pending_rows >= self.segment_rows:
+                self._submit_segment()
+
+    def _submit_segment(self) -> None:
+        if not self._pending_rows:
+            return
+        seg_idx = self._next_seg
+        self._next_seg += 1
+        parts, rows = self._pending, self._pending_rows
+        self._pending, self._pending_rows = [], 0
+        # backpressure: bound in-flight segments (memory = buffered
+        # arrays); block on the OLDEST unfinished write, pruning finished
+        # futures so neither the list nor the wait degenerates
+        pending = [(i, f) for i, f in self._futures if not f.done()]
+        done = [(i, f) for i, f in self._futures if f.done()]
+        for _, f in done:
+            f.result()   # surface write errors promptly
+        self._futures = done + pending  # keep results for finish()
+        while len(pending) >= self._workers + 2:
+            pending[0][1].result()
+            pending = [(i, f) for i, f in pending if not f.done()]
+        self._futures.append(
+            (seg_idx, self._pool.submit(self._write_segment, seg_idx,
+                                        parts, rows)))
+
+    def _write_segment(self, seg_idx: int, parts: List[Dict[str, np.ndarray]],
+                       rows: int) -> Segment:
+        seg_dir = os.path.join(self.directory, f"seg-{seg_idx:05d}")
+        os.makedirs(seg_dir, exist_ok=True)
+        lib = _native_lib()
+        for name in self._schema:
+            path = os.path.join(seg_dir, _col_filename(name))
+            if lib is not None:
+                for part in parts:
+                    chunk = np.ascontiguousarray(part[name])
+                    r = lib.dc_write(path.encode(), chunk.ctypes.data,
+                                     chunk.nbytes, 1)
+                    if r != chunk.nbytes:
+                        raise IOError(f"native write failed for {path}")
+            else:
+                with open(path, "ab") as f:
+                    for part in parts:
+                        f.write(np.ascontiguousarray(part[name]).tobytes())
+        return Segment(seg_dir, rows, self._schema)
+
     def finish(self) -> List[Segment]:
         """Seal the cache and write the manifest
         (``DataCacheWriter.finish``)."""
         if not self._finished:
-            self._rotate()
+            if self._pool is not None:
+                self._submit_segment()
+                try:
+                    segs = {i: f.result() for i, f in self._futures}
+                except Exception:
+                    self._broken = True
+                    self._pool.shutdown(wait=True)
+                    raise
+                self._pool.shutdown(wait=True)
+                self._segments = [segs[i] for i in sorted(segs)]
+            else:
+                self._rotate()
             self._finished = True
             manifest = {
                 "segments": [s.to_json() for s in self._segments],

@@ -96,7 +96,9 @@ class CountWindows:
                 raise ValueError(f"cursor {cursor} out of range")
             self._cursor = cursor
         else:
-            # live feeds can only fast-forward: re-window and discard
+            # feed cursors fast-forward by re-windowing and discarding —
+            # exact for replayable feeds; live feeds need a WindowLog tee
+            # (data/wal.py) for loss-free restore
             self._skip = cursor
 
 
@@ -108,6 +110,14 @@ class EventTimeWindows:
     rule).  All still-open windows flush in time order at end-of-stream.
 
     ``source`` is a Table or an iterable of Tables carrying ``time_col``.
+
+    Cursor caveat: ``snapshot``/``restore`` count EMITTED windows and
+    fast-forward by re-iterating the source — exact only when the source
+    replays deterministically from the start (a Table, a file, a cache).
+    For a genuinely live feed, wrap the window stream in
+    :class:`flink_ml_tpu.data.wal.WindowLog`, whose write-ahead log
+    replays consumed-but-uncheckpointed windows without touching the
+    source (the ``Checkpoints.java`` analog).
     """
 
     def __init__(self, source: Any, time_col: str, window_size: float,

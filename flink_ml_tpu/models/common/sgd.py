@@ -563,6 +563,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                       values_key: Optional[str] = None,
                       dense_key: Optional[str] = None,
                       prefetch_depth: int = 2,
+                      prefetch_workers: int = 1,
+                      prefetch_stats=None,
                       checkpoint=None,
                       checkpoint_every_steps: int = 0,
                       resume: bool = False
@@ -740,7 +742,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         resume_loss_sum, resume_n_batches, skip_steps = None, 0, 0
         for dev_batch in prefetch_to_device(
                 reader, depth=prefetch_depth,
-                transform=to_host_batch, sharding=sharding):
+                transform=to_host_batch, sharding=sharding,
+                workers=prefetch_workers, stats=prefetch_stats):
             params, value = batch_step(params, *dev_batch)
             loss_sum = value if loss_sum is None else add(loss_sum, value)
             n_batches += 1

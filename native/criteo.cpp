@@ -17,10 +17,24 @@
 // Returns the number of rows parsed; *consumed gets the byte count of the
 // whole lines consumed (callers carry the tail of a chunk into the next
 // read).  Malformed lines (wrong field count) are skipped.
+//
+// Throughput design (the single-core rate IS the north-star ingest floor):
+// the FNV chain is 4-5 cycles of xor+mul LATENCY per byte, so hashing one
+// token at a time caps the parser near ~400 MB/s.  The 26 per-row chains
+// are independent, so the hot path hashes them INTERLEAVED — scalar
+// interleaving pipelines the multiplies (mul throughput is 1/cycle), and
+// an AVX-512DQ variant (runtime-dispatched; vpmullq = 8 chains/vector
+// with per-lane length masks) cuts it further.  Both produce bit-exact
+// FNV-1a — same values as the Python twin, token at a time.
 
 #include <array>
 #include <cstdint>
 #include <cstdio>
+#include <cstring>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
 
 extern "C" {
 
@@ -33,6 +47,103 @@ static inline uint64_t fnv1a64(const uint8_t* data, int64_t len,
 }
 
 static const uint64_t kFnvOffset = 14695981039346656037ull;
+static const uint64_t kFnvPrime = 1099511628211ull;
+
+// Hash the 26 categorical tokens of one row with the chains interleaved.
+// Tokens are (start, len) pairs into buf; out[f] = FNV-1a(salt_f, token_f).
+static inline void hash26_interleaved(const uint8_t* buf,
+                                      const int64_t* starts,
+                                      const int64_t* lens,
+                                      const uint64_t* salts,
+                                      uint64_t* out) {
+  uint64_t h[26];
+  int64_t maxlen = 0;
+  for (int f = 0; f < 26; ++f) {
+    h[f] = salts[f];
+    if (lens[f] > maxlen) maxlen = lens[f];
+  }
+  for (int64_t j = 0; j < maxlen; ++j) {
+    for (int f = 0; f < 26; ++f) {
+      if (j < lens[f]) {
+        h[f] = (h[f] ^ buf[starts[f] + j]) * kFnvPrime;
+      }
+    }
+  }
+  std::memcpy(out, h, sizeof(h));
+}
+
+#if defined(__x86_64__)
+__attribute__((target("avx512f,avx512dq,avx512bw,avx512vl")))
+static void hash26_avx512(const uint8_t* buf, const int64_t* starts,
+                          const int64_t* lens, const uint64_t* salts,
+                          uint64_t* out) {
+  // 26 chains in 4 vectors of 8 lanes (last 6 lanes idle).  Token words
+  // load 8 bytes at a time; per byte-round j, lanes with len <= j are
+  // mask-frozen, so results are exact FNV-1a for any length mix.
+  alignas(64) uint64_t w[32];    // current 8-byte window per field
+  alignas(64) int64_t  l[32];
+  alignas(64) uint64_t hs[32];
+  int64_t maxlen = 0;
+  for (int f = 0; f < 26; ++f) {
+    l[f] = lens[f];
+    hs[f] = salts[f];
+    if (lens[f] > maxlen) maxlen = lens[f];
+  }
+  for (int f = 26; f < 32; ++f) { l[f] = 0; hs[f] = 0; w[f] = 0; }
+  const __m512i prime = _mm512_set1_epi64(static_cast<long long>(kFnvPrime));
+  const __m512i bytemask = _mm512_set1_epi64(0xFF);
+  __m512i hv[4], lv[4];
+  for (int g = 0; g < 4; ++g) {
+    hv[g] = _mm512_load_si512(hs + 8 * g);
+    lv[g] = _mm512_load_si512(l + 8 * g);
+  }
+  for (int64_t base = 0; base < maxlen; base += 8) {
+    // refill 8-byte windows (unaligned safe loads; token data is inside
+    // the line so reading 8 bytes from start+base can only run past the
+    // token into the same buffer chunk — memcpy keeps it UB-free, and
+    // lanes past len are mask-frozen anyway)
+    for (int f = 0; f < 26; ++f) {
+      w[f] = 0;
+      int64_t m = lens[f] - base;
+      if (m > 0) {
+        std::memcpy(&w[f], buf + starts[f] + base, m > 8 ? 8 : m);
+      }
+    }
+    __m512i wv[4];
+    for (int g = 0; g < 4; ++g) wv[g] = _mm512_load_si512(w + 8 * g);
+    const int64_t round_end = maxlen - base < 8 ? maxlen - base : 8;
+    for (int64_t j = 0; j < round_end; ++j) {
+      const __m512i jv = _mm512_set1_epi64(base + j);
+      for (int g = 0; g < 4; ++g) {
+        __mmask8 active = _mm512_cmpgt_epi64_mask(lv[g], jv);
+        __m512i b = _mm512_and_si512(wv[g], bytemask);
+        __m512i mixed = _mm512_mullo_epi64(
+            _mm512_xor_si512(hv[g], b), prime);
+        hv[g] = _mm512_mask_mov_epi64(hv[g], active, mixed);
+        wv[g] = _mm512_srli_epi64(wv[g], 8);
+      }
+    }
+  }
+  alignas(64) uint64_t hout[32];
+  for (int g = 0; g < 4; ++g) _mm512_store_si512(hout + 8 * g, hv[g]);
+  std::memcpy(out, hout, 26 * sizeof(uint64_t));
+}
+#endif
+
+typedef void (*hash26_fn)(const uint8_t*, const int64_t*, const int64_t*,
+                          const uint64_t*, uint64_t*);
+
+static hash26_fn pick_hash26() {
+#if defined(__x86_64__)
+  if (__builtin_cpu_supports("avx512f") &&
+      __builtin_cpu_supports("avx512dq") &&
+      __builtin_cpu_supports("avx512bw") &&
+      __builtin_cpu_supports("avx512vl")) {
+    return hash26_avx512;
+  }
+#endif
+  return hash26_interleaved;
+}
 
 // Post-salt FNV states for "C1=".."C26=" — row-invariant, computed once
 // (thread-safe C++11 static init) instead of 26 snprintf+FNV per row.
@@ -47,78 +158,182 @@ static std::array<uint64_t, 26> make_salts() {
   return salts;
 }
 
-int64_t ct_parse(const uint8_t* buf, int64_t nbytes, int64_t max_rows,
-                 int64_t hash_space, int64_t n_reserved,
-                 float* dense, int32_t* cat, float* label,
-                 int64_t* consumed) {
+// Emit one validated 40-field line into the output rows.  starts/lens
+// index into buf; returns nothing (caller already checked nf == 40).
+static inline void emit_row(const uint8_t* buf, const int64_t* starts,
+                            const int64_t* lens, int64_t hash_space,
+                            int64_t n_reserved, hash26_fn hash26,
+                            const uint64_t* salts, int64_t row,
+                            float* dense, int32_t* cat, float* label) {
+  float* drow = dense + row * 13;
+  int32_t* crow = cat + row * 26;
+  // label
+  label[row] = (lens[0] > 0 && buf[starts[0]] == '1') ? 1.0f : 0.0f;
+  // 13 integer fields: optional '-', then digits only; anything else
+  // (or > 18 digits, which would overflow int64) parses as 0 — the
+  // Python twin replicates these exact rules
+  for (int f = 0; f < 13; ++f) {
+    int64_t s = starts[1 + f], len = lens[1 + f];
+    if (len == 0) {
+      drow[f] = 0.0f;
+      continue;
+    }
+    bool neg = buf[s] == '-';
+    int64_t ndig = len - (neg ? 1 : 0);
+    int64_t v = 0;
+    if (ndig >= 1 && ndig <= 18) {
+      for (int64_t i = s + (neg ? 1 : 0); i < s + len; ++i) {
+        if (buf[i] < '0' || buf[i] > '9') { v = 0; break; }
+        v = v * 10 + (buf[i] - '0');
+      }
+    }
+    // v == 0 emits +0.0 (not -0.0) for true bit parity with the twin
+    drow[f] = v == 0 ? 0.0f
+                     : (neg ? -static_cast<float>(v)
+                            : static_cast<float>(v));
+  }
+  // 26 categorical fields: interleaved FNV-1a (see hash26_* above)
+  uint64_t hashes[26];
+  hash26(buf, starts + 14, lens + 14, salts, hashes);
+  for (int f = 0; f < 26; ++f) {
+    crow[f] = static_cast<int32_t>(
+        n_reserved
+        + static_cast<int64_t>(hashes[f]
+                               % static_cast<uint64_t>(hash_space)));
+  }
+}
+
+// Scalar delimiter walk (fallback; also the reference semantics).
+static int64_t parse_scalar(const uint8_t* buf, int64_t nbytes,
+                            int64_t max_rows, int64_t hash_space,
+                            int64_t n_reserved, hash26_fn hash26,
+                            const uint64_t* salts, float* dense,
+                            int32_t* cat, float* label,
+                            int64_t* consumed) {
   int64_t rows = 0;
   int64_t pos = 0;
   *consumed = 0;
   while (rows < max_rows) {
-    // find end of line
-    int64_t eol = pos;
-    while (eol < nbytes && buf[eol] != '\n') ++eol;
-    if (eol >= nbytes) break;  // partial line: leave for the next chunk
+    const void* nl = std::memchr(buf + pos, '\n', nbytes - pos);
+    if (nl == nullptr) break;  // partial line: leave for the next chunk
+    const int64_t eol = static_cast<const uint8_t*>(nl) - buf;
 
-    // split into 40 tab-separated fields
     int64_t starts[40], lens[40];
     int nf = 0;
     int64_t fs = pos;
-    for (int64_t i = pos; i <= eol && nf < 40; ++i) {
-      if (i == eol || buf[i] == '\t') {
+    for (int64_t i = pos; i < eol && nf < 40; ++i) {
+      if (buf[i] == '\t') {
         starts[nf] = fs;
         lens[nf] = i - fs;
         ++nf;
         fs = i + 1;
       }
     }
-    int64_t line_end = eol + 1;
+    if (nf < 40) {  // final field ends at eol
+      starts[nf] = fs;
+      lens[nf] = eol - fs;
+      ++nf;
+      fs = eol + 1;
+    }
     // exactly 40 fields: fs must have advanced past the final (eol)
     // terminator — a 41st field would leave fs <= eol and the line skips,
     // matching the Python twin's len(fields) == 40 check
     if (nf == 40 && fs == eol + 1) {
-      static const std::array<uint64_t, 26> kSalts = make_salts();
-      float* drow = dense + rows * 13;
-      int32_t* crow = cat + rows * 26;
-      // label
-      label[rows] = (lens[0] > 0 && buf[starts[0]] == '1') ? 1.0f : 0.0f;
-      // 13 integer fields: optional '-', then digits only; anything else
-      // (or > 18 digits, which would overflow int64) parses as 0 — the
-      // Python twin replicates these exact rules
-      for (int f = 0; f < 13; ++f) {
-        int64_t s = starts[1 + f], len = lens[1 + f];
-        if (len == 0) {
-          drow[f] = 0.0f;
-          continue;
-        }
-        bool neg = buf[s] == '-';
-        int64_t ndig = len - (neg ? 1 : 0);
-        int64_t v = 0;
-        if (ndig >= 1 && ndig <= 18) {
-          for (int64_t i = s + (neg ? 1 : 0); i < s + len; ++i) {
-            if (buf[i] < '0' || buf[i] > '9') { v = 0; break; }
-            v = v * 10 + (buf[i] - '0');
-          }
-        }
-        // v == 0 emits +0.0 (not -0.0) for true bit parity with the twin
-        drow[f] = v == 0 ? 0.0f
-                         : (neg ? -static_cast<float>(v)
-                                : static_cast<float>(v));
-      }
-      // 26 categorical fields: FNV-1a("C{field}=") continued over token
-      for (int f = 0; f < 26; ++f) {
-        uint64_t h = fnv1a64(buf + starts[14 + f], lens[14 + f],
-                             kSalts[f]);
-        crow[f] = static_cast<int32_t>(
-            n_reserved
-            + static_cast<int64_t>(h % static_cast<uint64_t>(hash_space)));
-      }
+      emit_row(buf, starts, lens, hash_space, n_reserved, hash26, salts,
+               rows, dense, cat, label);
       ++rows;
     }
-    pos = line_end;
+    pos = eol + 1;
     *consumed = pos;
   }
   return rows;
+}
+
+#if defined(__x86_64__)
+// Single-pass AVX2 walk: 32-byte blocks -> tab|newline bitmasks, fields
+// closed per set bit (simdjson-style structural scan).  ~2x the scalar
+// split on Criteo-shaped lines; output is byte-identical.
+__attribute__((target("avx2")))
+static int64_t parse_avx2(const uint8_t* buf, int64_t nbytes,
+                          int64_t max_rows, int64_t hash_space,
+                          int64_t n_reserved, hash26_fn hash26,
+                          const uint64_t* salts, float* dense,
+                          int32_t* cat, float* label,
+                          int64_t* consumed) {
+  const __m256i vtab = _mm256_set1_epi8('\t');
+  const __m256i vnl = _mm256_set1_epi8('\n');
+  int64_t rows = 0;
+  *consumed = 0;
+  int64_t starts[41], lens[41];
+  int nf = 0;           // fields closed on the current line
+  bool overflow = false;  // line had > 40 fields
+  int64_t fs = 0;       // current field start
+  for (int64_t base = 0; base < nbytes && rows < max_rows; base += 32) {
+    uint32_t mask;
+    if (base + 32 <= nbytes) {
+      const __m256i v = _mm256_loadu_si256(
+          reinterpret_cast<const __m256i*>(buf + base));
+      mask = static_cast<uint32_t>(_mm256_movemask_epi8(
+          _mm256_or_si256(_mm256_cmpeq_epi8(v, vtab),
+                          _mm256_cmpeq_epi8(v, vnl))));
+    } else {
+      mask = 0;
+      for (int64_t i = base; i < nbytes; ++i) {
+        if (buf[i] == '\t' || buf[i] == '\n') mask |= 1u << (i - base);
+      }
+    }
+    while (mask != 0 && rows < max_rows) {
+      const int bit = __builtin_ctz(mask);
+      mask &= mask - 1;
+      const int64_t i = base + bit;
+      if (buf[i] == '\t') {
+        if (nf < 40) {
+          starts[nf] = fs;
+          lens[nf] = i - fs;
+          ++nf;
+        } else {
+          overflow = true;
+        }
+        fs = i + 1;
+      } else {  // newline: close the final field, maybe emit, reset
+        if (nf < 40) {
+          starts[nf] = fs;
+          lens[nf] = i - fs;
+          ++nf;
+        } else {
+          overflow = true;
+        }
+        if (nf == 40 && !overflow) {
+          emit_row(buf, starts, lens, hash_space, n_reserved, hash26,
+                   salts, rows, dense, cat, label);
+          ++rows;
+        }
+        nf = 0;
+        overflow = false;
+        fs = i + 1;
+        *consumed = i + 1;
+      }
+    }
+  }
+  return rows;
+}
+#endif
+
+int64_t ct_parse(const uint8_t* buf, int64_t nbytes, int64_t max_rows,
+                 int64_t hash_space, int64_t n_reserved,
+                 float* dense, int32_t* cat, float* label,
+                 int64_t* consumed) {
+  static const std::array<uint64_t, 26> kSalts = make_salts();
+  static const hash26_fn hash26 = pick_hash26();
+#if defined(__x86_64__)
+  static const bool use_avx2 = __builtin_cpu_supports("avx2");
+  if (use_avx2) {
+    return parse_avx2(buf, nbytes, max_rows, hash_space, n_reserved,
+                      hash26, kSalts.data(), dense, cat, label, consumed);
+  }
+#endif
+  return parse_scalar(buf, nbytes, max_rows, hash_space, n_reserved,
+                      hash26, kSalts.data(), dense, cat, label, consumed);
 }
 
 }  // extern "C"

@@ -246,3 +246,43 @@ def test_reader_streams_multiple_files(tmp_path):
 
     with pytest.raises(ValueError, match="at least one"):
         CriteoTSVReader([], batch_rows=8, hash_space=64)
+
+
+def test_parallel_reader_matches_serial_exactly(tmp_path, monkeypatch):
+    """workers>1 range-shards the files; output must be byte-identical to
+    the serial reader in ORDER too (deterministic resume depends on it).
+    Tiny ranges force every boundary case: range starting mid-line, range
+    ending exactly on a line boundary, range inside one line, multi-file
+    crossing, trailing line without newline."""
+    rng = np.random.default_rng(7)
+    p1, p2 = tmp_path / "day0.tsv", tmp_path / "day1.tsv"
+    _make_tsv(p1, 57, rng)
+    _make_tsv(p2, 41, rng)
+    # strip p2's final newline to exercise the EOF tail
+    p2.write_bytes(p2.read_bytes()[:-1])
+
+    def collect(reader):
+        d, c, y = [], [], []
+        for b in reader:
+            d.append(b["features_dense"])
+            c.append(b["features_indices"])
+            y.append(b["label"])
+        return (np.concatenate(d), np.concatenate(c), np.concatenate(y))
+
+    serial = collect(CriteoTSVReader([str(p1), str(p2)], batch_rows=16,
+                                     hash_space=1 << 10, workers=1))
+    for range_bytes in (64, 200, 1 << 20):
+        par = CriteoTSVReader([str(p1), str(p2)], batch_rows=16,
+                              hash_space=1 << 10, workers=3)
+        monkeypatch.setattr(
+            par, "_range_tasks",
+            lambda rb=range_bytes, r=par:
+            CriteoTSVReader._range_tasks(r, range_bytes=rb))
+        got = collect(par)
+        for a, b in zip(serial, got):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_parallel_reader_auto_workers_single_core():
+    r = CriteoTSVReader("x.tsv", batch_rows=4, hash_space=8, workers=0)
+    assert r.workers >= 1

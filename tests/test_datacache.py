@@ -209,3 +209,28 @@ def test_broken_writer_refuses_retry(tmp_path, monkeypatch):
                            "y": np.ones((4,), np.int64)})
     finally:
         dc._LIB = lib
+
+
+def test_parallel_writer_matches_serial(tmp_path):
+    """workers>1 writes whole segments on a pool; the reader's view must be
+    identical (same rows, same order, same segment rotation)."""
+    rng = np.random.default_rng(3)
+    batches = [{"x": rng.normal(size=(n, 4)).astype(np.float32),
+                "y": rng.integers(0, 9, size=n).astype(np.int32)}
+               for n in (70, 1, 130, 64, 35)]
+
+    from flink_ml_tpu.data.datacache import DataCacheReader, DataCacheWriter
+
+    outs = []
+    for workers in (1, 3):
+        d = str(tmp_path / f"cache-w{workers}")
+        w = DataCacheWriter(d, segment_rows=64, workers=workers)
+        for b in batches:
+            w.append(b)
+        segs = w.finish()
+        assert [s.rows for s in segs] == [64, 64, 64, 64, 44]
+        got = list(DataCacheReader(d, batch_rows=50))
+        outs.append({k: np.concatenate([b[k] for b in got])
+                     for k in ("x", "y")})
+    for k in ("x", "y"):
+        np.testing.assert_array_equal(outs[0][k], outs[1][k])

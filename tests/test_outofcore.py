@@ -202,3 +202,41 @@ def test_estimator_fit_outofcore_matches_inmemory_quality(tmp_path):
     a_stream, a_mem = acc(model_stream), acc(model_mem)
     assert a_stream > 0.95
     assert abs(a_stream - a_mem) < 0.03
+
+
+def test_prefetch_workers_ordered_and_stats():
+    """Multi-worker decode must preserve source order; stats must account
+    the pipeline stages."""
+    import time as _time
+
+    from flink_ml_tpu.data.prefetch import PrefetchStats, prefetch_to_device
+
+    def slow_transform(x):
+        # odd batches decode slower: out-of-order completion is forced
+        _time.sleep(0.01 if x % 2 else 0.001)
+        return np.full((4,), x, np.float32)
+
+    stats = PrefetchStats()
+    got = [int(b[0]) for b in prefetch_to_device(
+        range(20), transform=slow_transform, workers=3, stats=stats)]
+    assert got == list(range(20))
+    assert stats.batches == 20
+    assert stats.transform_s > 0
+    d = stats.as_dict()
+    assert set(d) == {"read_s", "transform_s", "put_s", "consumer_wait_s",
+                      "batches"}
+
+
+def test_prefetch_workers_propagates_transform_error():
+    from flink_ml_tpu.data.prefetch import prefetch_to_device
+
+    def bad(x):
+        if x == 3:
+            raise ValueError("boom at 3")
+        return np.zeros(2, np.float32)
+
+    out = []
+    with pytest.raises(ValueError, match="boom at 3"):
+        for b in prefetch_to_device(range(10), transform=bad, workers=2):
+            out.append(b)
+    assert len(out) <= 3

@@ -1,0 +1,146 @@
+"""Write-ahead window log (`data/wal.py`) — exactly-once ingest for live
+feeds (the Checkpoints.java analog at window granularity, VERDICT r2
+missing #1).
+
+The decisive test is the last one: an online-model fit killed mid-stream
+on a NON-replayable iterator must converge identically to the
+uninterrupted run — the crashed run's unacknowledged windows come back
+from the log, not from the source."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from flink_ml_tpu.data.table import Table
+from flink_ml_tpu.data.wal import WindowLog
+from flink_ml_tpu.iteration import (CheckpointConfig, IterationBodyResult,
+                                    IterationConfig, iterate)
+
+
+def _windows(lo, hi, rows=4):
+    """Deterministic windows lo..hi-1; window i carries value i rows."""
+    for i in range(lo, hi):
+        yield Table({"x": np.full((rows,), float(i), np.float32),
+                     "i": np.full((rows,), i, np.int64)})
+
+
+class OneShotFeed:
+    """A genuinely non-replayable source: iterating consumes it forever,
+    and a second iteration continues where the first stopped (a socket)."""
+
+    def __init__(self, lo, hi):
+        self._it = _windows(lo, hi)
+
+    def __iter__(self):
+        return self._it
+
+
+class TestWindowLog:
+    def test_tee_then_replay_after_crash(self, tmp_path):
+        d = str(tmp_path / "wal")
+        feed = OneShotFeed(0, 10)
+        log = WindowLog(feed, d)
+        it = iter(log)
+        seen = [int(next(it)["i"][0]) for _ in range(6)]
+        assert seen == list(range(6))
+        snap = log.snapshot()          # checkpoint cut at 6
+        assert snap == {"consumed": 6}
+        # two more windows consumed after the cut, then "crash"
+        assert int(next(it)["i"][0]) == 6
+        assert int(next(it)["i"][0]) == 7
+
+        # restart: the source lost windows 0..7 forever (socket moved on)
+        resumed = WindowLog(OneShotFeed(8, 10), d)
+        resumed.restore(snap)
+        replayed = [int(t["i"][0]) for t in resumed]
+        # 6,7 come from the LOG; 8,9 from the live source
+        assert replayed == [6, 7, 8, 9]
+
+    def test_crash_before_any_checkpoint_replays_everything(self, tmp_path):
+        d = str(tmp_path / "wal")
+        it = iter(WindowLog(OneShotFeed(0, 5), d))
+        for _ in range(3):
+            next(it)
+        # crash with no snapshot: a fresh log replays all logged windows
+        resumed = WindowLog(OneShotFeed(3, 5), d)
+        assert [int(t["i"][0]) for t in resumed] == [0, 1, 2, 3, 4]
+
+    def test_truncation_keeps_recent_snapshots(self, tmp_path):
+        d = str(tmp_path / "wal")
+        log = WindowLog(OneShotFeed(0, 8), d, keep_snapshots=2)
+        it = iter(log)
+        for k in (2, 4, 6):
+            while log._consumed < k:
+                next(it)
+            log.snapshot()
+        files = sorted(os.listdir(d))
+        # horizon = second-most-recent snapshot (4): win-0..3 truncated
+        assert files == [f"win-{i:08d}.npz" for i in (4, 5)]
+        # restoring to the oldest RETAINED cut works...
+        ok = WindowLog(OneShotFeed(6, 8), d)
+        ok.restore({"consumed": 4})
+        assert [int(t["i"][0]) for t in ok] == [4, 5, 6, 7]
+        # ...restoring past the horizon errors loudly
+        bad = WindowLog(OneShotFeed(6, 8), d)
+        bad.restore({"consumed": 2})
+        with pytest.raises(ValueError, match="truncation horizon"):
+            next(iter(bad))
+
+    def test_kill_and_resume_fit_matches_uninterrupted(self, tmp_path):
+        """The r2 'done' criterion: online fit + WindowLog + checkpoint,
+        killed mid-stream on a non-replayable feed, resumes to EXACTLY the
+        uninterrupted run's state."""
+
+        def body(state, epoch, window):
+            x = jnp.asarray(np.asarray(window["x"], np.float32))
+            # order-sensitive update: any lost/duplicated/reordered window
+            # changes the result
+            return IterationBodyResult(state * 0.9 + jnp.sum(x) * (epoch + 1))
+
+        # uninterrupted oracle (same windows, no crash)
+        oracle = iterate(
+            body, jnp.asarray(0.0),
+            WindowLog(OneShotFeed(0, 12), str(tmp_path / "wal-oracle")),
+            config=IterationConfig(mode="hosted", jit=False))
+        assert oracle.num_epochs == 12
+
+        class Killed(RuntimeError):
+            pass
+
+        class KillingFeed:
+            """Non-replayable feed that dies after handing out 7 windows."""
+
+            def __init__(self, lo, hi, die_after):
+                self._it = _windows(lo, hi)
+                self._left = die_after
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                if self._left == 0:
+                    raise Killed()
+                self._left -= 1
+                return next(self._it)
+
+        wal_dir = str(tmp_path / "wal-crash")
+        ckpt = str(tmp_path / "ckpt")
+        with pytest.raises(Killed):
+            iterate(body, jnp.asarray(0.0),
+                    WindowLog(KillingFeed(0, 12, die_after=7), wal_dir),
+                    config=IterationConfig(mode="hosted", jit=False),
+                    checkpoint=CheckpointConfig(ckpt, interval=4))
+
+        # the feed itself lost windows 0..6 (already consumed); only 7..11
+        # remain live.  The WAL brings back 4..6 (consumed after the cut).
+        resumed = iterate(
+            body, jnp.asarray(0.0),
+            WindowLog(OneShotFeed(7, 12), wal_dir),
+            config=IterationConfig(mode="hosted", jit=False),
+            checkpoint=CheckpointConfig(ckpt, interval=4), resume=True)
+        assert float(resumed.state) == pytest.approx(float(oracle.state),
+                                                     rel=1e-6)
+        assert resumed.num_epochs == 12
