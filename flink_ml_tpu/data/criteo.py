@@ -252,24 +252,32 @@ class CriteoTSVReader:
                 if take <= 0:
                     if not data:
                         break  # ended exactly on a line boundary
-                    # past end: extend through the first newline (this
-                    # range owns its final partial line)
-                    if b"\n" not in data:
-                        extra = f.read(1 << 16)
-                        while extra:
-                            data += extra
-                            if b"\n" in extra:
-                                break
+                    # past end: the tail may hold several complete (e.g.
+                    # malformed-short) lines plus the range's owned final
+                    # partial line.  Complete that last line by extending
+                    # through the FIRST newline past the current bytes
+                    # (never further — later lines belong to the next
+                    # range), then drain everything.
+                    if not data.endswith(b"\n"):
+                        while True:
                             extra = f.read(1 << 16)
-                    nl = data.find(b"\n")
-                    if nl < 0:  # EOF without newline: final line
-                        data = data + b"\n" if data.strip() else b""
-                        nl = len(data) - 1
-                    data = data[:nl + 1]
-                    if data:
-                        d, c, y, _ = parse_chunk(
-                            data, max(1, len(data) // 40),
+                            if not extra:   # EOF without trailing newline
+                                data = (data + b"\n" if data.strip()
+                                        else b"")
+                                break
+                            nl = extra.find(b"\n")
+                            if nl >= 0:
+                                data += extra[:nl + 1]
+                                break
+                            data += extra
+                    pos = 0
+                    while pos < len(data):
+                        d, c, y, consumed = parse_chunk(
+                            data[pos:], max(1, (len(data) - pos) // 40),
                             self.hash_space, self.n_reserved)
+                        if consumed == 0:
+                            break
+                        pos += consumed
                         if len(y):
                             ds.append(d); cs.append(c); ys.append(y)
                     break

@@ -248,12 +248,18 @@ class DataCacheWriter:
         # futures so neither the list nor the wait degenerates
         pending = [(i, f) for i, f in self._futures if not f.done()]
         done = [(i, f) for i, f in self._futures if f.done()]
-        for _, f in done:
-            f.result()   # surface write errors promptly
-        self._futures = done + pending  # keep results for finish()
-        while len(pending) >= self._workers + 2:
-            pending[0][1].result()
-            pending = [(i, f) for i, f in pending if not f.done()]
+        try:
+            for _, f in done:
+                f.result()   # surface write errors promptly
+            self._futures = done + pending  # keep results for finish()
+            while len(pending) >= self._workers + 2:
+                pending[0][1].result()
+                pending = [(i, f) for i, f in pending if not f.done()]
+        except Exception:
+            # same contract as the serial path: a failed segment write
+            # leaves partial column bytes on disk — refuse retries
+            self._broken = True
+            raise
         self._futures.append(
             (seg_idx, self._pool.submit(self._write_segment, seg_idx,
                                         parts, rows)))

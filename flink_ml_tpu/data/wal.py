@@ -70,7 +70,7 @@ class WindowLog:
         # next log index = 1 + highest persisted window (gaps below come
         # from truncation; a stale tmp file from a mid-write crash is
         # ignored and overwritten)
-        existing = [int(name[4:12]) for name in os.listdir(directory)
+        existing = [int(name[4:-4]) for name in os.listdir(directory)
                     if name.startswith("win-") and name.endswith(".npz")]
         self._next_log = max(existing) + 1 if existing else 0
 
@@ -133,7 +133,7 @@ class WindowLog:
     def _truncate_below(self, horizon: int) -> None:
         for name in os.listdir(self._dir):
             if (name.startswith("win-") and name.endswith(".npz")
-                    and int(name[4:12]) < horizon):
+                    and int(name[4:-4]) < horizon):
                 try:
                     os.unlink(os.path.join(self._dir, name))
                 except OSError:

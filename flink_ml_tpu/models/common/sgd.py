@@ -169,7 +169,8 @@ def sgd_fit_params(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
 
 
 def _run_minibatch_epochs(update, data: tuple, init_params, steps: int,
-                          config: SGDConfig, mesh) -> Tuple[dict, list]:
+                          config: SGDConfig, mesh, *,
+                          place_params: bool = True) -> Tuple[dict, list]:
     """THE shared epoch driver behind sgd_fit / sgd_fit_sparse /
     sgd_fit_mixed: an inner scan of ``update`` over per-step slices of the
     (steps, batch, ...) device tensors in ``data``, wrapped in a fused
@@ -197,7 +198,8 @@ def _run_minibatch_epochs(update, data: tuple, init_params, steps: int,
         return IterationBodyResult(
             feedback=(params, epoch_loss, loss_log), termination=termination)
 
-    init_state = (replicate(init_params, mesh),
+    init_state = (replicate(init_params, mesh) if place_params
+                  else init_params,
                   jnp.asarray(jnp.inf, jnp.float32),
                   jnp.full((config.max_epochs,), jnp.nan, jnp.float32))
 
@@ -296,27 +298,34 @@ def _scatter_add_weights(w: jnp.ndarray, idx: jnp.ndarray,
     return w.at[idx.reshape(-1)].add(updates_flat)
 
 
-def _finish_sparse_step(config: SGDConfig):
+def _finish_sparse_step(config: SGDConfig, *, sumsq=None, rsum=None):
     """Shared l2/apply/l1-prox/bias tail of the manual-gradient updates:
-    the regularization algebra lives in ONE place so the sparse and mixed
-    paths stay identical to the dense autodiff semantics (l2 decay =
-    ``w*(1-lr*l2)`` before the sparse gradient, exactly grad-of-
-    ``loss + l2/2 ||w||^2``; l1 via proximal soft-threshold after)."""
+    the regularization algebra lives in ONE place so the sparse, mixed,
+    ELL, and model-sharded paths stay identical to the dense autodiff
+    semantics (l2 decay = ``w*(1-lr*l2)`` before the sparse gradient,
+    exactly grad-of-``loss + l2/2 ||w||^2``; l1 via proximal
+    soft-threshold after).
+
+    ``sumsq``/``rsum`` override the two REDUCTIONS (||w||^2 and sum(r))
+    for callers whose w/r are device-local shards needing a psum — the
+    elementwise algebra never forks."""
     lr = config.learning_rate
     reg, alpha = config.reg, config.elastic_net
     l2 = reg * (1.0 - alpha)
     l1 = reg * alpha
+    sumsq = sumsq or (lambda w: jnp.sum(jnp.square(w)))
+    rsum = rsum or jnp.sum
 
     def finish(w, b, value, r, apply_grad):
         """``apply_grad(w)`` must add ``-lr * grad_loss`` to the (possibly
         l2-decayed) weight; ``r`` is dloss/dmargin for the bias step."""
         if l2 > 0:
-            value = value + 0.5 * l2 * jnp.sum(jnp.square(w))
+            value = value + 0.5 * l2 * sumsq(w)
             w = w * (1.0 - lr * l2)
         w = apply_grad(w)
         if l1 > 0:
             w = jnp.sign(w) * jnp.maximum(jnp.abs(w) - lr * l1, 0.0)
-        b = b - (lr * jnp.sum(r) if config.fit_intercept else 0.0)
+        b = b - (lr * rsum(r) if config.fit_intercept else 0.0)
         return {"w": w, "b": b}, value
 
     return finish
@@ -489,6 +498,94 @@ def plan_mixed_impl(num_features: int, mesh, steps: int = 1) -> str:
     return "xla"
 
 
+def _mixed_update_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
+                          num_features: int, n_dense: int):
+    """dp x model-parallel twin of :func:`_mixed_update`: the weight is
+    SHARDED over the mesh's ``model`` axis (each device owns a contiguous
+    ``num_features / M`` block) so 2^24+ hash spaces never replicate —
+    the embedding-table pattern of
+    ``widedeep.py::build_sharded_train_step`` applied to the flat LR
+    weight.  Communication per step is three small collectives, all on
+    per-batch vectors, never on the weight:
+
+    - ``psum("model")`` of each shard's partial margins (batch,)
+    - ``psum("data")`` of the weighted-loss numerator/denominator pair
+      (the loss_fn's weighted mean re-normalized globally, so the result
+      matches the replicated path exactly)
+    - ``psum("data")`` of the owned-slot update block (shard-sized; this
+      is the data-parallel gradient reduction)
+
+    Each device scatters only the categorical slots it OWNS (masked
+    local indices); the dense block lives on model-rank 0's shard.
+    """
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map  # type: ignore
+
+    if _mesh_process_count(mesh) > 1:
+        raise NotImplementedError(
+            "model-sharded sgd_fit_mixed is single-host for now: the "
+            "final weight fetch assembles shards across local devices "
+            "only (multi-host needs a cross-process allgather of the "
+            "'model' axis)")
+    M = int(mesh.shape["model"])
+    if num_features % M:
+        raise ValueError(
+            f"num_features={num_features} must divide the model axis "
+            f"({M}); pad the hash space")
+    shard = num_features // M
+    if n_dense > shard:
+        raise ValueError(
+            f"n_dense={n_dense} exceeds the per-device weight shard "
+            f"{shard}; use fewer model shards")
+    lr = config.learning_rate
+    finish = _finish_sparse_step(
+        config,
+        sumsq=lambda w: jax.lax.psum(jnp.sum(jnp.square(w)), "model"),
+        rsum=lambda r: jax.lax.psum(jnp.sum(r), "data"))
+
+    def device_fn(w_shard, b, dense, cat, yb, wb):
+        # w_shard (shard,) this device's block; batch args are LOCAL rows
+        mrank = jax.lax.axis_index("model")
+        off = mrank * shard
+        loc = cat - off
+        owned = (loc >= 0) & (loc < shard)
+        locc = jnp.clip(loc, 0, shard - 1)
+        gathered = jnp.where(owned, w_shard[locc], 0.0)
+        margin_part = jnp.sum(gathered, axis=-1)
+        on0 = (mrank == 0).astype(jnp.float32)
+        margin_part = margin_part + on0 * (dense @ w_shard[:n_dense])
+        margin = jax.lax.psum(margin_part, "model") + b
+
+        value_local, pull = jax.vjp(lambda m: loss_fn(m, yb, wb), margin)
+        (r_local,) = pull(jnp.ones_like(value_local))
+        # re-normalize the loss_fn's LOCAL weighted mean to the global
+        # denominator so sharded == replicated bit-for-bit in exact math
+        denom_local = jnp.maximum(jnp.sum(wb), 1e-12)
+        denom = jax.lax.psum(denom_local, "data")
+        value = jax.lax.psum(value_local * denom_local, "data") / denom
+        r = r_local * (denom_local / denom)
+
+        def apply_grad(w_shard):
+            delta = jnp.zeros_like(w_shard).at[locc.reshape(-1)].add(
+                jnp.where(owned, -lr * r[:, None], 0.0).reshape(-1))
+            delta = delta.at[:n_dense].add(on0 * (-lr) * (r @ dense))
+            return w_shard + jax.lax.psum(delta, "data")
+
+        return finish(w_shard, b, value, r, apply_grad)
+
+    fn = shard_map(
+        device_fn, mesh=mesh,
+        in_specs=(P("model"), P(), P("data", None), P("data", None),
+                  P("data"), P("data")),
+        out_specs=({"w": P("model"), "b": P()}, P()))
+
+    def update(params, dense, cat, yb, wb):
+        return fn(params["w"], params["b"], dense, cat, yb, wb)
+
+    return update
+
+
 def sgd_fit_mixed(loss_fn: LossFn, dense_features: np.ndarray,
                   cat_indices: np.ndarray, labels: np.ndarray,
                   weights: Optional[np.ndarray], num_features: int,
@@ -526,7 +623,12 @@ def sgd_fit_mixed(loss_fn: LossFn, dense_features: np.ndarray,
               else np.ones((n,), np.float32))
     w = prepare_epoch_tensor(w_host, perm, steps, batch, pad_value=0.0)
 
-    impl = plan_mixed_impl(num_features, mesh, steps)
+    model_sharded = int(mesh.shape.get("model", 1)) > 1
+    impl = ("sharded" if model_sharded
+            else plan_mixed_impl(num_features, mesh, steps))
+    place_params = True
+    init_params = {"w": jnp.zeros((num_features,), jnp.float32),
+                   "b": jnp.zeros((), jnp.float32)}
     if impl == "ell":
         # one-time static routing of every step's categorical slots
         # (amortised over max_epochs replays of the same epoch tensor)
@@ -536,6 +638,21 @@ def sgd_fit_mixed(loss_fn: LossFn, dense_features: np.ndarray,
         extra = (layout.src, layout.pos, layout.mask,
                  layout.ovf_idx, layout.ovf_src)
         update = _mixed_update_ell(loss_fn, config)
+    elif impl == "sharded":
+        # weight sharded over the model axis (2^24+ hash spaces never
+        # replicate); see _mixed_update_sharded
+        extra = ()
+        update = _mixed_update_sharded(loss_fn, config, mesh, num_features,
+                                       n_dense)
+        from jax.sharding import NamedSharding
+
+        init_params = {
+            "w": jax.device_put(init_params["w"],
+                                NamedSharding(mesh, P("model"))),
+            "b": jax.device_put(init_params["b"],
+                                NamedSharding(mesh, P())),
+        }
+        place_params = False
     else:
         extra = ()
         update = _mixed_update(loss_fn, config)
@@ -547,9 +664,8 @@ def sgd_fit_mixed(loss_fn: LossFn, dense_features: np.ndarray,
     extra = tuple(jax.device_put(a) for a in extra)  # single-device path
 
     params, loss_log = _run_minibatch_epochs(
-        update, (dense, cat) + extra + (y, w),
-        {"w": jnp.zeros((num_features,), jnp.float32),
-         "b": jnp.zeros((), jnp.float32)}, steps, config, mesh)
+        update, (dense, cat) + extra + (y, w), init_params, steps, config,
+        mesh, place_params=place_params)
     return LinearState(np.asarray(params["w"], np.float64),
                        float(params["b"])), loss_log
 

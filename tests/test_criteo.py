@@ -286,3 +286,34 @@ def test_parallel_reader_matches_serial_exactly(tmp_path, monkeypatch):
 def test_parallel_reader_auto_workers_single_core():
     r = CriteoTSVReader("x.tsv", batch_rows=4, hash_space=8, workers=0)
     assert r.workers >= 1
+
+
+def test_parallel_reader_with_malformed_short_lines(tmp_path):
+    """Malformed (<40-field) lines near range boundaries must not drop or
+    duplicate neighboring valid rows (code-review r3 finding)."""
+    rng = np.random.default_rng(9)
+    path = tmp_path / "dirty.tsv"
+    _make_tsv(path, 30, rng)
+    content = path.read_bytes().split(b"\n")
+    # splice short garbage lines between every few valid lines
+    dirty = []
+    for i, line in enumerate(content):
+        dirty.append(line)
+        if i % 3 == 1:
+            dirty.append(b"x")
+            dirty.append(b"bad\tline")
+    path.write_bytes(b"\n".join(dirty))
+
+    def labels(reader):
+        return np.concatenate([b["label"] for b in reader])
+
+    serial = labels(CriteoTSVReader(str(path), batch_rows=8,
+                                    hash_space=256, workers=1))
+    assert len(serial) == 30
+    for rb in (48, 100, 256):
+        par = CriteoTSVReader(str(path), batch_rows=8, hash_space=256,
+                              workers=3)
+        par._range_tasks = (
+            lambda rb=rb, r=par:
+            CriteoTSVReader._range_tasks(r, range_bytes=rb))
+        np.testing.assert_array_equal(serial, labels(par))

@@ -478,3 +478,63 @@ def test_online_lr_accepts_mixed_columns(rng):
     out = model.transform(Table({"features_dense": dense,
                                  "features_indices": cat}))[0]
     assert np.isfinite(np.asarray(out["rawPrediction"])).all()
+
+
+import jax as _jax
+
+
+class TestShardedMixedWeight:
+    """dp x model mesh: the weight shards over 'model' (VERDICT r2 task 7).
+    The sharded fit must reproduce the single-device oracle allclose —
+    a wrong psum/axis placement still converges, so only exact
+    equivalence catches it (the WideDeep oracle stance)."""
+
+    def _data(self, d):
+        rng = np.random.default_rng(5)
+        n, nd, nc = 256, 3, 5
+        dense = rng.normal(size=(n, nd)).astype(np.float32)
+        cat = rng.integers(0, d, size=(n, nc)).astype(np.int32)
+        y = rng.integers(0, 2, size=n).astype(np.float64)
+        cat[:, 0] = np.where(y == 1, 40, 41)
+        return dense, cat, y
+
+    @pytest.mark.parametrize("axes", [{"data": 2, "model": 4},
+                                      {"data": 1, "model": 8},
+                                      {"data": 8, "model": 1}])
+    def test_matches_single_device_oracle(self, axes):
+        from flink_ml_tpu.models.common.losses import logistic_loss
+        from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_mixed
+        from flink_ml_tpu.parallel.mesh import device_mesh
+
+        d = 1 << 10
+        dense, cat, y = self._data(d)
+        for cfg in (SGDConfig(learning_rate=0.4, global_batch_size=64,
+                              max_epochs=4, tol=0),
+                    SGDConfig(learning_rate=0.4, global_batch_size=64,
+                              max_epochs=4, tol=0, reg=0.02,
+                              elastic_net=0.25)):
+            oracle, oracle_log = sgd_fit_mixed(
+                logistic_loss, dense, cat, y, None, d, cfg,
+                mesh=device_mesh({"data": 1},
+                                 devices=_jax.devices()[:1]))
+            got, got_log = sgd_fit_mixed(
+                logistic_loss, dense, cat, y, None, d, cfg,
+                mesh=device_mesh(axes))
+            np.testing.assert_allclose(got.coefficients,
+                                       oracle.coefficients,
+                                       rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(got.intercept, oracle.intercept,
+                                       rtol=1e-4, atol=1e-6)
+            np.testing.assert_allclose(got_log, oracle_log,
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_rejects_indivisible_hash_space(self):
+        from flink_ml_tpu.models.common.losses import logistic_loss
+        from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_mixed
+        from flink_ml_tpu.parallel.mesh import device_mesh
+
+        dense, cat, y = self._data(1001)
+        with pytest.raises(ValueError, match="divide the model axis"):
+            sgd_fit_mixed(logistic_loss, dense, cat, y, None, 1001,
+                          SGDConfig(max_epochs=1),
+                          mesh=device_mesh({"data": 1, "model": 8}))
