@@ -163,6 +163,18 @@ class GBTClassifier(GBTEstimatorBase):
         p = _sigmoid(pred)
         return p - y, np.maximum(p * (1.0 - p), 1e-12)
 
+    def _streaming_labels(self, y_raw: np.ndarray) -> np.ndarray:
+        y = np.asarray(y_raw, np.float64)
+        bad = ~np.isin(y, (0.0, 1.0))
+        if bad.any():
+            raise ValueError(
+                "fit_outofcore needs 0/1 labels (a streamed fit cannot "
+                f"inventory arbitrary label values); got {y[bad][:3]}")
+        return y
+
+    def _streaming_label_values(self):
+        return np.asarray([0.0, 1.0])
+
     def _base_score(self, y) -> float:
         p = np.clip(y.mean(), 1e-6, 1 - 1e-6)
         return float(np.log(p / (1.0 - p)))

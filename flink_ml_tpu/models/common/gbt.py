@@ -22,9 +22,11 @@ hosted (each tree depends on the previous residuals).
 
 from __future__ import annotations
 
+import os
+
 from dataclasses import dataclass
 from functools import partial
-from typing import Callable, List, Tuple
+from typing import Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -57,18 +59,23 @@ class Forest:
     learning_rate: float
 
 
-def bin_features(X: np.ndarray, max_bins: int) -> Tuple[np.ndarray, np.ndarray]:
-    """Quantile binning on host: (binned int32 (n, d), edges (d, bins-1))."""
-    n, d = X.shape
+def quantile_edges(X: np.ndarray, max_bins: int) -> np.ndarray:
+    """Per-feature quantile edges (d, bins-1) — the sketch half of
+    :func:`bin_features` (the out-of-core trainer needs only this from
+    its bounded leading sample)."""
+    d = X.shape[1]
     edges = np.empty((d, max_bins - 1))
-    binned = np.empty((n, d), np.int32)
     qs = np.linspace(0, 1, max_bins + 1)[1:-1]
     for j in range(d):
-        e = np.quantile(X[:, j], qs)
-        # strictly increasing edges (duplicates collapse constant regions)
-        edges[j] = e
-        binned[:, j] = np.searchsorted(e, X[:, j], side="left")
-    return binned, edges
+        # duplicates collapse constant regions
+        edges[j] = np.quantile(X[:, j], qs)
+    return edges
+
+
+def bin_features(X: np.ndarray, max_bins: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Quantile binning on host: (binned int32 (n, d), edges (d, bins-1))."""
+    edges = quantile_edges(X, max_bins)
+    return apply_bins(X, edges), edges
 
 
 def apply_bins(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
@@ -78,21 +85,32 @@ def apply_bins(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
     return binned
 
 
-@partial(jax.jit, static_argnames=("n_nodes", "d", "bins", "reg_lambda",
-                                   "min_child_weight"))
-def _build_level(binned, node_ids, grad, hess, n_nodes: int,
-                 d: int, bins: int, reg_lambda: float,
-                 min_child_weight: float):
-    """One tree level for all ``n_nodes`` nodes at once.
+@jax.jit
+def apply_bins_device(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
+    """Vectorized on-device twin of :func:`apply_bins`:
+    ``bin = #edges strictly below x`` (== searchsorted side='left' for
+    quantile edges), with NaN routed to the LAST bin exactly as
+    np.searchsorted sorts it.  One fused (n, d, bins-1) compare+sum
+    instead of a per-feature loop.
 
-    Returns (feature (n_nodes,), threshold (n_nodes,), gain (n_nodes,),
-    new_node_ids (n,)).  ``node_ids`` are level-local in [0, n_nodes) with
-    -1 marking rows already settled in a leaf.
-    """
-    n = binned.shape[0]
+    Precision caveat: runs at the device dtype (f32 without jax x64), so
+    rows within f32 rounding of an edge can bin differently from the
+    f64 host path — use it for f32-native device-resident pipelines; the
+    out-of-core trainer host-bins to stay bit-identical with in-core
+    training AND with predict-time binning."""
+    count = jnp.sum(X[:, :, None] > edges[None, :, :], axis=-1,
+                    dtype=jnp.int32)
+    return jnp.where(jnp.isnan(X), edges.shape[1], count)
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "d", "bins"))
+def _level_histograms(binned, node_ids, grad, hess, n_nodes: int,
+                      d: int, bins: int):
+    """Per-(node, feature, bin) grad/hess sums for one level — the
+    ADDITIVE piece of split finding: the out-of-core trainer accumulates
+    these over streamed batches and decides splits from the totals."""
     live = node_ids >= 0
     safe_node = jnp.where(live, node_ids, 0)
-
     # (node, feature, bin) -> flat key; dead rows land in a scratch key 0
     # with zero weights
     keys = (safe_node[:, None] * (d * bins)
@@ -105,9 +123,14 @@ def _build_level(binned, node_ids, grad, hess, n_nodes: int,
                                  flat, seg)
     h_hist = jax.ops.segment_sum((hess * w)[:, None].repeat(d, 1).reshape(-1),
                                  flat, seg)
-    g_hist = g_hist.reshape(n_nodes, d, bins)
-    h_hist = h_hist.reshape(n_nodes, d, bins)
+    return (g_hist.reshape(n_nodes, d, bins),
+            h_hist.reshape(n_nodes, d, bins))
 
+
+def _level_splits(g_hist, h_hist, reg_lambda: float,
+                  min_child_weight: float):
+    """Best (feature, bin, gain) per node from the level histograms."""
+    n_nodes, d, bins = g_hist.shape
     g_tot = jnp.sum(g_hist, axis=(1, 2)) / d                    # per node
     h_tot = jnp.sum(h_hist, axis=(1, 2)) / d
 
@@ -133,15 +156,43 @@ def _build_level(binned, node_ids, grad, hess, n_nodes: int,
     best_gain = jnp.take_along_axis(flat_gain, best[:, None], 1)[:, 0]
     best_feature = (best // bins).astype(jnp.int32)
     best_bin = (best % bins).astype(jnp.int32)
+    return best_feature, best_bin, best_gain
 
-    # route rows: live rows whose node split go to 2*node (+1 for right) in
-    # the next level's local numbering
+
+def _apply_split(binned, node_ids, best_feature, best_bin, best_gain):
+    """Route live rows through the level's chosen splits: 2*node (+1 for
+    right) in the next level's local numbering, -1 where the node did not
+    split."""
+    live = node_ids >= 0
+    safe_node = jnp.where(live, node_ids, 0)
     row_bin = jnp.take_along_axis(binned, best_feature[safe_node][:, None],
                                   1)[:, 0]
     goes_right = row_bin > best_bin[safe_node]
     node_split = best_gain[safe_node] > 0
-    new_ids = jnp.where(live & node_split,
-                        2 * safe_node + goes_right.astype(jnp.int32), -1)
+    return jnp.where(live & node_split,
+                     2 * safe_node + goes_right.astype(jnp.int32), -1)
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "d", "bins", "reg_lambda",
+                                   "min_child_weight"))
+def _build_level(binned, node_ids, grad, hess, n_nodes: int,
+                 d: int, bins: int, reg_lambda: float,
+                 min_child_weight: float):
+    """One tree level for all ``n_nodes`` nodes at once
+    (histograms -> splits -> routing; the three pieces are separate
+    functions so the out-of-core trainer can accumulate histograms over
+    batches and reuse the identical split/routing math).
+
+    Returns (feature (n_nodes,), threshold (n_nodes,), gain (n_nodes,),
+    new_node_ids (n,)).  ``node_ids`` are level-local in [0, n_nodes) with
+    -1 marking rows already settled in a leaf.
+    """
+    g_hist, h_hist = _level_histograms(binned, node_ids, grad, hess,
+                                       n_nodes, d, bins)
+    best_feature, best_bin, best_gain = _level_splits(
+        g_hist, h_hist, reg_lambda, min_child_weight)
+    new_ids = _apply_split(binned, node_ids, best_feature, best_bin,
+                           best_gain)
     return best_feature, best_bin, best_gain, new_ids
 
 
@@ -235,6 +286,221 @@ def train_forest(X: np.ndarray, y: np.ndarray,
             d, config)
         pred = pred + config.learning_rate * np.asarray(tree_pred, np.float64)
 
+    return Forest(features, thresholds, values, edges, base_score,
+                  config.learning_rate)
+
+
+@partial(jax.jit, static_argnames=("n_nodes",))
+def _leaf_sums(node_ids, grad, hess, n_nodes: int):
+    """Per-node (G, H) sums — the additive form of :func:`_leaf_values`
+    for streamed batches."""
+    live = node_ids >= 0
+    safe = jnp.where(live, node_ids, 0)
+    w = live.astype(grad.dtype)
+    return (jax.ops.segment_sum(grad * w, safe, n_nodes),
+            jax.ops.segment_sum(hess * w, safe, n_nodes))
+
+
+@partial(jax.jit, static_argnames=("level",))
+def _route_to_level(binned, feature_rows, threshold_rows, level: int):
+    """Node ids entering ``level`` by walking the assembled tree-so-far
+    (level-major layout; ``feature == -1`` marks a non-splitting node,
+    matching :func:`_apply_split`'s ``gain > 0`` routing exactly)."""
+    ids = jnp.zeros((binned.shape[0],), jnp.int32)
+    base = 0
+    for lvl in range(level):
+        live = ids >= 0
+        safe = jnp.where(live, ids, 0)
+        gnode = base + safe
+        f = feature_rows[gnode]
+        thr = threshold_rows[gnode]
+        split = f >= 0
+        row_bin = jnp.take_along_axis(binned, jnp.maximum(f, 0)[:, None],
+                                      1)[:, 0]
+        ids = jnp.where(live & split,
+                        2 * safe + (row_bin > thr).astype(jnp.int32), -1)
+        base += 2 ** lvl
+    return ids
+
+
+def train_forest_outofcore(make_reader, grad_hess, base_score,
+                           config: GBTConfig, *,
+                           features_key: str = "features",
+                           label_key: str = "label",
+                           work_dir: Optional[str] = None,
+                           sample_rows: int = 1 << 18,
+                           batch_device_rows: int = 1 << 16) -> Forest:
+    """Out-of-core :func:`train_forest`: the dataset streams from
+    ``make_reader()`` (a fresh iterator of host batch dicts per call —
+    the ``sgd_fit_outofcore`` protocol) instead of living in RAM/HBM,
+    removing the one estimator family with a host-memory ceiling
+    (VERDICT r2 task 9).
+
+    Design: histogram building is ADDITIVE over row batches, so each tree
+    level is one streamed pass accumulating ``_level_histograms`` on
+    device, followed by the same ``_level_splits`` decision the in-core
+    path uses — the classic out-of-core GBDT recipe, with the reference's
+    replay-per-epoch posture (``ReplayOperator``) supplying the passes.
+
+    - Bin edges come from the stream's leading ``sample_rows`` rows
+      (quantile sketching on a bounded sample); each batch then bins
+      through the HOST searchsorted (bit-identical to in-core training
+      and to predict-time binning; see :func:`apply_bins_device` for why
+      the f32 device variant is not used here).
+    - The binned matrix is written once to a :class:`DataCacheWriter`
+      cache in a fresh run directory under ``work_dir`` (uint8 when
+      ``max_bins <= 256``: 4x smaller than the raw f32 stream), every
+      later pass replays the cache, and the run directory is removed on
+      return (margins included).
+    - Per-row boosting margins live in a disk-backed memmap (float64,
+      8 bytes/row — the only O(n) state).
+    - ``base_score`` may be a float or a callable receiving the leading
+      sample's labels (folds the estimator's base-score computation into
+      pass A instead of an extra head read).
+
+    Passes per tree: ``max_depth`` histogram passes + one leaf-sum pass +
+    one margin-update pass.  Results match :func:`train_forest` on the
+    same rows up to f32 accumulation order (asserted in tests).
+    """
+    import shutil
+    import tempfile
+
+    from ...data.datacache import DataCacheReader, DataCacheWriter
+
+    bins = config.max_bins
+    depth = config.max_depth
+
+    # pass A: edges (and optionally the base score) from the leading sample
+    sample: List[np.ndarray] = []
+    sample_y: List[np.ndarray] = []
+    seen = 0
+    for batch in make_reader():
+        sample.append(np.asarray(batch[features_key], np.float64))
+        sample_y.append(np.asarray(batch[label_key], np.float64))
+        seen += len(sample[-1])
+        if seen >= sample_rows:
+            break
+    if not sample:
+        raise ValueError("make_reader() returned an empty stream")
+    Xs = np.concatenate(sample)[:sample_rows]
+    d = Xs.shape[1]
+    edges = quantile_edges(Xs, bins)
+    if callable(base_score):
+        base_score = float(base_score(np.concatenate(sample_y)[:sample_rows]))
+    del sample, sample_y, Xs
+
+    # pass B: binned cache + labels, in a unique per-fit run directory
+    # (DataCacheWriter refuses dirty directories; retries and repeated
+    # fits against one work_dir must each get a fresh cache)
+    if work_dir is not None:
+        os.makedirs(work_dir, exist_ok=True)
+    run_dir = tempfile.mkdtemp(prefix="gbt-run-", dir=work_dir)
+    try:
+        cache_dir = os.path.join(run_dir, "binned")
+        bin_dtype = np.uint8 if bins <= 256 else np.int32
+        writer = DataCacheWriter(cache_dir, segment_rows=1 << 20)
+        n = 0
+        for batch in make_reader():
+            X = np.asarray(batch[features_key], np.float64)
+            b = apply_bins(X, edges).astype(bin_dtype)
+            writer.append({"binned": b,
+                           "label": np.asarray(batch[label_key],
+                                               np.float64)})
+            n += len(b)
+        writer.finish()
+        margins = np.memmap(os.path.join(run_dir, "margins.f64"),
+                            np.float64, mode="w+", shape=(n,))
+        margins[:] = base_score
+
+        def cache_batches():
+            """(slice, binned int32 device, y f64, margins f64) batches."""
+            reader = DataCacheReader(cache_dir,
+                                     batch_rows=batch_device_rows)
+            start = 0
+            for batch in reader:
+                rows = len(batch["label"])
+                sl = slice(start, start + rows)
+                start += rows
+                yield (sl, jnp.asarray(batch["binned"].astype(np.int32)),
+                       np.asarray(batch["label"], np.float64), margins[sl])
+
+        return _boost_outofcore(cache_batches, margins, grad_hess,
+                                base_score, edges, n, d, config)
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+
+def _boost_outofcore(cache_batches, margins, grad_hess, base_score: float,
+                     edges: np.ndarray, n: int, d: int,
+                     config: GBTConfig) -> Forest:
+    bins = config.max_bins
+    depth = config.max_depth
+
+    n_nodes_total = 2 ** (depth + 1) - 1
+    features = np.full((config.num_trees, n_nodes_total), -1, np.int32)
+    thresholds = np.zeros((config.num_trees, n_nodes_total), np.int32)
+    values = np.zeros((config.num_trees, n_nodes_total), np.float32)
+
+    for t in range(config.num_trees):
+        feature_row = np.full((n_nodes_total,), -1, np.int32)
+        threshold_row = np.zeros((n_nodes_total,), np.int32)
+        value_row = np.zeros((n_nodes_total,), np.float32)
+        base = 0
+        for level in range(depth):
+            n_nodes = 2 ** level
+            g_hist = h_hist = None
+            f_dev = jnp.asarray(feature_row)
+            thr_dev = jnp.asarray(threshold_row)
+            for sl, binned_b, y_b, m_b in cache_batches():
+                g, h = grad_hess(y_b, m_b)
+                ids = _route_to_level(binned_b, f_dev, thr_dev, level)
+                gh, hh = _level_histograms(
+                    binned_b, ids, jnp.asarray(g, jnp.float32),
+                    jnp.asarray(h, jnp.float32), n_nodes, d, bins)
+                g_hist = gh if g_hist is None else g_hist + gh
+                h_hist = hh if h_hist is None else h_hist + hh
+            bf, bb, bg = _level_splits(g_hist, h_hist, config.reg_lambda,
+                                       config.min_child_weight)
+            bf, bb, bg = np.asarray(bf), np.asarray(bb), np.asarray(bg)
+            split = bg > 0
+            feature_row[base:base + n_nodes] = np.where(split, bf, -1)
+            threshold_row[base:base + n_nodes] = bb
+            # leaf value for rows that STOP at this level: Newton step on
+            # the per-node totals the histograms already carry
+            g_tot = np.asarray(jnp.sum(g_hist, axis=(1, 2))) / d
+            h_tot = np.asarray(jnp.sum(h_hist, axis=(1, 2))) / d
+            vals = -g_tot / (h_tot + config.reg_lambda)
+            value_row[base:base + n_nodes] = np.where(split, 0.0, vals)
+            base += n_nodes
+
+        # deepest level: always leaves — one leaf-sum pass
+        n_nodes = 2 ** depth
+        G = np.zeros((n_nodes,), np.float64)
+        H = np.zeros((n_nodes,), np.float64)
+        f_dev = jnp.asarray(feature_row)
+        thr_dev = jnp.asarray(threshold_row)
+        for sl, binned_b, y_b, m_b in cache_batches():
+            g, h = grad_hess(y_b, m_b)
+            ids = _route_to_level(binned_b, f_dev, thr_dev, depth)
+            gs, hs = _leaf_sums(ids, jnp.asarray(g, jnp.float32),
+                                jnp.asarray(h, jnp.float32), n_nodes)
+            G += np.asarray(gs, np.float64)
+            H += np.asarray(hs, np.float64)
+        value_row[base:base + n_nodes] = (
+            -G / (H + config.reg_lambda)).astype(np.float32)
+
+        # margin-update pass
+        feat_dev = jnp.asarray(feature_row)
+        thr_dev = jnp.asarray(threshold_row)
+        val_dev = jnp.asarray(value_row)
+        for sl, binned_b, _, _ in cache_batches():
+            pred = _predict_tree_jit(binned_b, feat_dev, thr_dev, val_dev,
+                                     depth)
+            margins[sl] += config.learning_rate * np.asarray(pred,
+                                                             np.float64)
+        features[t], thresholds[t], values[t] = (feature_row,
+                                                 threshold_row, value_row)
+    margins.flush()
     return Forest(features, thresholds, values, edges, base_score,
                   config.learning_rate)
 

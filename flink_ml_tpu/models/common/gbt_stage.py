@@ -162,6 +162,50 @@ class GBTEstimatorBase(GBTParams, Estimator):
         self._finalize_model(model, label_values)
         return model
 
+    def fit_outofcore(self, make_reader, *, features_key: str = None,
+                      label_key: str = None, work_dir: str = None,
+                      sample_rows: int = 1 << 18):
+        """Out-of-core ``fit`` (see ``gbt.train_forest_outofcore``): the
+        dataset streams from ``make_reader()`` — a fresh iterator of host
+        batch dicts per call (``{features_key: (b, d) float, label_key:
+        (b,) labels}``, e.g. a re-seeked ``DataCacheReader``) — instead
+        of living in RAM; per-row state is one f64 margin memmap.
+
+        Binary-classification label note: the streamed labels must
+        already be 0/1 floats (the in-core fit's arbitrary-label mapping
+        needs the full label set up front)."""
+        from .gbt import train_forest_outofcore
+
+        def prepared_reader():
+            for batch in make_reader():
+                y = self._streaming_labels(
+                    np.asarray(batch[label_key or self.get_label_col()]))
+                yield {"features": np.asarray(
+                    batch[features_key or self.get_features_col()]),
+                    "label": y}
+
+        # base score folds into the trainer's pass A over the same
+        # leading sample (no extra head read of a slow source)
+        forest = train_forest_outofcore(
+            prepared_reader, self._grad_hess, self._base_score,
+            self._config(), work_dir=work_dir, sample_rows=sample_rows)
+        model = self.model_cls()
+        model.copy_params_from(self)
+        model._forest = forest
+        self._finalize_model(model, self._streaming_label_values())
+        return model
+
+    def _streaming_labels(self, y_raw: np.ndarray) -> np.ndarray:
+        """Per-batch label prep for fit_outofcore.  Unlike
+        ``_prepare_labels``, this must be BATCH-LOCAL (no global label
+        inventory); the default passes float targets through."""
+        return np.asarray(y_raw, np.float64)
+
+    def _streaming_label_values(self):
+        """Label set installed on the streamed-fit model (None for
+        regressors)."""
+        return None
+
     def _finalize_model(self, model, label_values) -> None:
         """Hook for subclasses (e.g. install the label mapping)."""
 
