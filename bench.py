@@ -214,8 +214,11 @@ def bench_logreg(results: dict) -> None:
     def device_layout(cat):
         from flink_ml_tpu.ops.ell_scatter import ell_layout_device
 
-        lay = ell_layout_device(cat, LR_DIM)
-        return (lay.src, lay.pos, lay.mask, lay.ovf_idx, lay.ovf_src)
+        # ovf_cap sized for the post-heavy residual: with the marker
+        # feature routed to the heavy path, spill is the Poisson tail
+        lay = ell_layout_device(cat, LR_DIM, ovf_cap=1 << 13)
+        return (lay.src, lay.pos, lay.mask, lay.ovf_idx, lay.ovf_src,
+                lay.heavy_idx, lay.heavy_cnt)
 
     if impl == "ell":
         ell_update = _mixed_update_ell(logistic_loss, cfg)

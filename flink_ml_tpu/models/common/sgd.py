@@ -396,9 +396,10 @@ def _mixed_update_ell(loss_fn: LossFn, config: SGDConfig,
     regularization algebra, but the categorical scatter goes through the
     static ELL routing (``ops/ell_scatter.py``) instead of XLA's
     per-element scatter — ~2.5x faster per step on v5e.  The extra batch
-    arguments (src, pos, mask, ovf_idx, ovf_src) are the per-step layout
-    stacks produced by ``ell_layout`` at fit time; results differ from
-    the XLA path only in f32 summation order."""
+    arguments (src, pos, mask, ovf_idx, ovf_src, heavy_idx, heavy_cnt)
+    are the per-step layout stacks produced by ``ell_layout`` at fit
+    time; results differ from the XLA path only in f32 summation
+    order."""
     from ...ops.ell_scatter import ell_scatter_apply, ell_scatter_apply_xla
 
     lr = config.learning_rate
@@ -406,7 +407,7 @@ def _mixed_update_ell(loss_fn: LossFn, config: SGDConfig,
     apply_ell = ell_scatter_apply if use_pallas else ell_scatter_apply_xla
 
     def update(params, dense, cat, src, pos, mask, ovf_idx, ovf_src,
-               yb, wb):
+               heavy_idx, heavy_cnt, yb, wb):
         w, b = params["w"], params["b"]
         n_dense = dense.shape[-1]
         margin = (dense @ w[:n_dense]
@@ -423,6 +424,11 @@ def _mixed_update_ell(loss_fn: LossFn, config: SGDConfig,
         def apply_grad(w):
             w = apply_ell(w, u, pos, mask)
             w = w.at[ovf_idx].add((-lr) * r_ext[ovf_src])
+            # heavy hitters: one (H, batch) @ (batch,) matvec replaces
+            # their thousands of per-slot updates (padding entries carry
+            # zero counts and add 0 at w[0])
+            w = w.at[heavy_idx].add(
+                (-lr) * (heavy_cnt.astype(jnp.float32) @ r))
             return w.at[:n_dense].add(-lr * (r @ dense))
 
         return finish(w, b, value, r, apply_grad)
@@ -636,7 +642,8 @@ def sgd_fit_mixed(loss_fn: LossFn, dense_features: np.ndarray,
 
         layout = ell_layout(cat, num_features)
         extra = (layout.src, layout.pos, layout.mask,
-                 layout.ovf_idx, layout.ovf_src)
+                 layout.ovf_idx, layout.ovf_src,
+                 layout.heavy_idx, layout.heavy_cnt)
         update = _mixed_update_ell(loss_fn, config)
     elif impl == "sharded":
         # weight sharded over the model axis (2^24+ hash spaces never

@@ -78,6 +78,13 @@ class EllLayout:
     """Static per-step routing for :func:`ell_scatter_apply`.
 
     All arrays are per-step stacks: leading dim = steps.
+
+    Heavy hitters: an index occurring more than ``heavy_threshold`` times
+    in a step (power-law categories — label markers, dominant tokens;
+    real Criteo categorical frequencies are Zipfian) would flood a
+    per-slot path, so ALL its slots leave the ELL grid for a dense count
+    matrix: its update is ``-lr * (counts @ r)`` — one tiny matmul plus
+    an H-element scatter instead of thousands of per-slot ops.
     """
     src: jnp.ndarray       # (steps, rows, 128) i32: batch row charged, or
                            #   ``batch`` (points at the zero pad of r_ext)
@@ -85,6 +92,9 @@ class EllLayout:
     mask: jnp.ndarray      # (steps, rows, 128) f32: 0 where P was empty
     ovf_idx: jnp.ndarray   # (steps, cap) i32: overflow weight indices (0 pad)
     ovf_src: jnp.ndarray   # (steps, cap) i32: overflow batch rows (batch pad)
+    heavy_idx: jnp.ndarray  # (steps, H) i32: heavy indices (0 pad)
+    heavy_cnt: jnp.ndarray  # (steps, H, batch) i16: per-row counts
+                            #   (all-zero rows for padding entries)
     batch: int             # rows per batch (r vector length)
     num_features: int
 
@@ -93,8 +103,23 @@ class EllLayout:
         return self.src.shape[0]
 
 
-def _ell_one_step(flat: np.ndarray, batch: int, nnz: int, rows: int
-                  ) -> Tuple[np.ndarray, ...]:
+HEAVY_THRESHOLD = 512   # slots per index per step before the dense path
+
+
+def _check_heavy_threshold(heavy_threshold: int) -> None:
+    """A threshold below ELL_WIDTH would let a heavy run inflate the raw
+    ``pos`` of kept same-row slots past their rank among kept slots, so
+    their cumsum picks would read the zero pad — silently dropped
+    updates.  With threshold >= ELL_WIDTH every slot after a heavy run
+    has pos > 127 and routes to overflow, which is exact."""
+    if heavy_threshold < ELL_WIDTH:
+        raise ValueError(
+            f"heavy_threshold must be >= ELL_WIDTH ({ELL_WIDTH}); "
+            f"got {heavy_threshold}")
+
+
+def _ell_one_step(flat: np.ndarray, batch: int, nnz: int, rows: int,
+                  heavy_threshold: int) -> Tuple[np.ndarray, ...]:
     """Host layout for one step's flattened indices (batch*nnz,)."""
     b_of = np.repeat(np.arange(batch, dtype=np.int32), nnz)
     order = np.argsort(flat, kind="stable")
@@ -104,7 +129,13 @@ def _ell_one_step(flat: np.ndarray, batch: int, nnz: int, rows: int
     lo = (sidx & 127).astype(np.int32)
     starts = np.searchsorted(row, np.arange(rows, dtype=np.int64))
     pos = np.arange(flat.size, dtype=np.int64) - starts[row]
-    keep = pos < ELL_WIDTH
+    # heavy indices: the whole run leaves the per-slot paths (positions of
+    # later same-row slots keep counting past them — a heavy row's other
+    # slots simply overflow, a negligible cost next to the run itself)
+    run_start = np.searchsorted(sidx, sidx, side="left")
+    run_end = np.searchsorted(sidx, sidx, side="right")
+    heavy_slot = (run_end - run_start) > heavy_threshold
+    keep = (pos < ELL_WIDTH) & ~heavy_slot
 
     src = np.full((rows, ELL_WIDTH), batch, np.int32)
     src[row[keep], pos[keep]] = ssrc[keep]
@@ -114,41 +145,60 @@ def _ell_one_step(flat: np.ndarray, batch: int, nnz: int, rows: int
     mask = (P >= 0).astype(np.float32)
     Pc = np.maximum(P, 0).astype(np.int32)
 
-    ovf_idx = sidx[~keep].astype(np.int32)
-    ovf_src = ssrc[~keep]
-    return src, Pc, mask, ovf_idx, ovf_src
+    spill = ~keep & ~heavy_slot
+    ovf_idx = sidx[spill].astype(np.int32)
+    ovf_src = ssrc[spill]
+
+    h_idx = np.unique(sidx[heavy_slot]).astype(np.int32)
+    h_cnt = np.zeros((h_idx.size, batch), np.int16)
+    if h_idx.size:
+        h_rank = np.searchsorted(h_idx, sidx[heavy_slot])
+        np.add.at(h_cnt, (h_rank, ssrc[heavy_slot]), 1)
+    return src, Pc, mask, ovf_idx, ovf_src, h_idx, h_cnt
 
 
-def ell_layout(cat_indices: np.ndarray, num_features: int) -> EllLayout:
+def ell_layout(cat_indices: np.ndarray, num_features: int,
+               heavy_threshold: int = HEAVY_THRESHOLD) -> EllLayout:
     """Build the static routing from a ``(steps, batch, nnz)`` int epoch
     tensor of categorical indices (host numpy; one-time per fit)."""
+    _check_heavy_threshold(heavy_threshold)
     steps, batch, nnz = cat_indices.shape
     rows = num_features // _LANES
     outs = [_ell_one_step(np.asarray(cat_indices[s], np.int64).reshape(-1),
-                          batch, nnz, rows)
+                          batch, nnz, rows, heavy_threshold)
             for s in range(steps)]
     cap = max(8, max(o[3].size for o in outs))
     cap += (-cap) % 8
     ovf_idx = np.zeros((steps, cap), np.int32)
     ovf_src = np.full((steps, cap), batch, np.int32)
+    H = max(1, max(o[5].size for o in outs))
+    heavy_idx = np.zeros((steps, H), np.int32)
+    heavy_cnt = np.zeros((steps, H, batch), np.int16)
     for s, o in enumerate(outs):
         ovf_idx[s, :o[3].size] = o[3]
         ovf_src[s, :o[4].size] = o[4]
+        heavy_idx[s, :o[5].size] = o[5]
+        heavy_cnt[s, :o[6].shape[0]] = o[6]
     return EllLayout(
         src=jnp.asarray(np.stack([o[0] for o in outs])),
         pos=jnp.asarray(np.stack([o[1] for o in outs])),
         mask=jnp.asarray(np.stack([o[2] for o in outs])),
         ovf_idx=jnp.asarray(ovf_idx), ovf_src=jnp.asarray(ovf_src),
+        heavy_idx=jnp.asarray(heavy_idx), heavy_cnt=jnp.asarray(heavy_cnt),
         batch=batch, num_features=num_features)
 
 
 def ell_layout_device(cat_indices: jnp.ndarray, num_features: int,
-                      ovf_cap: int = 1 << 16) -> EllLayout:
+                      ovf_cap: int = 1 << 16, heavy_cap: int = 8,
+                      heavy_threshold: int = HEAVY_THRESHOLD) -> EllLayout:
     """Device-side layout builder (jit, vmapped over steps) for callers
     whose epoch tensor already lives in HBM (e.g. the benchmark, where
     host round-trips are prohibitively slow through a tunnel).  Overflow
-    capacity is static; slots beyond it are dropped, so callers must
-    check ``ovf_cap`` generously exceeds the worst heavy-hitter mass."""
+    and heavy capacities are static; slots beyond them are dropped, so
+    callers must size ``ovf_cap``/``heavy_cap`` generously for their
+    distribution (the bench asserts the kernel path against the XLA
+    oracle before timing, which catches an undersized cap)."""
+    _check_heavy_threshold(heavy_threshold)
     steps, batch, nnz = cat_indices.shape
     rows = num_features // _LANES
     b_of = jnp.repeat(jnp.arange(batch, dtype=jnp.int32), nnz)
@@ -163,7 +213,10 @@ def ell_layout_device(cat_indices: jnp.ndarray, num_features: int,
         lo = (sidx & 127).astype(jnp.int32)
         starts = jnp.searchsorted(row, jnp.arange(rows, dtype=sidx.dtype))
         pos = jnp.arange(flat.size, dtype=jnp.int32) - starts[row]
-        keep = pos < ELL_WIDTH
+        run_start = jnp.searchsorted(sidx, sidx, side="left")
+        run_end = jnp.searchsorted(sidx, sidx, side="right")
+        heavy_slot = (run_end - run_start) > heavy_threshold
+        keep = (pos < ELL_WIDTH) & ~heavy_slot
         src = jnp.full((rows, ELL_WIDTH), batch, jnp.int32)
         # overflow slots target column ELL_WIDTH, which mode="drop"
         # discards (an in-bounds dummy would race the real slot there)
@@ -174,19 +227,31 @@ def ell_layout_device(cat_indices: jnp.ndarray, num_features: int,
         P = jnp.cumsum(hist, axis=1) - 1
         mask = (P >= 0).astype(jnp.float32)
         Pc = jnp.maximum(P, 0).astype(jnp.int32)
-        ovf_slot = jnp.cumsum((~keep).astype(jnp.int32)) - 1
+        spill = ~keep & ~heavy_slot
+        ovf_slot = jnp.cumsum(spill.astype(jnp.int32)) - 1
         ovf_i = jnp.zeros((ovf_cap,), jnp.int32).at[
-            jnp.where(~keep, ovf_slot, ovf_cap)].set(
-            jnp.where(~keep, sidx.astype(jnp.int32), 0), mode="drop")
+            jnp.where(spill, ovf_slot, ovf_cap)].set(
+            jnp.where(spill, sidx.astype(jnp.int32), 0), mode="drop")
         ovf_s = jnp.full((ovf_cap,), batch, jnp.int32).at[
-            jnp.where(~keep, ovf_slot, ovf_cap)].set(
-            jnp.where(~keep, ssrc, batch), mode="drop")
-        return src, Pc, mask, ovf_i, ovf_s
+            jnp.where(spill, ovf_slot, ovf_cap)].set(
+            jnp.where(spill, ssrc, batch), mode="drop")
+        # heavy runs: rank = number of heavy runs starting at or before
+        # this slot - 1 (first-occurrence compaction)
+        is_first = jnp.arange(flat.size, dtype=jnp.int32) == run_start
+        h_rank = jnp.cumsum((is_first & heavy_slot).astype(jnp.int32)) - 1
+        h_i = jnp.zeros((heavy_cap,), jnp.int32).at[
+            jnp.where(is_first & heavy_slot, h_rank, heavy_cap)].set(
+            jnp.where(heavy_slot, sidx.astype(jnp.int32), 0), mode="drop")
+        h_c = jnp.zeros((heavy_cap, batch), jnp.int16).at[
+            jnp.where(heavy_slot, h_rank, heavy_cap), ssrc].add(
+            1, mode="drop")
+        return src, Pc, mask, ovf_i, ovf_s, h_i, h_c
 
-    src, Pc, mask, ovf_i, ovf_s = build(
+    src, Pc, mask, ovf_i, ovf_s, h_i, h_c = build(
         cat_indices.reshape(steps, -1).astype(jnp.int32))
     return EllLayout(src=src, pos=Pc, mask=mask, ovf_idx=ovf_i,
-                     ovf_src=ovf_s, batch=batch, num_features=num_features)
+                     ovf_src=ovf_s, heavy_idx=h_i, heavy_cnt=h_c,
+                     batch=batch, num_features=num_features)
 
 
 def _kernel(block_rows: int):

@@ -38,6 +38,9 @@ def _scatter_reference(d, layout, r_ext, lr, step=0):
     w += delta.reshape(-1)
     np.add.at(w, np.asarray(layout.ovf_idx[step]),
               -lr * r_ext[np.asarray(layout.ovf_src[step])])
+    np.add.at(w, np.asarray(layout.heavy_idx[step]),
+              -lr * (np.asarray(layout.heavy_cnt[step], np.float64)
+                     @ r_ext[:layout.batch]))
     return w
 
 
@@ -68,17 +71,37 @@ class TestLayout:
             np.testing.assert_allclose(got, want, atol=1e-5)
 
     def test_heavy_hitter_overflows(self):
-        # one index receives every slot: ELL keeps 128, rest overflow
+        # one index receives every slot: below the heavy threshold it
+        # splits ELL (128) + overflow (the rest)
         d, batch, nnz = 128 * 128, 300, 2
         cat = np.full((1, batch, nnz), 777, np.int32)
         r = np.ones(batch, np.float32)
-        layout = ell_layout(cat, d)
+        layout = ell_layout(cat, d, heavy_threshold=10_000)
         n_ovf = int((np.asarray(layout.ovf_src[0]) != batch).sum())
         assert n_ovf == batch * nnz - ELL_WIDTH
         r_ext = np.concatenate([r, np.zeros(1, np.float32)])
         got = _scatter_reference(d, layout, r_ext, 1.0)
         want = _direct_scatter(d, cat[0], r, 1.0)
         np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_heavy_hitter_dense_path(self):
+        # above the threshold the whole run routes to the count matrix
+        rng = np.random.default_rng(8)
+        d, batch, nnz = 128 * 128, 400, 4
+        cat = rng.integers(0, d, size=(1, batch, nnz)).astype(np.int32)
+        cat[:, :, 0] = 777          # 400 slots > threshold 300
+        cat[:, ::2, 1] = 778        # 200 slots < threshold: stays per-slot
+        r = rng.normal(size=batch).astype(np.float32)
+        layout = ell_layout(cat, d, heavy_threshold=300)
+        h_idx = np.asarray(layout.heavy_idx[0])
+        assert 777 in h_idx and 778 not in h_idx
+        # heavy slots left the ELL grid and the overflow list
+        assert int((np.asarray(layout.ovf_src[0])
+                    != batch).sum()) < batch * nnz
+        r_ext = np.concatenate([r, np.zeros(1, np.float32)])
+        got = _scatter_reference(d, layout, r_ext, 0.7)
+        want = _direct_scatter(d, cat[0], r, 0.7)
+        np.testing.assert_allclose(got, want, atol=1e-4)
 
     def test_device_builder_agrees_with_host(self):
         rng = np.random.default_rng(1)
@@ -142,6 +165,7 @@ class TestMixedUpdateEll:
                                 jnp.asarray(cat[0]), layout.src[0],
                                 layout.pos[0], layout.mask[0],
                                 layout.ovf_idx[0], layout.ovf_src[0],
+                                layout.heavy_idx[0], layout.heavy_cnt[0],
                                 jnp.asarray(y), jnp.asarray(wb))
             np.testing.assert_allclose(np.asarray(got_loss),
                                        np.asarray(want_loss), rtol=1e-6)
@@ -177,3 +201,13 @@ class TestApplyPallas:
             jnp.asarray(w0), jnp.asarray(u), layout.pos[0],
             layout.mask[0]))
         np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_heavy_threshold_floor_enforced():
+    # threshold < ELL_WIDTH would silently drop kept-slot updates after a
+    # heavy run (pos inflated past rank); both builders must refuse it
+    cat = np.zeros((1, 8, 2), np.int32)
+    with pytest.raises(ValueError, match="heavy_threshold"):
+        ell_layout(cat, 128 * 128, heavy_threshold=64)
+    with pytest.raises(ValueError, match="heavy_threshold"):
+        ell_layout_device(jnp.asarray(cat), 128 * 128, heavy_threshold=64)
