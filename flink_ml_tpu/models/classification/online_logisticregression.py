@@ -130,31 +130,74 @@ class OnlineLogisticRegression(HasFeaturesCol, HasLabelCol, HasWeightCol,
         for t in windows_of(source, batch):
             yield extract(t)
 
-    def fit(self, *inputs) -> OnlineLogisticRegressionModel:
+    def fit(self, *inputs, **kwargs) -> OnlineLogisticRegressionModel:
         """``fit(stream)`` where stream is a Table (windowed by
         globalBatchSize) or any iterable of Tables (a live unbounded feed).
         Returns when the stream ends; the model then holds the latest
-        version plus history."""
+        version plus history.
+
+        ``checkpoint`` / ``resume`` (keyword-only) make the streaming fit
+        restartable: the FTRL state and the SOURCE CURSOR checkpoint
+        together (the reference's exactly-once posture, §3.4); on resume
+        the stream repositions before any window is pulled.  For a
+        genuinely live (non-replayable) feed, wrap it in
+        :class:`flink_ml_tpu.data.wal.WindowLog` so
+        consumed-but-uncheckpointed windows replay from its write-ahead
+        log.  Checkpointed fits must ``set_num_features`` (sniffing the
+        width would consume a live window before the cursor restores).
+        A resumed fit's ``version_history`` holds only post-resume
+        versions (earlier versions were emitted to the crashed process);
+        ``model_version`` still counts all epochs."""
         (source,) = inputs
+        checkpoint = kwargs.pop("checkpoint", None)
+        resume = bool(kwargs.pop("resume", False))
+        if kwargs:
+            raise TypeError(f"unexpected kwargs: {sorted(kwargs)}")
+        if checkpoint is not None and isinstance(source, Table):
+            # a bare Table has no cursor; window it explicitly so the
+            # checkpoint can reposition it on resume
+            from ...data.stream import CountWindows
+
+            source = CountWindows(source, self.get_global_batch_size())
+        if checkpoint is not None and not (
+                hasattr(source, "snapshot") and hasattr(source, "restore")):
+            raise ValueError(
+                "checkpointed streaming fit needs a source with a cursor "
+                "(snapshot/restore): resume would otherwise silently "
+                "re-train already-consumed windows.  Use CountWindows / "
+                "EventTimeWindows / DataCacheReader, or wrap a live feed "
+                "in flink_ml_tpu.data.wal.WindowLog")
         reg, alpha_mix = self.get_reg(), self.get_elastic_net()
         l1, l2 = reg * alpha_mix, reg * (1.0 - alpha_mix)
         alpha, beta = self.get_alpha(), self.get_beta()
 
-        batches = self._batches(source)
-        first = next(batches, None)
-        if first is None:
-            raise ValueError("OnlineLogisticRegression.fit got an empty stream")
-        sparse = first[0] == "sparse"
-        if sparse:
-            d = self.get_num_features() or first[4]
-            if not d:
+        d = self.get_num_features()
+        lead: list = []   # sniffed batches replayed ahead of the stream
+        if not d:
+            if checkpoint is not None:
                 raise ValueError(
-                    "hashed pair-column input needs numFeatures (the hash-"
-                    "space size); call set_num_features")
-            ftrl_step = _make_sparse_ftrl_step(alpha, beta, l1, l2)
+                    "checkpointed streaming fit needs set_num_features: "
+                    "sniffing the feature width would consume a window "
+                    "before the checkpoint cursor repositions the stream")
+            batches = self._batches(source)
+            first = next(batches, None)
+            if first is None:
+                raise ValueError(
+                    "OnlineLogisticRegression.fit got an empty stream")
+            if first[0] == "sparse":
+                d = first[4]
+                if not d:
+                    raise ValueError(
+                        "hashed pair-column input needs numFeatures (the "
+                        "hash-space size); call set_num_features")
+            else:
+                d = first[1].shape[1]
+            lead = [first]
         else:
-            d = first[1].shape[1]
-            ftrl_step = _make_ftrl_step(alpha, beta, l1, l2)
+            batches = None   # built lazily inside the adapter
+
+        sparse_step = _make_sparse_ftrl_step(alpha, beta, l1, l2)
+        dense_step = _make_ftrl_step(alpha, beta, l1, l2)
 
         w0 = (np.zeros((d,), np.float32) if self._initial_model is None
               else self._initial_model.astype(np.float32))
@@ -164,28 +207,50 @@ class OnlineLogisticRegression(HasFeaturesCol, HasLabelCol, HasWeightCol,
             "n": jnp.zeros((d,), jnp.float32),
         }
 
-        def rechain():
-            if sparse:
-                check_sparse_indices(first[1][0], d)
-            yield first[1:4]
-            for kind, feats, y, w, *_ in batches:
-                if (kind == "sparse") != sparse:
+        kind_seen: dict = {}
+
+        def payloads():
+            stream = batches if batches is not None \
+                else self._batches(source)
+            import itertools
+            for kind, feats, y, w, *_ in itertools.chain(lead, stream):
+                sparse = kind == "sparse"
+                if kind_seen.setdefault("sparse", sparse) != sparse:
                     raise ValueError(
                         "stream switched between dense and sparse features "
                         "mid-flight")
                 if sparse:
                     check_sparse_indices(feats[0], d)
+                elif feats.shape[1] != d:
+                    raise ValueError(
+                        f"dense stream width {feats.shape[1]} != "
+                        f"numFeatures {d}; fix set_num_features (or unset "
+                        "it to sniff the width)")
                 yield feats, y, w
+
+        class _CursorAdapter:
+            """Iterable of payloads whose snapshot/restore delegate to the
+            underlying windowed source (WindowLog, Count/EventTimeWindows,
+            DataCacheReader...) so the cursor rides the checkpoint."""
+
+            def __iter__(self):
+                return payloads()
+
+            def __getattr__(self, name):
+                if name in ("snapshot", "restore"):
+                    return getattr(source, name)  # AttributeError if absent
+                raise AttributeError(name)
 
         def body(state, epoch, data):
             feats, y, w = data
-            if sparse:
+            # pytree structure picks the kernel at trace time
+            if isinstance(feats, tuple):
                 idx, vals = feats
-                new_state, loss = ftrl_step(
+                new_state, loss = sparse_step(
                     state, jnp.asarray(idx), jnp.asarray(vals),
                     jnp.asarray(y), jnp.asarray(w))
             else:
-                new_state, loss = ftrl_step(
+                new_state, loss = dense_step(
                     state, jnp.asarray(feats), jnp.asarray(y),
                     jnp.asarray(w))
             return IterationBodyResult(new_state, outputs=loss)
@@ -201,10 +266,15 @@ class OnlineLogisticRegression(HasFeaturesCol, HasLabelCol, HasWeightCol,
                     versions.append(LinearState(w_host, 0.0))
 
         result = iterate(
-            body, state0, rechain(),
+            body, state0, _CursorAdapter(),
             config=IterationConfig(mode="hosted", jit=True),
             listeners=[VersionEmitter()],
+            checkpoint=checkpoint, resume=resume,
         )
+        if result.num_epochs == 0:
+            # a real resume always lands at >= 1 (saves fire only after an
+            # epoch), so zero epochs means an empty stream either way
+            raise ValueError("OnlineLogisticRegression.fit got an empty stream")
 
         final_w = np.asarray(jax.device_get(result.state["w"]), np.float64)
         model = OnlineLogisticRegressionModel()

@@ -109,3 +109,100 @@ def test_save_load(tmp_path):
     t = Table({"features": X})
     np.testing.assert_array_equal(loaded.transform(t)[0]["prediction"],
                                   model.transform(t)[0]["prediction"])
+
+
+class TestCheckpointedStreamingFit:
+    """fit(checkpoint=..., resume=...) + WindowLog: the estimator-level
+    exactly-once story for live feeds (VERDICT r2 missing #1)."""
+
+    def _windows(self, lo, hi, rng_seed=0):
+        rng = np.random.default_rng(rng_seed)
+        out = []
+        for i in range(lo, hi):
+            X = rng.normal(size=(32, 4)).astype(np.float64)
+            y = (X[:, 0] > 0).astype(np.float64)
+            out.append(Table({"features": X, "label": y}))
+        return out
+
+    def test_kill_and_resume_matches_uninterrupted(self, tmp_path):
+        from flink_ml_tpu.data.wal import WindowLog
+        from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+
+        windows = self._windows(0, 10)
+
+        def est():
+            return (OnlineLogisticRegression().set_num_features(4)
+                    .set_global_batch_size(32))
+
+        oracle = est().fit(iter(windows))
+
+        class Killed(RuntimeError):
+            pass
+
+        def killing_feed(wins, die_after):
+            for i, w in enumerate(wins):
+                if i == die_after:
+                    raise Killed()
+                yield w
+
+        wal = str(tmp_path / "wal")
+        ckpt = CheckpointConfig(str(tmp_path / "ckpt"), interval=3)
+        with pytest.raises(Killed):
+            est().fit(WindowLog(killing_feed(windows, 7), wal),
+                      checkpoint=ckpt)
+        # the live feed lost windows 0..6; the last cut was epoch 6
+        # (interval=3: saves at 3 and 6), so the WAL replays window 6 and
+        # 7..9 come live
+        resumed = est().fit(WindowLog(iter(windows[7:]), wal),
+                            checkpoint=ckpt, resume=True)
+        np.testing.assert_allclose(resumed._state.coefficients,
+                                   oracle._state.coefficients,
+                                   rtol=1e-6, atol=1e-8)
+        assert resumed.model_version == oracle.model_version == 10
+
+    def test_checkpoint_requires_num_features(self, tmp_path):
+        from flink_ml_tpu.data.stream import CountWindows
+        from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+
+        src = CountWindows(iter(self._windows(0, 2)), 32)  # has a cursor
+        with pytest.raises(ValueError, match="set_num_features"):
+            OnlineLogisticRegression().fit(
+                src, checkpoint=CheckpointConfig(str(tmp_path / "c")))
+
+    def test_bounded_table_checkpoint_resume(self, tmp_path):
+        from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(320, 4))
+        y = (X[:, 0] > 0).astype(np.float64)
+        t = Table({"features": X, "label": y})
+
+        def est():
+            return (OnlineLogisticRegression().set_num_features(4)
+                    .set_global_batch_size(32))
+
+        oracle = est().fit(t)
+        ckpt = CheckpointConfig(str(tmp_path / "ckpt"), interval=4)
+        full = est().fit(t, checkpoint=ckpt)
+        np.testing.assert_allclose(full._state.coefficients,
+                                   oracle._state.coefficients)
+        # resume from the last periodic cut (epoch 8 of 10 — stream_end
+        # breaks before a final save): windows 8..9 retrain via the
+        # cursor's DETERMINISTIC replay, reproducing identical weights
+        resumed = est().fit(t, checkpoint=ckpt, resume=True)
+        np.testing.assert_allclose(resumed._state.coefficients,
+                                   oracle._state.coefficients)
+
+    def test_checkpoint_rejects_cursorless_source(self, tmp_path):
+        from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+
+        with pytest.raises(ValueError, match="cursor"):
+            (OnlineLogisticRegression().set_num_features(4)
+             .fit(iter(self._windows(0, 3)),
+                  checkpoint=CheckpointConfig(str(tmp_path / "c"))))
+
+    def test_dense_width_mismatch_errors_clearly(self):
+        with pytest.raises(ValueError, match="numFeatures"):
+            (OnlineLogisticRegression().set_num_features(10)
+             .set_global_batch_size(32)
+             .fit(iter(self._windows(0, 2))))
