@@ -249,13 +249,37 @@ def bench_logreg(results: dict) -> None:
     results["logreg_epochs_per_sec"] = round(epochs / best, 3)
     results["rows_per_sec"] = round(rows / epoch_s, 1)
 
-    # secondary: the generic (indices, values) sparse path on the same rows
+    # secondary: the generic (indices, values) sparse path on the same
+    # rows — also through the planned ELL path on TPU (values-aware
+    # layout), with the same pre-timing oracle parity stance
     def sparse_data(s):
         dense, cat, y = _criteo_device_data(steps, batch, seed=s)
         idx, vals = _as_sparse_pair(dense, cat)
         return idx, vals, y
 
-    best_sparse = measure(make_runner(sparse_update), sparse_data)
+    if impl == "ell":
+        from flink_ml_tpu.models.common.sgd import _sparse_update_ell
+        from flink_ml_tpu.ops.ell_scatter import ell_layout_device
+
+        def sparse_data_ell(s):
+            idx, vals, y = sparse_data(s)
+            lay = ell_layout_device(idx, LR_DIM, ovf_cap=1 << 13,
+                                    values=vals)
+            return (idx, vals, y, lay.src, lay.pos, lay.mask, lay.val,
+                    lay.ovf_idx, lay.ovf_src, lay.ovf_val,
+                    lay.heavy_idx, lay.heavy_cnt)
+
+        run_sparse_ell = make_runner(
+            _sparse_update_ell(logistic_loss, cfg))
+        a0 = sparse_data_ell(0)
+        p_se, _ = run_sparse_ell(fresh_params(), *a0)
+        p_so, _ = make_runner(sparse_update)(fresh_params(), *a0[:3])
+        if not np.allclose(np.asarray(p_se["w"]), np.asarray(p_so["w"]),
+                           rtol=1e-3, atol=1e-4):
+            raise AssertionError("sparse ELL path diverged from oracle")
+        best_sparse = measure(run_sparse_ell, sparse_data_ell)
+    else:
+        best_sparse = measure(make_runner(sparse_update), sparse_data)
     results["logreg_sparse_epochs_per_sec"] = round(epochs / best_sparse, 3)
 
     # arithmetic: per row ~2*2*NNZ flops (score + grad MACs); the blocked

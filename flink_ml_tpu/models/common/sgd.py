@@ -390,6 +390,31 @@ def _mixed_update(loss_fn: LossFn, config: SGDConfig):
     return update
 
 
+def _extended_r(r: jnp.ndarray) -> jnp.ndarray:
+    """r with a zero pad: padding slots carry ``src == batch`` and the pad
+    rounds the gather table up to a whole number of 256-lane rows."""
+    batch = r.shape[0]
+    pad = _GATHER_LANES - (batch % _GATHER_LANES) or _GATHER_LANES
+    return jnp.concatenate([r, jnp.zeros((pad,), jnp.float32)])
+
+
+def _apply_ell_categorical(apply_ell, lr, w, r, r_ext, src, pos, mask,
+                           ovf_idx, ovf_src, heavy_idx, heavy_cnt,
+                           val_ell=None, ovf_val=None):
+    """THE single copy of the ELL gradient application shared by the
+    mixed (implicit value 1.0) and generic sparse (explicit values)
+    update builders: slot gather -> kernel scatter -> overflow scatter ->
+    heavy-hitter matvec ((H, batch) @ (batch,) replaces thousands of
+    per-slot updates; padding entries carry zero counts and add 0 at
+    w[0])."""
+    g = _gather_weights(r_ext, src)
+    u = (-lr) * (g if val_ell is None else val_ell * g)
+    w = apply_ell(w, u, pos, mask)
+    o = r_ext[ovf_src] if ovf_val is None else ovf_val * r_ext[ovf_src]
+    w = w.at[ovf_idx].add((-lr) * o)
+    return w.at[heavy_idx].add((-lr) * (heavy_cnt.astype(jnp.float32) @ r))
+
+
 def _mixed_update_ell(loss_fn: LossFn, config: SGDConfig,
                       use_pallas: bool = True):
     """Kernel-planned twin of :func:`_mixed_update`: same margin/loss/
@@ -414,21 +439,12 @@ def _mixed_update_ell(loss_fn: LossFn, config: SGDConfig,
                   + jnp.sum(_gather_weights(w, cat), axis=-1) + b)
         value, pull = jax.vjp(lambda m: loss_fn(m, yb, wb), margin)
         (r,) = pull(jnp.ones_like(value))
-        # r extended with zeros: padding slots carry src == batch and the
-        # pad rounds the gather table up to a whole number of 256-lane rows
-        batch = r.shape[0]
-        pad = _GATHER_LANES - (batch % _GATHER_LANES) or _GATHER_LANES
-        r_ext = jnp.concatenate([r, jnp.zeros((pad,), jnp.float32)])
-        u = (-lr) * _gather_weights(r_ext, src)
+        r_ext = _extended_r(r)
 
         def apply_grad(w):
-            w = apply_ell(w, u, pos, mask)
-            w = w.at[ovf_idx].add((-lr) * r_ext[ovf_src])
-            # heavy hitters: one (H, batch) @ (batch,) matvec replaces
-            # their thousands of per-slot updates (padding entries carry
-            # zero counts and add 0 at w[0])
-            w = w.at[heavy_idx].add(
-                (-lr) * (heavy_cnt.astype(jnp.float32) @ r))
+            w = _apply_ell_categorical(
+                apply_ell, lr, w, r, r_ext, src, pos, mask, ovf_idx,
+                ovf_src, heavy_idx, heavy_cnt)
             return w.at[:n_dense].add(-lr * (r @ dense))
 
         return finish(w, b, value, r, apply_grad)
@@ -461,13 +477,29 @@ def sgd_fit_sparse(loss_fn: LossFn, indices: np.ndarray, values: np.ndarray,
               else np.ones((n,), np.float32))
     w = prepare_epoch_tensor(w_host, perm, steps, batch, pad_value=0.0)
 
+    # the values-aware layout adds a fourth f32 grid (val): 16 B/slot/step
+    impl = plan_mixed_impl(num_features, mesh, steps,
+                           layout_bytes_per_slot=16)
+    if impl == "ell":
+        from ...ops.ell_scatter import ell_layout
+
+        layout = ell_layout(idx, num_features, values=vals)
+        extra = (layout.src, layout.pos, layout.mask, layout.val,
+                 layout.ovf_idx, layout.ovf_src, layout.ovf_val,
+                 layout.heavy_idx, layout.heavy_cnt)
+        update = _sparse_update_ell(loss_fn, config)
+    else:
+        extra = ()
+        update = _sparse_update(loss_fn, config)
+
     idx = _put_epoch_tensor(idx, mesh, P(None, "data", None))
     vals = _put_epoch_tensor(vals, mesh, P(None, "data", None))
     y = _put_epoch_tensor(y, mesh, P(None, "data"))
     w = _put_epoch_tensor(w, mesh, P(None, "data"))
+    extra = tuple(jax.device_put(a) for a in extra)  # single-device path
 
     params, loss_log = _run_minibatch_epochs(
-        _sparse_update(loss_fn, config), (idx, vals, y, w),
+        update, (idx, vals) + extra + (y, w),
         {"w": jnp.zeros((num_features,), jnp.float32),
          "b": jnp.zeros((), jnp.float32)}, steps, config, mesh)
     return LinearState(np.asarray(params["w"], np.float64),
@@ -481,7 +513,8 @@ def sgd_fit_sparse(loss_fn: LossFn, indices: np.ndarray, values: np.ndarray,
 _ELL_LAYOUT_BUDGET_BYTES = 2 << 30
 
 
-def plan_mixed_impl(num_features: int, mesh, steps: int = 1) -> str:
+def plan_mixed_impl(num_features: int, mesh, steps: int = 1,
+                    layout_bytes_per_slot: int = 12) -> str:
     """Which categorical-scatter implementation :func:`sgd_fit_mixed`
     runs: ``"ell"`` (the Pallas static-routing kernel,
     ``ops/ell_scatter.py``) on a single TPU device when the weight size
@@ -499,9 +532,42 @@ def plan_mixed_impl(num_features: int, mesh, steps: int = 1) -> str:
         n_dev = len(mesh.devices.flat)
     if (_jax.default_backend() == "tpu" and n_dev == 1
             and _ell_supported(num_features)
-            and steps * num_features * 12 <= _ELL_LAYOUT_BUDGET_BYTES):
+            and steps * num_features * layout_bytes_per_slot
+            <= _ELL_LAYOUT_BUDGET_BYTES):
         return "ell"
     return "xla"
+
+
+def _sparse_update_ell(loss_fn: LossFn, config: SGDConfig,
+                       use_pallas: bool = True):
+    """Kernel-planned twin of :func:`_sparse_update` for the generic
+    (indices, values) layout: per-slot updates are ``-lr * value * r``,
+    carried by the layout's value arrays (``EllLayout.val`` /
+    ``ovf_val`` / value-sum ``heavy_cnt``).  Same algebra as the XLA
+    path up to f32 summation order."""
+    from ...ops.ell_scatter import ell_scatter_apply, ell_scatter_apply_xla
+
+    lr = config.learning_rate
+    finish = _finish_sparse_step(config)
+    apply_ell = ell_scatter_apply if use_pallas else ell_scatter_apply_xla
+
+    def update(params, idx, vals, src, pos, mask, val_ell, ovf_idx,
+               ovf_src, ovf_val, heavy_idx, heavy_cnt, yb, wb):
+        w, b = params["w"], params["b"]
+        margin = jnp.sum(vals * _gather_weights(w, idx), axis=-1) + b
+        value, pull = jax.vjp(lambda m: loss_fn(m, yb, wb), margin)
+        (r,) = pull(jnp.ones_like(value))
+        r_ext = _extended_r(r)
+
+        def apply_grad(w):
+            return _apply_ell_categorical(
+                apply_ell, lr, w, r, r_ext, src, pos, mask, ovf_idx,
+                ovf_src, heavy_idx, heavy_cnt, val_ell=val_ell,
+                ovf_val=ovf_val)
+
+        return finish(w, b, value, r, apply_grad)
+
+    return update
 
 
 def _mixed_update_sharded(loss_fn: LossFn, config: SGDConfig, mesh,

@@ -93,10 +93,15 @@ class EllLayout:
     ovf_idx: jnp.ndarray   # (steps, cap) i32: overflow weight indices (0 pad)
     ovf_src: jnp.ndarray   # (steps, cap) i32: overflow batch rows (batch pad)
     heavy_idx: jnp.ndarray  # (steps, H) i32: heavy indices (0 pad)
-    heavy_cnt: jnp.ndarray  # (steps, H, batch) i16: per-row counts
+    heavy_cnt: jnp.ndarray  # (steps, H, batch): per-row counts (i16), or
+                            #   per-row VALUE SUMS (f32) with `values`
                             #   (all-zero rows for padding entries)
     batch: int             # rows per batch (r vector length)
     num_features: int
+    # generic (indices, values) sparse layout only (None for the mixed
+    # implicit-1.0 layout):
+    val: Optional[jnp.ndarray] = None      # (steps, rows, 128) f32
+    ovf_val: Optional[jnp.ndarray] = None  # (steps, cap) f32
 
     @property
     def steps(self) -> int:
@@ -119,12 +124,18 @@ def _check_heavy_threshold(heavy_threshold: int) -> None:
 
 
 def _ell_one_step(flat: np.ndarray, batch: int, nnz: int, rows: int,
-                  heavy_threshold: int) -> Tuple[np.ndarray, ...]:
-    """Host layout for one step's flattened indices (batch*nnz,)."""
+                  heavy_threshold: int,
+                  values: "Optional[np.ndarray]" = None
+                  ) -> Tuple[np.ndarray, ...]:
+    """Host layout for one step's flattened indices (batch*nnz,).  With
+    ``values`` (same flat shape), each slot carries a coefficient: the
+    layout also emits the value arrays and the heavy matrix holds VALUE
+    SUMS instead of counts (the (indices, values) sparse layout)."""
     b_of = np.repeat(np.arange(batch, dtype=np.int32), nnz)
     order = np.argsort(flat, kind="stable")
     sidx = flat[order]
     ssrc = b_of[order]
+    svals = values[order] if values is not None else None
     row = sidx >> 7
     lo = (sidx & 127).astype(np.int32)
     starts = np.searchsorted(row, np.arange(rows, dtype=np.int64))
@@ -139,6 +150,10 @@ def _ell_one_step(flat: np.ndarray, batch: int, nnz: int, rows: int,
 
     src = np.full((rows, ELL_WIDTH), batch, np.int32)
     src[row[keep], pos[keep]] = ssrc[keep]
+    val = None
+    if svals is not None:
+        val = np.zeros((rows, ELL_WIDTH), np.float32)
+        val[row[keep], pos[keep]] = svals[keep]
     hist = np.zeros((rows, 128), np.int64)
     np.add.at(hist, (row[keep], lo[keep]), 1)
     P = np.cumsum(hist, axis=1) - 1
@@ -148,49 +163,72 @@ def _ell_one_step(flat: np.ndarray, batch: int, nnz: int, rows: int,
     spill = ~keep & ~heavy_slot
     ovf_idx = sidx[spill].astype(np.int32)
     ovf_src = ssrc[spill]
+    ovf_val = svals[spill].astype(np.float32) if svals is not None else None
 
     h_idx = np.unique(sidx[heavy_slot]).astype(np.int32)
-    h_cnt = np.zeros((h_idx.size, batch), np.int16)
+    if svals is None:
+        h_cnt = np.zeros((h_idx.size, batch), np.int16)
+        h_w = np.ones(int(heavy_slot.sum()))
+    else:
+        h_cnt = np.zeros((h_idx.size, batch), np.float32)
+        h_w = svals[heavy_slot]
     if h_idx.size:
         h_rank = np.searchsorted(h_idx, sidx[heavy_slot])
-        np.add.at(h_cnt, (h_rank, ssrc[heavy_slot]), 1)
-    return src, Pc, mask, ovf_idx, ovf_src, h_idx, h_cnt
+        np.add.at(h_cnt, (h_rank, ssrc[heavy_slot]), h_w)
+    return src, Pc, mask, ovf_idx, ovf_src, h_idx, h_cnt, val, ovf_val
 
 
 def ell_layout(cat_indices: np.ndarray, num_features: int,
-               heavy_threshold: int = HEAVY_THRESHOLD) -> EllLayout:
+               heavy_threshold: int = HEAVY_THRESHOLD,
+               values: "Optional[np.ndarray]" = None) -> EllLayout:
     """Build the static routing from a ``(steps, batch, nnz)`` int epoch
-    tensor of categorical indices (host numpy; one-time per fit)."""
+    tensor of categorical indices (host numpy; one-time per fit).  Pass
+    ``values`` (same shape, float) for the generic sparse layout —
+    slots then scatter ``value * r`` instead of ``r``."""
     _check_heavy_threshold(heavy_threshold)
     steps, batch, nnz = cat_indices.shape
     rows = num_features // _LANES
-    outs = [_ell_one_step(np.asarray(cat_indices[s], np.int64).reshape(-1),
-                          batch, nnz, rows, heavy_threshold)
-            for s in range(steps)]
+    outs = [_ell_one_step(
+        np.asarray(cat_indices[s], np.int64).reshape(-1), batch, nnz, rows,
+        heavy_threshold,
+        None if values is None
+        else np.asarray(values[s], np.float32).reshape(-1))
+        for s in range(steps)]
     cap = max(8, max(o[3].size for o in outs))
     cap += (-cap) % 8
     ovf_idx = np.zeros((steps, cap), np.int32)
     ovf_src = np.full((steps, cap), batch, np.int32)
     H = max(1, max(o[5].size for o in outs))
     heavy_idx = np.zeros((steps, H), np.int32)
-    heavy_cnt = np.zeros((steps, H, batch), np.int16)
+    heavy_cnt = np.zeros((steps, H, batch),
+                         np.int16 if values is None else np.float32)
+    val = ovf_val = None
+    if values is not None:
+        val = np.zeros((steps, rows, ELL_WIDTH), np.float32)
+        ovf_val = np.zeros((steps, cap), np.float32)
     for s, o in enumerate(outs):
         ovf_idx[s, :o[3].size] = o[3]
         ovf_src[s, :o[4].size] = o[4]
         heavy_idx[s, :o[5].size] = o[5]
         heavy_cnt[s, :o[6].shape[0]] = o[6]
+        if values is not None:
+            val[s] = o[7]
+            ovf_val[s, :o[8].size] = o[8]
     return EllLayout(
         src=jnp.asarray(np.stack([o[0] for o in outs])),
         pos=jnp.asarray(np.stack([o[1] for o in outs])),
         mask=jnp.asarray(np.stack([o[2] for o in outs])),
         ovf_idx=jnp.asarray(ovf_idx), ovf_src=jnp.asarray(ovf_src),
         heavy_idx=jnp.asarray(heavy_idx), heavy_cnt=jnp.asarray(heavy_cnt),
+        val=None if val is None else jnp.asarray(val),
+        ovf_val=None if ovf_val is None else jnp.asarray(ovf_val),
         batch=batch, num_features=num_features)
 
 
 def ell_layout_device(cat_indices: jnp.ndarray, num_features: int,
                       ovf_cap: int = 1 << 16, heavy_cap: int = 8,
-                      heavy_threshold: int = HEAVY_THRESHOLD) -> EllLayout:
+                      heavy_threshold: int = HEAVY_THRESHOLD,
+                      values: Optional[jnp.ndarray] = None) -> EllLayout:
     """Device-side layout builder (jit, vmapped over steps) for callers
     whose epoch tensor already lives in HBM (e.g. the benchmark, where
     host round-trips are prohibitively slow through a tunnel).  Overflow
@@ -203,12 +241,16 @@ def ell_layout_device(cat_indices: jnp.ndarray, num_features: int,
     rows = num_features // _LANES
     b_of = jnp.repeat(jnp.arange(batch, dtype=jnp.int32), nnz)
 
+    with_values = values is not None
+
     @functools.partial(jax.jit, static_argnums=())
     @jax.vmap
-    def build(flat):
+    def build(flat, fvals):
         order = jnp.argsort(flat)
         sidx = flat[order]
         ssrc = b_of[order]
+        # implicit-1.0 callers skip all value plumbing at trace time
+        svals = fvals[order] if with_values else None
         row = sidx >> 7
         lo = (sidx & 127).astype(jnp.int32)
         starts = jnp.searchsorted(row, jnp.arange(rows, dtype=sidx.dtype))
@@ -222,6 +264,9 @@ def ell_layout_device(cat_indices: jnp.ndarray, num_features: int,
         # discards (an in-bounds dummy would race the real slot there)
         src = src.at[row, jnp.where(keep, pos, ELL_WIDTH)].set(
             ssrc, mode="drop")
+        val = (jnp.zeros((rows, ELL_WIDTH), jnp.float32).at[
+            row, jnp.where(keep, pos, ELL_WIDTH)].set(svals, mode="drop")
+            if with_values else jnp.zeros((1, 1), jnp.float32))
         hist = jnp.zeros((rows, 128), jnp.int32).at[row, lo].add(
             keep.astype(jnp.int32), mode="drop")
         P = jnp.cumsum(hist, axis=1) - 1
@@ -235,6 +280,10 @@ def ell_layout_device(cat_indices: jnp.ndarray, num_features: int,
         ovf_s = jnp.full((ovf_cap,), batch, jnp.int32).at[
             jnp.where(spill, ovf_slot, ovf_cap)].set(
             jnp.where(spill, ssrc, batch), mode="drop")
+        ovf_v = (jnp.zeros((ovf_cap,), jnp.float32).at[
+            jnp.where(spill, ovf_slot, ovf_cap)].set(
+            jnp.where(spill, svals, 0.0), mode="drop")
+            if with_values else jnp.zeros((1,), jnp.float32))
         # heavy runs: rank = number of heavy runs starting at or before
         # this slot - 1 (first-occurrence compaction)
         is_first = jnp.arange(flat.size, dtype=jnp.int32) == run_start
@@ -242,15 +291,25 @@ def ell_layout_device(cat_indices: jnp.ndarray, num_features: int,
         h_i = jnp.zeros((heavy_cap,), jnp.int32).at[
             jnp.where(is_first & heavy_slot, h_rank, heavy_cap)].set(
             jnp.where(heavy_slot, sidx.astype(jnp.int32), 0), mode="drop")
-        h_c = jnp.zeros((heavy_cap, batch), jnp.int16).at[
-            jnp.where(heavy_slot, h_rank, heavy_cap), ssrc].add(
-            1, mode="drop")
-        return src, Pc, mask, ovf_i, ovf_s, h_i, h_c
+        if with_values:
+            h_c = jnp.zeros((heavy_cap, batch), jnp.float32).at[
+                jnp.where(heavy_slot, h_rank, heavy_cap), ssrc].add(
+                svals, mode="drop")
+        else:
+            h_c = jnp.zeros((heavy_cap, batch), jnp.int16).at[
+                jnp.where(heavy_slot, h_rank, heavy_cap), ssrc].add(
+                1, mode="drop")
+        return src, Pc, mask, ovf_i, ovf_s, h_i, h_c, val, ovf_v
 
-    src, Pc, mask, ovf_i, ovf_s, h_i, h_c = build(
-        cat_indices.reshape(steps, -1).astype(jnp.int32))
+    flat_steps = cat_indices.reshape(steps, -1).astype(jnp.int32)
+    fvals = (values.reshape(steps, -1).astype(jnp.float32) if with_values
+             else jnp.zeros((steps, 1), jnp.float32))  # unused placeholder
+    src, Pc, mask, ovf_i, ovf_s, h_i, h_c, val, ovf_v = build(
+        flat_steps, fvals)
     return EllLayout(src=src, pos=Pc, mask=mask, ovf_idx=ovf_i,
                      ovf_src=ovf_s, heavy_idx=h_i, heavy_cnt=h_c,
+                     val=val if with_values else None,
+                     ovf_val=ovf_v if with_values else None,
                      batch=batch, num_features=num_features)
 
 
