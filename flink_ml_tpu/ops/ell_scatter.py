@@ -166,15 +166,12 @@ def _ell_one_step(flat: np.ndarray, batch: int, nnz: int, rows: int,
     ovf_val = svals[spill].astype(np.float32) if svals is not None else None
 
     h_idx = np.unique(sidx[heavy_slot]).astype(np.int32)
-    if svals is None:
-        h_cnt = np.zeros((h_idx.size, batch), np.int16)
-        h_w = np.ones(int(heavy_slot.sum()))
-    else:
-        h_cnt = np.zeros((h_idx.size, batch), np.float32)
-        h_w = svals[heavy_slot]
+    h_cnt = np.zeros((h_idx.size, batch),
+                     np.int16 if svals is None else np.float32)
     if h_idx.size:
         h_rank = np.searchsorted(h_idx, sidx[heavy_slot])
-        np.add.at(h_cnt, (h_rank, ssrc[heavy_slot]), h_w)
+        np.add.at(h_cnt, (h_rank, ssrc[heavy_slot]),
+                  1 if svals is None else svals[heavy_slot])
     return src, Pc, mask, ovf_idx, ovf_src, h_idx, h_cnt, val, ovf_val
 
 

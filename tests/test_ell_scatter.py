@@ -285,14 +285,20 @@ class TestSparseUpdateEll:
             SGDConfig, _sparse_update_ell)
 
         rng = np.random.default_rng(9)
-        d, batch, nnz = 128 * 128, 64, 5
+        d, batch, nnz = 128 * 128, 400, 5
         idx = rng.integers(0, d, size=(2, batch, nnz)).astype(np.int32)
-        idx[:, :, 0] = 31             # hot index exercises ovf/heavy paths
+        # 400 occurrences of idx 31: > threshold 128 -> HEAVY value sums;
+        # 200 of idx 33 (same table row as 31): not heavy, > ELL_WIDTH
+        # entries in row 0 -> real OVERFLOW values
+        idx[:, :, 0] = 31
+        idx[:, ::2, 1] = 33
         vals = rng.normal(size=(2, batch, nnz)).astype(np.float32)
-        host = ell_layout(idx, d, values=vals, heavy_threshold=128)
+        host = ell_layout(idx, d, values=vals, heavy_threshold=256)
         dev = ell_layout_device(jnp.asarray(idx), d, ovf_cap=512,
                                 values=jnp.asarray(vals),
-                                heavy_threshold=128)
+                                heavy_threshold=256)
+        assert 31 in np.asarray(host.heavy_idx[0])
+        assert float(np.abs(np.asarray(host.ovf_val)).sum()) > 0
         # grid fields match exactly; overflow/heavy capacities differ by
         # construction, so compare the applied UPDATE instead
         for f in ("src", "pos", "mask", "val"):
