@@ -150,7 +150,7 @@ class CriteoTSVReader:
     def __init__(self, path: "str | bytes | os.PathLike | Sequence[str]",
                  batch_rows: int, hash_space: int,
                  n_reserved: int = N_DENSE, features_col: str = "features",
-                 label_col: str = "label", chunk_bytes: int = 1 << 20,
+                 label_col: str = "label", chunk_bytes: int = 1 << 24,
                  workers: int = 0):
         if batch_rows <= 0:
             raise ValueError(f"batch_rows must be positive: {batch_rows}")
@@ -349,6 +349,7 @@ class CriteoTSVReader:
                 yield dense, cat, label
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        B = self.batch_rows
         pend_d, pend_c, pend_l = [], [], []
         pending = 0
         for dense, cat, label in self._rows():
@@ -356,17 +357,21 @@ class CriteoTSVReader:
             pend_c.append(cat)
             pend_l.append(label)
             pending += len(label)
-            while pending >= self.batch_rows:
-                d = np.concatenate(pend_d)
-                c = np.concatenate(pend_c)
-                y = np.concatenate(pend_l)
-                yield self._batch(d[: self.batch_rows],
-                                  c[: self.batch_rows],
-                                  y[: self.batch_rows])
-                pend_d = [d[self.batch_rows:]]
-                pend_c = [c[self.batch_rows:]]
-                pend_l = [y[self.batch_rows:]]
-                pending -= self.batch_rows
+            if pending < B:
+                continue
+            # concatenate ONCE, then emit offset slices: re-concatenating
+            # the leftover per batch would copy O(remaining) per yield
+            # (quadratic when a parse chunk holds many batches)
+            d = np.concatenate(pend_d)
+            c = np.concatenate(pend_c)
+            y = np.concatenate(pend_l)
+            off = 0
+            while pending - off >= B:
+                yield self._batch(d[off:off + B], c[off:off + B],
+                                  y[off:off + B])
+                off += B
+            pend_d, pend_c, pend_l = [d[off:]], [c[off:]], [y[off:]]
+            pending -= off
         if pending:
             yield self._batch(np.concatenate(pend_d),
                               np.concatenate(pend_c),

@@ -112,11 +112,20 @@ class DataCacheWriter:
     is identical for any worker count."""
 
     def __init__(self, directory: str, segment_rows: int = 1 << 20,
-                 workers: int = 1):
+                 workers: int = 1, borrow_batches: bool = False):
         if segment_rows <= 0:
             raise ValueError("segment_rows must be positive")
         if workers < 1:
             raise ValueError("workers must be >= 1")
+        # borrow_batches=True skips the defensive copy the parallel path
+        # otherwise makes of every buffered slice: valid ONLY when the
+        # producer never mutates a batch after append() (e.g. it yields
+        # fresh arrays, like CriteoTSVReader) — on a single core the copy
+        # costs more than the write overlap buys.  Note: borrowed slices
+        # are VIEWS, so each in-flight segment pins its producer arrays'
+        # full base buffers until the background write lands — peak RSS
+        # scales with the producer's chunk size, not just segment size.
+        self._borrow = borrow_batches
         self.directory = directory
         self.segment_rows = segment_rows
         os.makedirs(directory, exist_ok=True)
@@ -225,11 +234,12 @@ class DataCacheWriter:
         written = 0
         while written < rows:
             take = min(rows - written, self.segment_rows - self._pending_rows)
-            # COPY the slice: append() returns before the background write
-            # runs, so a view into a caller-reused buffer would let the
-            # next batch's bytes land in this segment
+            # COPY the slice (unless borrowing): append() returns before
+            # the background write runs, so a view into a caller-reused
+            # buffer would let the next batch's bytes land in this segment
             self._pending.append(
-                {k: v[written:written + take].copy()
+                {k: (v[written:written + take] if self._borrow
+                     else v[written:written + take].copy())
                  for k, v in batch.items()})
             self._pending_rows += take
             written += take

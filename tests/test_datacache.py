@@ -234,3 +234,22 @@ def test_parallel_writer_matches_serial(tmp_path):
                      for k in ("x", "y")})
     for k in ("x", "y"):
         np.testing.assert_array_equal(outs[0][k], outs[1][k])
+
+
+def test_parallel_writer_borrow_batches(tmp_path):
+    """borrow_batches=True skips the defensive copy; with a fresh-array
+    producer the cache is identical to the copying path."""
+    from flink_ml_tpu.data.datacache import DataCacheReader, DataCacheWriter
+
+    outs = []
+    for borrow in (False, True):
+        d = str(tmp_path / f"cache-b{borrow}")
+        w = DataCacheWriter(d, segment_rows=50, workers=2,
+                            borrow_batches=borrow)
+        r2 = np.random.default_rng(6)
+        for n in (70, 30, 55):
+            w.append({"x": r2.normal(size=(n, 3)).astype(np.float32)})
+        w.finish()
+        got = list(DataCacheReader(d, batch_rows=64))
+        outs.append(np.concatenate([b["x"] for b in got]))
+    np.testing.assert_array_equal(outs[0], outs[1])
