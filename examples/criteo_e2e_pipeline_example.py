@@ -42,7 +42,8 @@ hash_space = 1 << 16
 reader = CriteoTSVReader(day, batch_rows=2048, hash_space=hash_space,
                          workers=0)           # 0 = auto (cores - 1)
 writer = DataCacheWriter(os.path.join(work, "cache"), segment_rows=8192,
-                         workers=2)
+                         workers=2, borrow_batches=True)  # reader yields
+                                                          # fresh arrays
 t0 = time.perf_counter()
 n = 0
 for batch in reader:
