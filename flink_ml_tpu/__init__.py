@@ -10,6 +10,10 @@ feedback state, ICI collectives for aggregation.
 
 from .api.stage import AlgoOperator, Estimator, Model, Stage, Transformer
 from .api.graph import Graph, GraphBuilder, GraphModel, TableId
+from .api.model_selection import (CrossValidator,
+                                  CrossValidatorModel,
+                                  ParamGridBuilder,
+                                  TrainValidationSplit)
 from .api.pipeline import Pipeline, PipelineModel
 from .data.table import Table
 from .linalg import DenseVector, SparseVector, Vectors
@@ -36,6 +40,8 @@ __version__ = "0.1.0"
 
 __all__ = [
     "AlgoOperator", "Estimator", "Model", "Stage", "Transformer",
+    "CrossValidator", "CrossValidatorModel", "ParamGridBuilder",
+    "TrainValidationSplit",
     "Pipeline", "PipelineModel", "Table",
     "Graph", "GraphBuilder", "GraphModel", "TableId",
     "DenseVector", "SparseVector", "Vectors", "DistanceMeasure",

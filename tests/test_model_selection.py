@@ -1,0 +1,166 @@
+"""Model selection (`api/model_selection.py`): ParamGridBuilder grids,
+CrossValidator fold mechanics + best-candidate selection + full-table
+refit, TrainValidationSplit, metric direction, error probes."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.api.model_selection import (
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+    TrainValidationSplit,
+)
+from flink_ml_tpu.models.classification import LogisticRegression
+from flink_ml_tpu.models.evaluation.binary_evaluator import (
+    BinaryClassificationEvaluator,
+)
+
+
+def _data(n=400, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(np.float64)
+    return Table({"features": X, "label": y})
+
+
+def _lr():
+    return (LogisticRegression().set_max_iter(15).set_learning_rate(0.5)
+            .set_global_batch_size(128))
+
+
+def _auc_eval():
+    return (BinaryClassificationEvaluator()
+            .set_raw_prediction_col("rawPrediction")
+            .set_metrics("areaUnderROC"))
+
+
+class TestParamGridBuilder:
+    def test_cartesian_product(self):
+        grid = (ParamGridBuilder()
+                .add_grid(LogisticRegression.REG, [0.0, 0.1])
+                .add_grid(LogisticRegression.MAX_ITER, [5, 10, 20])
+                .build())
+        assert len(grid) == 6
+        regs = {g[LogisticRegression.REG] for g in grid}
+        assert regs == {0.0, 0.1}
+
+    def test_empty_builder_is_single_default(self):
+        assert ParamGridBuilder().build() == [{}]
+
+    def test_rejects_non_param(self):
+        with pytest.raises(TypeError):
+            ParamGridBuilder().add_grid("reg", [1])
+        with pytest.raises(ValueError):
+            ParamGridBuilder().add_grid(LogisticRegression.REG, [])
+
+
+class TestCrossValidator:
+    def test_selects_sane_candidate_and_refits(self):
+        t = _data()
+        # candidate 0 is crippled (1 iteration, tiny lr); candidate 1 real
+        grid = [
+            {LogisticRegression.MAX_ITER: 1,
+             LogisticRegression.LEARNING_RATE: 1e-4},
+            {LogisticRegression.MAX_ITER: 20,
+             LogisticRegression.LEARNING_RATE: 0.5},
+        ]
+        cv = (CrossValidator(_lr(), _auc_eval(), grid)
+              .set_num_folds(3).set_seed(7))
+        model = cv.fit(t)
+        assert isinstance(model, CrossValidatorModel)
+        assert model.best_index == 1
+        assert len(model.avg_metrics) == 2
+        assert model.avg_metrics[1] > model.avg_metrics[0]
+        # refit-on-all-rows model predicts well
+        pred = np.asarray(model.transform(t)[0]["prediction"]).ravel()
+        assert (pred == np.asarray(t["label"])).mean() > 0.9
+
+    def test_fold_partition_is_exact(self):
+        t = _data(n=103)
+        cv = CrossValidator(_lr(), _auc_eval()).set_num_folds(4).set_seed(1)
+        splits = cv._splits(t)
+        assert len(splits) == 4
+        val_rows = sum(v.num_rows for _, v in splits)
+        assert val_rows == 103                       # folds cover all rows
+        for train, val in splits:
+            assert train.num_rows + val.num_rows == 103
+        # validation folds are disjoint (feature rows unique per fold)
+        seen = np.concatenate(
+            [np.asarray(v["features"])[:, 0] for _, v in splits])
+        assert len(np.unique(seen)) == 103
+
+    def test_minimizing_metric_direction(self):
+        # with largerIsBetter=false the crippled candidate "wins"
+        t = _data()
+        grid = [
+            {LogisticRegression.MAX_ITER: 1,
+             LogisticRegression.LEARNING_RATE: 1e-4},
+            {LogisticRegression.MAX_ITER: 20,
+             LogisticRegression.LEARNING_RATE: 0.5},
+        ]
+        cv = (CrossValidator(_lr(), _auc_eval(), grid)
+              .set_num_folds(2).set_larger_is_better(False))
+        assert cv.fit(t).best_index == 0
+
+    def test_too_few_rows_rejected(self):
+        cv = CrossValidator(_lr(), _auc_eval()).set_num_folds(5)
+        with pytest.raises(ValueError, match="folds"):
+            cv.fit(_data(n=3))
+
+    def test_missing_pieces_rejected(self):
+        with pytest.raises(ValueError, match="set_estimator"):
+            CrossValidator().fit(_data())
+
+    def test_model_save_delegates_to_best(self, tmp_path):
+        from flink_ml_tpu.models.classification import (
+            LogisticRegressionModel)
+
+        t = _data()
+        model = CrossValidator(_lr(), _auc_eval()).set_num_folds(2).fit(t)
+        path = str(tmp_path / "best")
+        model.save(path)
+        loaded = LogisticRegressionModel.load(path)
+        np.testing.assert_array_equal(
+            np.asarray(loaded.transform(t)[0]["prediction"]),
+            np.asarray(model.transform(t)[0]["prediction"]))
+
+
+class TestTrainValidationSplit:
+    def test_single_split_selection(self):
+        t = _data()
+        grid = [
+            {LogisticRegression.MAX_ITER: 1,
+             LogisticRegression.LEARNING_RATE: 1e-4},
+            {LogisticRegression.MAX_ITER: 20,
+             LogisticRegression.LEARNING_RATE: 0.5},
+        ]
+        tvs = (TrainValidationSplit(_lr(), _auc_eval(), grid)
+               .set_train_ratio(0.7).set_seed(3))
+        model = tvs.fit(t)
+        assert model.best_index == 1
+        (train, val), = tvs._splits(t)
+        assert train.num_rows == 280 and val.num_rows == 120
+
+    def test_degenerate_ratio_rejected(self):
+        tvs = (TrainValidationSplit(_lr(), _auc_eval())
+               .set_train_ratio(0.001))
+        with pytest.raises(ValueError, match="empty split"):
+            tvs.fit(_data(n=10))
+
+def test_root_exports_and_bool_param():
+    import flink_ml_tpu as fm
+
+    assert fm.CrossValidator is CrossValidator
+    assert fm.ParamGridBuilder is ParamGridBuilder
+    cv = CrossValidator().set(CrossValidator.LARGER_IS_BETTER, False)
+    assert cv.get(CrossValidator.LARGER_IS_BETTER) is False
+
+
+def test_add_grid_repeated_param_replaces():
+    grid = (ParamGridBuilder()
+            .add_grid(LogisticRegression.REG, [0.0, 1.0])
+            .add_grid(LogisticRegression.REG, [2.0, 3.0])
+            .build())
+    assert [g[LogisticRegression.REG] for g in grid] == [2.0, 3.0]
