@@ -62,11 +62,73 @@ class ParamGridBuilder:
                     *(vals for _, vals in self._grid))]
 
 
-def _clone_with(stage, param_map: Dict[Param, Any]):
+def _declares(stage, param: Param) -> bool:
+    """Does this stage's class hierarchy declare THIS param object?
+    (Identity over the MRO — name collisions between unrelated params
+    never match; shared Has* mixin params match every inheriting stage.)
+    A nested Pipeline declares whatever its descendants declare."""
+    from .pipeline import Pipeline
+
+    if isinstance(stage, Pipeline):
+        return any(_declares(s, param) for s in stage.stages)
+    return any(v is param for klass in type(stage).__mro__
+               for v in vars(klass).values())
+
+
+def _bind_in_children(children, param: Param, value) -> bool:
+    from .pipeline import Pipeline
+
+    hit = False
+    for child in children:
+        if isinstance(child, Pipeline):
+            hit |= _bind_in_children(child.stages, param, value)
+        elif _declares(child, param):
+            child.set(param, value)
+            hit = True
+    return hit
+
+
+def _clone_with(stage, param_map: Dict[Any, Any]):
+    """Fresh stage with ``stage``'s params plus ``param_map`` overrides.
+
+    A Pipeline candidate clones its ESTIMATOR children (nested pipelines
+    recursively); transformer/model children are reused as-is — fit
+    never mutates them, and re-instantiating would drop their fitted
+    data.  Grid keys bind by param-object IDENTITY on every declaring
+    descendant (a shared ``Has*`` mixin param therefore reaches all
+    stages inheriting it); to pin a value to one top-level child, use a
+    ``(child_index, Param)`` tuple key.  A key binding nowhere is an
+    error."""
+    from .pipeline import Pipeline
+
+    if isinstance(stage, Pipeline):
+        children = [
+            _clone_with(s, {}) if isinstance(s, (Pipeline, Estimator))
+            else s
+            for s in stage.stages]
+        clone = Pipeline(children)
+        clone.copy_params_from(stage)
+        for key, value in param_map.items():
+            if isinstance(key, tuple):
+                idx, param = key
+                target = children[idx]
+                if not (_declares(target, param)
+                        and _bind_in_children([target], param, value)):
+                    raise ValueError(
+                        f"pipeline stage {idx} does not declare "
+                        f"{param.name!r}")
+            elif not _bind_in_children(children, key, value):
+                raise ValueError(
+                    f"grid param {key.name!r} matches no pipeline stage")
+        return clone
     clone = type(stage)()
     clone.copy_params_from(stage)
-    for param, value in param_map.items():
-        clone.set(param, value)   # set() resolves by name and validates
+    for key, value in param_map.items():
+        if isinstance(key, tuple):
+            raise ValueError(
+                "(child_index, Param) grid keys only apply to Pipeline "
+                "estimators")
+        clone.set(key, value)   # set() resolves by name and validates
     return clone
 
 

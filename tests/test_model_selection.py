@@ -164,3 +164,82 @@ def test_add_grid_repeated_param_replaces():
             .add_grid(LogisticRegression.REG, [2.0, 3.0])
             .build())
     assert [g[LogisticRegression.REG] for g in grid] == [2.0, 3.0]
+
+
+def test_cv_over_pipeline_clones_children():
+    from flink_ml_tpu import Pipeline
+    from flink_ml_tpu.models.feature.scalers import StandardScaler
+
+    t = _data()
+    grid = (ParamGridBuilder()
+            .add_grid(LogisticRegression.MAX_ITER, [1, 20])
+            .build())
+    pipe = Pipeline([StandardScaler().set_output_col("features"),
+                     _lr()])
+    cv = (CrossValidator(pipe, _auc_eval(), grid)
+          .set_num_folds(2).set_seed(2))
+    model = cv.fit(t)
+    assert model.best_params[LogisticRegression.MAX_ITER] == 20
+    pred = np.asarray(model.transform(t)[0]["prediction"]).ravel()
+    assert (pred == np.asarray(t["label"])).mean() > 0.9
+    # the original pipeline's children are untouched by candidate fits
+    assert pipe.stages[1].get_max_iter() == 15
+
+
+def test_cv_pipeline_unknown_grid_param_rejected():
+    from flink_ml_tpu import Pipeline
+    from flink_ml_tpu.models.clustering.kmeans import KMeansParams
+
+    pipe = Pipeline([_lr()])
+    cv = CrossValidator(pipe, _auc_eval(),
+                        [{KMeansParams.K: 4}]).set_num_folds(2)
+    with pytest.raises(ValueError, match="matches no pipeline stage"):
+        cv.fit(_data())
+
+
+def test_cv_pipeline_nested_and_shared_mixin_binding():
+    from flink_ml_tpu import Pipeline
+    from flink_ml_tpu.models.feature.scalers import StandardScaler
+
+    t = _data()
+    # nested pipeline; maxIter (HasMaxIter mixin) binds into the inner LR
+    inner = Pipeline([_lr()])
+    pipe = Pipeline([StandardScaler().set_output_col("features"), inner])
+    grid = (ParamGridBuilder()
+            .add_grid(LogisticRegression.MAX_ITER, [1, 20]).build())
+    model = (CrossValidator(pipe, _auc_eval(), grid)
+             .set_num_folds(2).set_seed(4).fit(t))
+    assert model.best_params[LogisticRegression.MAX_ITER] == 20
+
+
+def test_cv_pipeline_tuple_key_pins_one_child():
+    from flink_ml_tpu import Pipeline
+    from flink_ml_tpu.models.feature.scalers import StandardScaler
+    from flink_ml_tpu.params.shared import HasFeaturesCol
+
+    # featuresCol is a SHARED mixin param: a bare key would hit both
+    # children; the tuple key pins it to the LR child only
+    t = _data().with_column("feat2", np.asarray(_data()["features"]))
+    pipe = Pipeline([StandardScaler().set_output_col("scaled"), _lr()])
+    grid = [{(1, HasFeaturesCol.FEATURES_COL): "scaled"}]
+    model = (CrossValidator(pipe, _auc_eval(), grid)
+             .set_num_folds(2).fit(t))
+    # the scaler child still reads the raw column (params untouched)
+    assert pipe.stages[0].get_features_col() == "features"
+    pred = np.asarray(model.transform(t)[0]["prediction"]).ravel()
+    assert (pred == np.asarray(t["label"])).mean() > 0.9
+
+
+def test_cv_pipeline_reuses_transformer_children():
+    from flink_ml_tpu import Pipeline
+    from flink_ml_tpu.models.feature.scalers import StandardScaler
+
+    t = _data()
+    # a FITTED model child must pass through with its model data intact
+    scaler_model = (StandardScaler().set_output_col("features").fit(t))
+    pipe = Pipeline([scaler_model, _lr()])
+    grid = (ParamGridBuilder()
+            .add_grid(LogisticRegression.MAX_ITER, [1, 20]).build())
+    model = (CrossValidator(pipe, _auc_eval(), grid)
+             .set_num_folds(2).fit(t))
+    assert model.best_params[LogisticRegression.MAX_ITER] == 20
