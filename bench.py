@@ -220,25 +220,38 @@ def bench_logreg(results: dict) -> None:
                 lay.heavy_idx, lay.heavy_cnt)
 
     mixed_args = _criteo_device_data(steps, batch, seed=0)
+    mixed_ell_ok = False
+    run_oracle = None
     if impl == "ell":
-        ell_update = _mixed_update_ell(logistic_loss, cfg)
-        run_oracle = make_runner(mixed_update)
-        run_ell = make_runner(ell_update)
+        # any kernel-path failure (parity divergence, Mosaic compile
+        # quirk on a different toolchain) degrades to the XLA path with
+        # a note — a broken fast path must not cost the round its bench
+        try:
+            ell_update = _mixed_update_ell(logistic_loss, cfg)
+            run_oracle = make_runner(mixed_update)
+            run_ell = make_runner(ell_update)
 
-        dense0, cat0, y0 = mixed_args
-        extra0 = device_layout(cat0)
-        p_ell, _ = run_ell(fresh_params(), 0.0, dense0, cat0, y0, *extra0)
-        p_ora, _ = run_oracle(fresh_params(), 0.0, dense0, cat0, y0)
-        w_ell, w_ora = np.asarray(p_ell["w"]), np.asarray(p_ora["w"])
-        if not np.allclose(w_ell, w_ora, rtol=1e-3, atol=1e-4):
-            raise AssertionError(
-                "ELL kernel path diverged from the XLA oracle after "
-                f"{epochs} epochs: max abs diff "
-                f"{np.max(np.abs(w_ell - w_ora))}")
-        results["ell_xla_allclose"] = True
+            dense0, cat0, y0 = mixed_args
+            extra0 = device_layout(cat0)
+            p_ell, _ = run_ell(fresh_params(), 0.0, dense0, cat0, y0,
+                               *extra0)
+            p_ora, _ = run_oracle(fresh_params(), 0.0, dense0, cat0, y0)
+            w_ell, w_ora = np.asarray(p_ell["w"]), np.asarray(p_ora["w"])
+            if not np.allclose(w_ell, w_ora, rtol=1e-3, atol=1e-4):
+                raise AssertionError(
+                    "ELL kernel path diverged from the XLA oracle after "
+                    f"{epochs} epochs: max abs diff "
+                    f"{np.max(np.abs(w_ell - w_ora))}")
+            results["ell_xla_allclose"] = True
+            mixed_ell_ok = True
+        except Exception as exc:   # noqa: BLE001 — degrade, don't die
+            results["notes"]["lr_impl"] = "xla (ell failed)"
+            results["notes"]["lr_ell_error"] = repr(exc)[:300]
+    if mixed_ell_ok:
         best = measure(run_ell, mixed_args + extra0)
     else:
-        best = measure(make_runner(mixed_update), mixed_args)
+        # reuse the already-compiled oracle when the try got that far
+        best = measure(run_oracle or make_runner(mixed_update), mixed_args)
     epoch_s = best / epochs
     results["logreg_epochs_per_sec"] = round(epochs / best, 3)
     results["rows_per_sec"] = round(rows / epoch_s, 1)
@@ -249,25 +262,37 @@ def bench_logreg(results: dict) -> None:
     idx0, vals0 = _as_sparse_pair(mixed_args[0], mixed_args[1])
     sparse_args = (idx0, vals0, mixed_args[2])
 
+    # the sparse ELL leg is independent of the mixed one: a mixed-leg
+    # failure does not skip it, and its impl is tagged either way
+    sparse_ok = False
     if impl == "ell":
-        from flink_ml_tpu.models.common.sgd import _sparse_update_ell
-        from flink_ml_tpu.ops.ell_scatter import ell_layout_device
+        try:
+            from flink_ml_tpu.models.common.sgd import _sparse_update_ell
+            from flink_ml_tpu.ops.ell_scatter import ell_layout_device
 
-        # heavy_cap: the pair encoding makes EVERY dense slot index
-        # (0..12, batch occurrences each) heavy, plus the label markers
-        lay = ell_layout_device(idx0, LR_DIM, ovf_cap=1 << 13,
-                                heavy_cap=24, values=vals0)
-        sparse_args_ell = sparse_args + (
-            lay.src, lay.pos, lay.mask, lay.val, lay.ovf_idx, lay.ovf_src,
-            lay.ovf_val, lay.heavy_idx, lay.heavy_cnt)
-        run_sparse_ell = make_runner(
-            _sparse_update_ell(logistic_loss, cfg))
-        p_se, _ = run_sparse_ell(fresh_params(), 0.0, *sparse_args_ell)
-        p_so, _ = make_runner(sparse_update)(fresh_params(), 0.0,
-                                             *sparse_args)
-        if not np.allclose(np.asarray(p_se["w"]), np.asarray(p_so["w"]),
-                           rtol=1e-3, atol=1e-4):
-            raise AssertionError("sparse ELL path diverged from oracle")
+            # heavy_cap: the pair encoding makes EVERY dense slot index
+            # (0..12, batch occurrences each) heavy, plus label markers
+            lay = ell_layout_device(idx0, LR_DIM, ovf_cap=1 << 13,
+                                    heavy_cap=24, values=vals0)
+            sparse_args_ell = sparse_args + (
+                lay.src, lay.pos, lay.mask, lay.val, lay.ovf_idx,
+                lay.ovf_src, lay.ovf_val, lay.heavy_idx, lay.heavy_cnt)
+            run_sparse_ell = make_runner(
+                _sparse_update_ell(logistic_loss, cfg))
+            p_se, _ = run_sparse_ell(fresh_params(), 0.0,
+                                     *sparse_args_ell)
+            p_so, _ = make_runner(sparse_update)(fresh_params(), 0.0,
+                                                 *sparse_args)
+            if not np.allclose(np.asarray(p_se["w"]),
+                               np.asarray(p_so["w"]),
+                               rtol=1e-3, atol=1e-4):
+                raise AssertionError(
+                    "sparse ELL path diverged from oracle")
+            sparse_ok = True
+        except Exception as exc:   # noqa: BLE001 — degrade, don't die
+            results["notes"]["lr_sparse_ell_error"] = repr(exc)[:300]
+    results["notes"]["lr_sparse_impl"] = "ell" if sparse_ok else "xla"
+    if sparse_ok:
         best_sparse = measure(run_sparse_ell, sparse_args_ell)
     else:
         best_sparse = measure(make_runner(sparse_update), sparse_args)
@@ -694,10 +719,14 @@ def main() -> None:
     profile_dir = os.environ.get("BENCH_PROFILE_DIR")
     if profile_dir:
         jax.profiler.start_trace(profile_dir)
+    # the headline leg must succeed; the auxiliary legs degrade to an
+    # error note instead of costing the round its whole bench line
     bench_logreg(results)
-    bench_logreg_outofcore(results)
-    bench_criteo_e2e(results)
-    bench_kmeans(results)
+    for leg in (bench_logreg_outofcore, bench_criteo_e2e, bench_kmeans):
+        try:
+            leg(results)
+        except Exception as exc:   # noqa: BLE001
+            results["notes"][f"{leg.__name__}_error"] = repr(exc)[:300]
     if profile_dir:
         jax.profiler.stop_trace()
         results["notes"]["profile_dir"] = profile_dir
