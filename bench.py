@@ -265,6 +265,7 @@ def bench_logreg(results: dict) -> None:
     # the sparse ELL leg is independent of the mixed one: a mixed-leg
     # failure does not skip it, and its impl is tagged either way
     sparse_ok = False
+    run_sparse_oracle = None
     if impl == "ell":
         try:
             from flink_ml_tpu.models.common.sgd import _sparse_update_ell
@@ -281,8 +282,8 @@ def bench_logreg(results: dict) -> None:
                 _sparse_update_ell(logistic_loss, cfg))
             p_se, _ = run_sparse_ell(fresh_params(), 0.0,
                                      *sparse_args_ell)
-            p_so, _ = make_runner(sparse_update)(fresh_params(), 0.0,
-                                                 *sparse_args)
+            run_sparse_oracle = make_runner(sparse_update)
+            p_so, _ = run_sparse_oracle(fresh_params(), 0.0, *sparse_args)
             if not np.allclose(np.asarray(p_se["w"]),
                                np.asarray(p_so["w"]),
                                rtol=1e-3, atol=1e-4):
@@ -295,7 +296,8 @@ def bench_logreg(results: dict) -> None:
     if sparse_ok:
         best_sparse = measure(run_sparse_ell, sparse_args_ell)
     else:
-        best_sparse = measure(make_runner(sparse_update), sparse_args)
+        best_sparse = measure(run_sparse_oracle or
+                              make_runner(sparse_update), sparse_args)
     results["logreg_sparse_epochs_per_sec"] = round(epochs / best_sparse, 3)
 
     # arithmetic: per row ~2*2*NNZ flops (score + grad MACs); the blocked

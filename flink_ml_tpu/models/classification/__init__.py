@@ -11,3 +11,4 @@ from .softmaxregression import (  # noqa: F401
 )
 from .knn import KNNClassifier, KNNClassifierModel  # noqa: F401
 from .gbtclassifier import GBTClassifier, GBTClassifierModel  # noqa: F401
+from .onevsrest import OneVsRest, OneVsRestModel  # noqa: F401

@@ -1,0 +1,99 @@
+"""OneVsRest meta-classifier: K binary fits -> argmax prediction,
+original label values preserved, persistence round-trip, error probes."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.classification import (
+    LinearSVC,
+    LogisticRegression,
+    OneVsRest,
+    OneVsRestModel,
+)
+
+
+def _data(n=600, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[2.0, 0.0], [-2.0, 1.0], [0.0, -2.5]])
+    y = rng.integers(0, 3, size=n)
+    X = centers[y] + 0.4 * rng.normal(size=(n, 2))
+    # non-contiguous label VALUES to prove inventory mapping
+    labels = np.array([10.0, 20.0, 30.0])[y]
+    return Table({"features": X, "label": labels}), labels
+
+
+def _base_lr():
+    return (LogisticRegression().set_max_iter(30).set_learning_rate(0.5)
+            .set_global_batch_size(128)
+            .set_raw_prediction_col("rawPrediction"))
+
+
+def test_three_class_accuracy_and_label_values():
+    t, labels = _data()
+    model = OneVsRest(_base_lr()).fit(t)
+    out = model.transform(t)[0]
+    pred = np.asarray(out[model.get_prediction_col()]).ravel()
+    assert set(np.unique(pred)) <= {10.0, 20.0, 30.0}
+    assert (pred == labels).mean() > 0.93
+    raw = np.asarray(out[model.get_raw_prediction_col()])
+    assert raw.shape == (len(labels), 3)
+
+
+def test_works_with_linearsvc_base():
+    t, labels = _data(seed=1)
+    base = (LinearSVC().set_max_iter(30).set_learning_rate(0.3)
+            .set_global_batch_size(128)
+            .set_raw_prediction_col("rawPrediction"))
+    model = OneVsRest(base).fit(t)
+    pred = np.asarray(model.transform(t)[0]
+                      [model.get_prediction_col()]).ravel()
+    assert (pred == labels).mean() > 0.9
+
+
+def test_save_load_round_trip(tmp_path):
+    t, _ = _data(n=300)
+    model = OneVsRest(_base_lr()).fit(t)
+    path = str(tmp_path / "ovr")
+    model.save(path)
+    loaded = OneVsRestModel.load(path)
+    np.testing.assert_array_equal(
+        np.asarray(loaded.transform(t)[0][model.get_prediction_col()]),
+        np.asarray(model.transform(t)[0][model.get_prediction_col()]))
+
+
+def test_requires_classifier_and_raw_col():
+    t, _ = _data(n=60)
+    with pytest.raises(ValueError, match="set_classifier"):
+        OneVsRest().fit(t)
+    base = LogisticRegression().set_raw_prediction_col("")
+    with pytest.raises(ValueError, match="rawPredictionCol"):
+        OneVsRest(base).fit(t)
+
+
+def test_single_class_rejected():
+    t = Table({"features": np.zeros((10, 2)), "label": np.ones(10)})
+    with pytest.raises(ValueError, match=">= 2 label values"):
+        OneVsRest(_base_lr()).fit(t)
+
+
+def test_estimator_save_load_keeps_classifier(tmp_path):
+    t, labels = _data(n=200)
+    est = OneVsRest(_base_lr())
+    path = str(tmp_path / "est")
+    est.save(path)
+    reloaded = OneVsRest.load(path)
+    model = reloaded.fit(t)
+    pred = np.asarray(model.transform(t)[0]
+                      [model.get_prediction_col()]).ravel()
+    assert (pred == labels).mean() > 0.9
+
+
+def test_multiclass_base_rejected_cleanly():
+    from flink_ml_tpu.models.classification import SoftmaxRegression
+
+    t, _ = _data(n=90)
+    base = (SoftmaxRegression().set_max_iter(2)
+            .set_raw_prediction_col("rawPrediction"))
+    with pytest.raises(ValueError, match="ONE score per row"):
+        OneVsRest(base).fit(t).transform(t)
