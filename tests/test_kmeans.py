@@ -312,3 +312,50 @@ def test_kmeans_outofcore_empty_reader_raises():
 
     with pytest.raises(ValueError, match="empty"):
         kmeans_fit_outofcore(lambda: iter(()), 2, max_iter=2)
+
+
+class TestKMeansPlusPlus:
+    def test_seeding_picks_distinct_dataset_points(self):
+        from flink_ml_tpu.models.clustering.kmeans import (
+            select_kmeanspp_centroids)
+
+        rng = np.random.default_rng(0)
+        pts = rng.normal(size=(500, 3)).astype(np.float32)
+        init = select_kmeanspp_centroids(pts, 8, seed=1)
+        assert init.shape == (8, 3)
+        # every chosen centroid IS a dataset point, all distinct
+        matches = (np.abs(pts[None, :, :] - init[:, None, :])
+                   .sum(-1) < 1e-7).any(axis=1)
+        assert matches.all()
+        assert len(np.unique(init.round(5), axis=0)) == 8
+        # deterministic per seed
+        np.testing.assert_array_equal(
+            init, select_kmeanspp_centroids(pts, 8, seed=1))
+
+    def test_covers_separated_clusters(self):
+        from flink_ml_tpu.models.clustering.kmeans import (
+            select_kmeanspp_centroids)
+
+        rng = np.random.default_rng(2)
+        centers = np.array([[0.0, 0.0], [50.0, 0.0], [0.0, 50.0]])
+        pts = np.concatenate([c + 0.5 * rng.normal(size=(200, 2))
+                              for c in centers]).astype(np.float32)
+        init = select_kmeanspp_centroids(pts, 3, seed=0)
+        # one seed per cluster: nearest true center of each pick is unique
+        owner = np.argmin(((init[:, None, :] - centers[None])**2).sum(-1),
+                          axis=1)
+        assert set(owner) == {0, 1, 2}
+
+    def test_estimator_init_mode_param(self):
+        rng = np.random.default_rng(3)
+        centers = np.array([[0.0, 0.0], [30.0, 0.0]])
+        pts = np.concatenate([c + rng.normal(size=(100, 2))
+                              for c in centers])
+        t = Table({"features": pts})
+        model = (KMeans().set_k(2).set_max_iter(10)
+                 .set_init_mode("k-means++").fit(t))
+        assign = np.asarray(model.transform(t)[0]["prediction"])
+        assert len(set(assign[:100])) == 1 and len(set(assign[100:])) == 1
+        assert assign[0] != assign[100]
+        with pytest.raises(Exception):
+            KMeans().set_init_mode("banana")
