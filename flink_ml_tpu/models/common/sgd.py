@@ -754,6 +754,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                       prefetch_depth: int = 2,
                       prefetch_workers: int = 1,
                       prefetch_stats=None,
+                      ell_ovf_cap: Optional[int] = None,
+                      ell_heavy_cap: int = 16,
                       checkpoint=None,
                       checkpoint_every_steps: int = 0,
                       resume: bool = False
@@ -778,7 +780,12 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     reader feeds the **mixed** Criteo-native layout instead — a dense
     block plus hashed categorical indices with implicit value 1.0 (the
     :func:`sgd_fit_mixed` layout, the fastest LR path on TPU).  Either
-    way 2^20+ dims stream from disk without ever densifying.
+    way 2^20+ dims stream from disk without ever densifying.  On a
+    single TPU device the mixed path plans the ELL scatter kernel: each
+    batch's static routing builds in the prefetch decode workers
+    (overlapping the device step) with fixed capacities
+    (``ell_ovf_cap``/``ell_heavy_cap`` — one compiled program for every
+    batch; an over-cap batch raises with sizing guidance).
 
     Unlike :func:`sgd_fit`, the READER owns the data layout:
     ``config.global_batch_size`` and ``config.seed`` are inert here — batch
@@ -808,9 +815,18 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                          "for the mixed layout)")
     if dense_key is not None and indices_key is None:
         raise ValueError("dense_key requires indices_key")
-    update = (_mixed_update(loss_fn, config) if mixed
-              else (_sparse_update if sparse
-                    else _linear_update)(loss_fn, config))
+    # mixed batches on a single TPU device route through the ELL kernel:
+    # the per-batch routing builds in the PREFETCH decode workers, so the
+    # host sort overlaps the device step like any other decode work.
+    # Caps are static (one compiled program for every batch).
+    stream_ell = mixed and plan_mixed_impl(num_features, mesh) == "ell"
+    if stream_ell:
+        update = _mixed_update_ell(
+            loss_fn, config, use_pallas=jax.default_backend() == "tpu")
+    else:
+        update = (_mixed_update(loss_fn, config) if mixed
+                  else (_sparse_update if sparse
+                        else _linear_update)(loss_fn, config))
     batch_step = jax.jit(update, donate_argnums=0)
 
     manager: Optional[CheckpointManager] = None
@@ -821,9 +837,18 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
 
     x_sh = NamedSharding(mesh, P("data", None))
     v_sh = NamedSharding(mesh, P("data"))
-    sharding = ((x_sh, x_sh, v_sh, v_sh) if (sparse or mixed)
-                else (x_sh, v_sh, v_sh))
+    r_sh = NamedSharding(mesh, P())      # layout grids: single device
+    if stream_ell:
+        # (dense, cat, src, pos, mask, ovf_idx, ovf_src, heavy_idx,
+        #  heavy_cnt, y, w)
+        sharding = (x_sh, x_sh, r_sh, r_sh, r_sh, r_sh, r_sh, r_sh, r_sh,
+                    v_sh, v_sh)
+    else:
+        sharding = ((x_sh, x_sh, v_sh, v_sh) if (sparse or mixed)
+                    else (x_sh, v_sh, v_sh))
     batch_rows: list = []   # fixed after first batch
+    import threading as _threading
+    _rows_lock = _threading.Lock()
 
     def _pad_rows(arrs, rows):
         have = arrs[0].shape[0]
@@ -853,12 +878,38 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         y = np.asarray(batch[label_key], np.float32)
         w = (np.asarray(batch[weight_key], np.float32) if weight_key
              else np.ones((y.shape[0],), np.float32))
-        if not batch_rows:
-            rows = y.shape[0]
-            rows += (-rows) % n_dev   # data-axis divisibility
-            batch_rows.append(rows)
+        with _rows_lock:
+            # under prefetch_workers > 1 two first batches can race; the
+            # lock makes exactly one win (a mis-sized winner — possible
+            # only for cursorless readers whose final partial batch is
+            # transformed first — still fails loudly in _pad_rows)
+            if not batch_rows:
+                rows = y.shape[0]
+                rows += (-rows) % n_dev   # data-axis divisibility
+                batch_rows.append(rows)
         # final partial batch: pad, weight 0
-        return _pad_rows(feats + (y, w), batch_rows[0])
+        padded = _pad_rows(feats + (y, w), batch_rows[0])
+        if stream_ell:
+            from ...ops.ell_scatter import ell_layout
+
+            dense_p, cat_p = padded[0], padded[1]
+            n_valid = y.shape[0]
+            if n_valid < batch_rows[0]:
+                # padding rows' indices become sentinels the layout
+                # drops (zero-pads would fabricate a heavy index 0;
+                # their margin gathers clamp and carry weight 0)
+                cat_p = cat_p.copy()
+                cat_p[n_valid:] = num_features
+            cap = (ell_ovf_cap if ell_ovf_cap is not None
+                   else max(1024, batch_rows[0]))
+            lay = ell_layout(cat_p[None], num_features,
+                             pad_ovf_cap=cap,
+                             pad_heavy_cap=ell_heavy_cap, device=False)
+            return (dense_p, cat_p,
+                    lay.src[0], lay.pos[0], lay.mask[0], lay.ovf_idx[0],
+                    lay.ovf_src[0], lay.heavy_idx[0],
+                    lay.heavy_cnt[0]) + padded[2:]
+        return padded
 
     params = replicate(
         {"w": jnp.zeros((num_features,), jnp.float32),

@@ -132,6 +132,15 @@ def _ell_one_step(flat: np.ndarray, batch: int, nnz: int, rows: int,
     layout also emits the value arrays and the heavy matrix holds VALUE
     SUMS instead of counts (the (indices, values) sparse layout)."""
     b_of = np.repeat(np.arange(batch, dtype=np.int32), nnz)
+    # sentinel indices (>= num_features, e.g. padding rows marked by the
+    # streaming trainer) drop out of the layout entirely — a zero-pad
+    # would fabricate an artificially heavy index 0
+    in_range = flat < rows * _LANES
+    if not in_range.all():
+        flat = flat[in_range]
+        b_of = b_of[in_range]
+        if values is not None:
+            values = values[in_range]
     order = np.argsort(flat, kind="stable")
     sidx = flat[order]
     ssrc = b_of[order]
@@ -177,11 +186,23 @@ def _ell_one_step(flat: np.ndarray, batch: int, nnz: int, rows: int,
 
 def ell_layout(cat_indices: np.ndarray, num_features: int,
                heavy_threshold: int = HEAVY_THRESHOLD,
-               values: "Optional[np.ndarray]" = None) -> EllLayout:
+               values: "Optional[np.ndarray]" = None,
+               pad_ovf_cap: Optional[int] = None,
+               pad_heavy_cap: Optional[int] = None,
+               device: bool = True) -> EllLayout:
     """Build the static routing from a ``(steps, batch, nnz)`` int epoch
     tensor of categorical indices (host numpy; one-time per fit).  Pass
     ``values`` (same shape, float) for the generic sparse layout —
-    slots then scatter ``value * r`` instead of ``r``."""
+    slots then scatter ``value * r`` instead of ``r``.
+
+    ``pad_ovf_cap`` / ``pad_heavy_cap`` force EXACT capacities (for
+    streaming callers whose every batch must share one compiled shape);
+    a batch exceeding a forced cap raises rather than dropping slots.
+    ``device=False`` keeps every array host numpy (streaming callers
+    hand the layout to a prefetch pipeline that does the one
+    device_put; a device round-trip per batch would defeat the
+    overlap).  Indices >= num_features are sentinels and drop out of
+    the layout (padding rows)."""
     _check_heavy_threshold(heavy_threshold)
     steps, batch, nnz = cat_indices.shape
     rows = num_features // _LANES
@@ -191,11 +212,22 @@ def ell_layout(cat_indices: np.ndarray, num_features: int,
         None if values is None
         else np.asarray(values[s], np.float32).reshape(-1))
         for s in range(steps)]
-    cap = max(8, max(o[3].size for o in outs))
+    need_ovf = max(o[3].size for o in outs)
+    need_heavy = max(o[5].size for o in outs)
+    if pad_ovf_cap is not None and need_ovf > pad_ovf_cap:
+        raise ValueError(
+            f"overflow needs {need_ovf} slots > forced cap {pad_ovf_cap}; "
+            "raise the cap (streaming: ell_ovf_cap)")
+    if pad_heavy_cap is not None and need_heavy > pad_heavy_cap:
+        raise ValueError(
+            f"{need_heavy} heavy indices > forced cap {pad_heavy_cap}; "
+            "raise the cap (streaming: ell_heavy_cap)")
+    cap = pad_ovf_cap if pad_ovf_cap is not None else max(8, need_ovf)
     cap += (-cap) % 8
     ovf_idx = np.zeros((steps, cap), np.int32)
     ovf_src = np.full((steps, cap), batch, np.int32)
-    H = max(1, max(o[5].size for o in outs))
+    H = (pad_heavy_cap if pad_heavy_cap is not None
+         else max(1, need_heavy))
     heavy_idx = np.zeros((steps, H), np.int32)
     heavy_cnt = np.zeros((steps, H, batch),
                          np.int16 if values is None else np.float32)
@@ -211,14 +243,15 @@ def ell_layout(cat_indices: np.ndarray, num_features: int,
         if values is not None:
             val[s] = o[7]
             ovf_val[s, :o[8].size] = o[8]
+    wrap = jnp.asarray if device else np.asarray
     return EllLayout(
-        src=jnp.asarray(np.stack([o[0] for o in outs])),
-        pos=jnp.asarray(np.stack([o[1] for o in outs])),
-        mask=jnp.asarray(np.stack([o[2] for o in outs])),
-        ovf_idx=jnp.asarray(ovf_idx), ovf_src=jnp.asarray(ovf_src),
-        heavy_idx=jnp.asarray(heavy_idx), heavy_cnt=jnp.asarray(heavy_cnt),
-        val=None if val is None else jnp.asarray(val),
-        ovf_val=None if ovf_val is None else jnp.asarray(ovf_val),
+        src=wrap(np.stack([o[0] for o in outs])),
+        pos=wrap(np.stack([o[1] for o in outs])),
+        mask=wrap(np.stack([o[2] for o in outs])),
+        ovf_idx=wrap(ovf_idx), ovf_src=wrap(ovf_src),
+        heavy_idx=wrap(heavy_idx), heavy_cnt=wrap(heavy_cnt),
+        val=None if val is None else wrap(val),
+        ovf_val=None if ovf_val is None else wrap(ovf_val),
         batch=batch, num_features=num_features)
 
 

@@ -240,3 +240,73 @@ def test_prefetch_workers_propagates_transform_error():
         for b in prefetch_to_device(range(10), transform=bad, workers=2):
             out.append(b)
     assert len(out) <= 3
+
+
+def test_streaming_ell_path_matches_xla(tmp_path, monkeypatch):
+    """The out-of-core mixed trainer's ELL streaming path (per-batch
+    layouts built in the decode workers) must reproduce the plain XLA
+    path exactly.  CPU forces use_pallas=False, so this exercises the
+    batch assembly + fixed-cap layouts end to end."""
+    from flink_ml_tpu.data.datacache import DataCacheReader, DataCacheWriter
+    from flink_ml_tpu.models.common import sgd
+    from flink_ml_tpu.models.common.losses import logistic_loss
+
+    rng = np.random.default_rng(4)
+    n, nd, nc, d = 3000, 4, 6, 128 * 128
+    dense = rng.normal(size=(n, nd)).astype(np.float32)
+    cat = rng.integers(0, d, size=(n, nc)).astype(np.int32)
+    cat[:, 0] = 777                    # heavy hitter every row
+    y = rng.integers(0, 2, size=n).astype(np.float32)
+
+    cache = str(tmp_path / "cache")
+    w = DataCacheWriter(cache, segment_rows=1024)
+    w.append({"d": dense, "c": cat, "label": y})
+    w.finish()
+
+    cfg = sgd.SGDConfig(learning_rate=0.4, max_epochs=3, tol=0)
+
+    def fit(force_ell):
+        if force_ell:
+            monkeypatch.setattr(sgd, "plan_mixed_impl",
+                                lambda *a, **k: "ell")
+        else:
+            monkeypatch.setattr(sgd, "plan_mixed_impl",
+                                lambda *a, **k: "xla")
+        state, log = sgd.sgd_fit_outofcore(
+            logistic_loss,
+            lambda: DataCacheReader(cache, batch_rows=640),
+            num_features=d, config=cfg, dense_key="d", indices_key="c",
+            prefetch_workers=2)
+        return state, log
+
+    s_ell, log_ell = fit(True)
+    s_xla, log_xla = fit(False)
+    np.testing.assert_allclose(s_ell.coefficients, s_xla.coefficients,
+                               atol=1e-5)
+    np.testing.assert_allclose(log_ell, log_xla, rtol=1e-6)
+
+
+def test_streaming_ell_cap_exceeded_raises(tmp_path, monkeypatch):
+    from flink_ml_tpu.data.datacache import DataCacheReader, DataCacheWriter
+    from flink_ml_tpu.models.common import sgd
+    from flink_ml_tpu.models.common.losses import logistic_loss
+
+    rng = np.random.default_rng(5)
+    n, d = 600, 128 * 128
+    dense = rng.normal(size=(n, 2)).astype(np.float32)
+    # every row hits idx 300 and 301: both overflow ELL (not heavy at
+    # threshold 512... 600 > 512 -> heavy actually; use two sub-heavy)
+    cat = np.stack([np.full(n, 300), np.full(n, 301),
+                    rng.integers(0, d, size=n)], axis=1).astype(np.int32)
+    y = rng.integers(0, 2, size=n).astype(np.float32)
+    cache = str(tmp_path / "cache")
+    w = DataCacheWriter(cache, segment_rows=1024)
+    w.append({"d": dense, "c": cat, "label": y})
+    w.finish()
+
+    monkeypatch.setattr(sgd, "plan_mixed_impl", lambda *a, **k: "ell")
+    with pytest.raises(ValueError, match="heavy indices > forced cap"):
+        sgd.sgd_fit_outofcore(
+            logistic_loss, lambda: DataCacheReader(cache, batch_rows=600),
+            num_features=d, config=sgd.SGDConfig(max_epochs=1, tol=0),
+            dense_key="d", indices_key="c", ell_heavy_cap=1)
