@@ -25,7 +25,8 @@ import numpy as np
 
 from .table import Table
 
-__all__ = ["CountWindows", "EventTimeWindows", "windows_of"]
+__all__ = ["CountWindows", "EventTimeWindows", "cursor_adapter",
+           "windows_of"]
 
 
 class CountWindows:
@@ -188,3 +189,22 @@ def windows_of(source: Any, window_rows: int) -> Iterator[Table]:
     if isinstance(source, Table):
         return iter(CountWindows(source, window_rows))
     return iter(source)
+
+
+def cursor_adapter(source: Any, payloads):
+    """Iterable whose items come from ``payloads()`` (a zero-arg generator
+    factory) while ``snapshot``/``restore`` delegate to ``source`` — THE
+    shim the checkpointed online estimators hand to ``iterate`` so the
+    stream cursor rides the checkpoint (one copy; OnlineLogisticRegression
+    and OnlineKMeans both route through it)."""
+
+    class _CursorAdapter:
+        def __iter__(self):
+            return payloads()
+
+        def __getattr__(self, name):
+            if name in ("snapshot", "restore"):
+                return getattr(source, name)  # AttributeError if absent
+            raise AttributeError(name)
+
+    return _CursorAdapter()

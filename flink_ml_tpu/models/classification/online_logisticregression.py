@@ -228,19 +228,6 @@ class OnlineLogisticRegression(HasFeaturesCol, HasLabelCol, HasWeightCol,
                         "it to sniff the width)")
                 yield feats, y, w
 
-        class _CursorAdapter:
-            """Iterable of payloads whose snapshot/restore delegate to the
-            underlying windowed source (WindowLog, Count/EventTimeWindows,
-            DataCacheReader...) so the cursor rides the checkpoint."""
-
-            def __iter__(self):
-                return payloads()
-
-            def __getattr__(self, name):
-                if name in ("snapshot", "restore"):
-                    return getattr(source, name)  # AttributeError if absent
-                raise AttributeError(name)
-
         def body(state, epoch, data):
             feats, y, w = data
             # pytree structure picks the kernel at trace time
@@ -265,8 +252,10 @@ class OnlineLogisticRegression(HasFeaturesCol, HasLabelCol, HasWeightCol,
                                         np.float64)
                     versions.append(LinearState(w_host, 0.0))
 
+        from ...data.stream import cursor_adapter
+
         result = iterate(
-            body, state0, _CursorAdapter(),
+            body, state0, cursor_adapter(source, payloads),
             config=IterationConfig(mode="hosted", jit=True),
             listeners=[VersionEmitter()],
             checkpoint=checkpoint, resume=resume,

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...api.stage import Estimator
-from ...data.stream import windows_of
+from ...data.stream import CountWindows, cursor_adapter, \
+    windows_of
 from ...data.table import Table
 from ...distance import DistanceMeasure
 from ...iteration import (
@@ -85,21 +86,48 @@ class OnlineKMeans(KMeansParams, Estimator[OnlineKMeansModel]):
         self._initial_centroids = np.asarray(table["centroids"][0], np.float32)
         return self
 
-    def fit(self, *inputs) -> OnlineKMeansModel:
+    def fit(self, *inputs, checkpoint=None,
+            resume: bool = False) -> OnlineKMeansModel:
         """``fit(stream)``: an iterable of Tables (windows).  Returns when
-        the stream ends."""
+        the stream ends.
+
+        ``checkpoint``/``resume`` cut the (centroids, weights) state and
+        the source cursor together (the OnlineLogisticRegression
+        contract; wrap live feeds in ``data.wal.WindowLog``).
+        Checkpointed fits must warm-start via ``set_initial_model_data``:
+        sniffing init centroids from the first window would consume it
+        BEFORE the checkpoint cursor repositions the stream."""
         (source,) = inputs
         k = self.get_k()
         alpha = self.get_decay_factor()
         measure = DistanceMeasure.get_instance(self.get_distance_measure())
         feat = self.get_features_col()
 
-        batches = windows_of(source, max(k, 256))
-        first = next(batches, None)
-        if first is None:
-            raise ValueError("OnlineKMeans.fit got an empty stream")
+        if checkpoint is not None:
+            if self._initial_centroids is None:
+                raise ValueError(
+                    "checkpointed streaming fit needs "
+                    "set_initial_model_data: sniffing init centroids "
+                    "would consume a window before the cursor restores")
+            if isinstance(source, Table):
+                # a bare Table has no cursor; window it explicitly so the
+                # checkpoint can reposition it (the OLR contract)
+                source = CountWindows(source, max(k, 256))
+            if not (hasattr(source, "snapshot")
+                    and hasattr(source, "restore")):
+                raise ValueError(
+                    "checkpointed streaming fit needs a source with a "
+                    "cursor (snapshot/restore), e.g. CountWindows or a "
+                    "WindowLog-wrapped live feed")
+            first = None
+        else:
+            batches_sniff = windows_of(source, max(k, 256))
+            first = next(batches_sniff, None)
+            if first is None:
+                raise ValueError("OnlineKMeans.fit got an empty stream")
 
-        first_X = stack_vectors(first[feat]).astype(np.float32)
+        first_X = (stack_vectors(first[feat]).astype(np.float32)
+                   if first is not None else None)
         if self._initial_centroids is not None:
             init = self._initial_centroids
             if init.shape[0] != k:
@@ -125,9 +153,13 @@ class OnlineKMeans(KMeansParams, Estimator[OnlineKMeansModel]):
                 centroids)
             return new_centroids, denom
 
-        def rechained():
-            yield first_X
-            for t in batches:
+        def payloads():
+            if first is not None:
+                yield first_X
+                stream = batches_sniff
+            else:
+                stream = windows_of(source, max(k, 256))
+            for t in stream:
                 yield stack_vectors(t[feat]).astype(np.float32)
 
         def body(state, epoch, X):
@@ -136,8 +168,13 @@ class OnlineKMeans(KMeansParams, Estimator[OnlineKMeansModel]):
             return IterationBodyResult((new_c, new_w))
 
         state0 = (jnp.asarray(init), jnp.zeros((k,), jnp.float32))
-        result = iterate(body, state0, rechained(),
-                         config=IterationConfig(mode="hosted", jit=False))
+        result = iterate(body, state0, cursor_adapter(source, payloads),
+                         config=IterationConfig(mode="hosted", jit=False),
+                         checkpoint=checkpoint, resume=resume)
+        if result.num_epochs == 0:
+            # a real resume always lands at >= 1 epoch, so zero means an
+            # empty stream either way
+            raise ValueError("OnlineKMeans.fit got an empty stream")
 
         centroids = np.asarray(jax.device_get(result.state[0]))
         model = OnlineKMeansModel()

@@ -153,3 +153,59 @@ def test_online_kmeans_initial_centroid_count_mismatch():
                      .normal(size=(8, 2)).astype(np.float32)})]
     with pytest.raises(ValueError, match="2 centroids but k=3"):
         est.fit(stream)
+
+
+class TestOnlineKMeansCheckpoint:
+    def test_kill_and_resume_matches_uninterrupted(self, tmp_path):
+        from flink_ml_tpu.data.wal import WindowLog
+        from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+        from flink_ml_tpu.models.clustering.online_kmeans import OnlineKMeans
+
+        rng = np.random.default_rng(1)
+        centers = np.array([[0.0, 0.0], [12.0, 0.0]])
+        windows = []
+        for i in range(9):
+            pts = np.concatenate(
+                [c + rng.normal(size=(40, 2)) for c in centers])
+            windows.append(Table({"features": pts}))
+        init = Table({"centroids": np.array([[1.0, 1.0],
+                                             [10.0, 1.0]])[None]})
+
+        def est():
+            return (OnlineKMeans().set_k(2).set_decay_factor(0.8)
+                    .set_initial_model_data(init))
+
+        oracle = est().fit(iter(windows))
+
+        class Killed(RuntimeError):
+            pass
+
+        def dying(ws, k):
+            for i, w in enumerate(ws):
+                if i == k:
+                    raise Killed()
+                yield w
+
+        wal = str(tmp_path / "wal")
+        ckpt = CheckpointConfig(str(tmp_path / "ckpt"), interval=3)
+        with pytest.raises(Killed):
+            est().fit(WindowLog(dying(windows, 7), wal), checkpoint=ckpt)
+        resumed = est().fit(WindowLog(iter(windows[7:]), wal),
+                            checkpoint=ckpt, resume=True)
+        got = np.asarray(resumed.get_model_data()[0]["centroids"][0])
+        want = np.asarray(oracle.get_model_data()[0]["centroids"][0])
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        assert resumed.model_version == oracle.model_version == 9
+
+    def test_checkpoint_requires_warm_start_and_cursor(self, tmp_path):
+        from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+        from flink_ml_tpu.models.clustering.online_kmeans import OnlineKMeans
+
+        ckpt = CheckpointConfig(str(tmp_path / "c"))
+        t = Table({"features": np.zeros((8, 2))})
+        with pytest.raises(ValueError, match="set_initial_model_data"):
+            OnlineKMeans().set_k(2).fit(iter([t]), checkpoint=ckpt)
+        init = Table({"centroids": np.zeros((1, 2, 2))})
+        with pytest.raises(ValueError, match="cursor"):
+            (OnlineKMeans().set_k(2).set_initial_model_data(init)
+             .fit(iter([t]), checkpoint=ckpt))
