@@ -1,0 +1,59 @@
+"""Model selection: CrossValidator over a scaler -> LogisticRegression
+Pipeline with a hyperparameter grid, then OneVsRest for multiclass.
+
+Run: python examples/model_selection_example.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+from flink_ml_tpu import CrossValidator, ParamGridBuilder, Pipeline, Table
+from flink_ml_tpu.models.classification import (LogisticRegression,
+                                                OneVsRest)
+from flink_ml_tpu.models.evaluation.binary_evaluator import (
+    BinaryClassificationEvaluator,
+)
+from flink_ml_tpu.models.feature.scalers import StandardScaler
+
+rng = np.random.default_rng(0)
+n = 1000
+X = rng.normal(size=(n, 5)) * np.array([20.0, 0.05, 1.0, 1.0, 1.0])
+y = (X[:, 0] / 20 + 20 * X[:, 1] + 0.3 * X[:, 2] > 0).astype(np.float64)
+table = Table({"features": X, "label": y})
+
+# --- CV over a pipeline: grid binds into the LR child by param identity --
+pipe = Pipeline([
+    StandardScaler().set_output_col("features"),
+    (LogisticRegression().set_learning_rate(0.5)
+     .set_global_batch_size(256)),
+])
+grid = (ParamGridBuilder()
+        .add_grid(LogisticRegression.REG, [0.0, 0.05])
+        .add_grid(LogisticRegression.MAX_ITER, [3, 30])
+        .build())
+evaluator = (BinaryClassificationEvaluator()
+             .set_raw_prediction_col("rawPrediction")
+             .set_metrics("areaUnderROC"))
+
+cv = CrossValidator(pipe, evaluator, grid).set_num_folds(3).set_seed(7)
+model = cv.fit(table)
+print("candidate AUCs:", [round(a, 4) for a in model.avg_metrics])
+print("best:", {p.name: v for p, v in model.best_params.items()})
+pred = np.asarray(model.transform(table)[0]["prediction"]).ravel()
+print("refit accuracy:", round(float((pred == y).mean()), 3))
+
+# --- OneVsRest: the binary winner config, lifted to 3 classes -----------
+centers = np.array([[3.0, 0.0], [-3.0, 1.5], [0.0, -3.0]])
+yk = rng.integers(0, 3, size=900)
+Xm = centers[yk] + 0.5 * rng.normal(size=(900, 2))
+multi = Table({"features": Xm, "label": yk.astype(np.float64)})
+ovr = OneVsRest(LogisticRegression().set_max_iter(30)
+                .set_learning_rate(0.5).set_global_batch_size(256)
+                .set_raw_prediction_col("rawPrediction"))
+m = ovr.fit(multi)
+pm = np.asarray(m.transform(multi)[0][m.get_prediction_col()]).ravel()
+print("one-vs-rest accuracy:", round(float((pm == yk).mean()), 3))
