@@ -26,7 +26,7 @@ import numpy as np
 from .table import Table
 
 __all__ = ["CountWindows", "EventTimeWindows", "cursor_adapter",
-           "windows_of"]
+           "ensure_cursor_source", "windows_of"]
 
 
 class CountWindows:
@@ -189,6 +189,23 @@ def windows_of(source: Any, window_rows: int) -> Iterator[Table]:
     if isinstance(source, Table):
         return iter(CountWindows(source, window_rows))
     return iter(source)
+
+
+def ensure_cursor_source(source: Any, window_rows: int):
+    """THE checkpoint-source preparation shared by the online estimators:
+    a bare Table auto-wraps in :class:`CountWindows` (it has no cursor of
+    its own), and anything without ``snapshot``/``restore`` is rejected —
+    resume would otherwise silently re-train already-consumed windows."""
+    if isinstance(source, Table):
+        source = CountWindows(source, window_rows)
+    if not (hasattr(source, "snapshot") and hasattr(source, "restore")):
+        raise ValueError(
+            "checkpointed streaming fit needs a source with a cursor "
+            "(snapshot/restore): resume would otherwise silently re-train "
+            "already-consumed windows.  Use CountWindows / "
+            "EventTimeWindows / DataCacheReader, or wrap a live feed in "
+            "flink_ml_tpu.data.wal.WindowLog")
+    return source
 
 
 def cursor_adapter(source: Any, payloads):

@@ -153,20 +153,11 @@ class OnlineLogisticRegression(HasFeaturesCol, HasLabelCol, HasWeightCol,
         resume = bool(kwargs.pop("resume", False))
         if kwargs:
             raise TypeError(f"unexpected kwargs: {sorted(kwargs)}")
-        if checkpoint is not None and isinstance(source, Table):
-            # a bare Table has no cursor; window it explicitly so the
-            # checkpoint can reposition it on resume
-            from ...data.stream import CountWindows
+        if checkpoint is not None:
+            from ...data.stream import ensure_cursor_source
 
-            source = CountWindows(source, self.get_global_batch_size())
-        if checkpoint is not None and not (
-                hasattr(source, "snapshot") and hasattr(source, "restore")):
-            raise ValueError(
-                "checkpointed streaming fit needs a source with a cursor "
-                "(snapshot/restore): resume would otherwise silently "
-                "re-train already-consumed windows.  Use CountWindows / "
-                "EventTimeWindows / DataCacheReader, or wrap a live feed "
-                "in flink_ml_tpu.data.wal.WindowLog")
+            source = ensure_cursor_source(source,
+                                          self.get_global_batch_size())
         reg, alpha_mix = self.get_reg(), self.get_elastic_net()
         l1, l2 = reg * alpha_mix, reg * (1.0 - alpha_mix)
         alpha, beta = self.get_alpha(), self.get_beta()

@@ -23,8 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...api.stage import Estimator
-from ...data.stream import CountWindows, cursor_adapter, \
-    windows_of
+from ...data.stream import (cursor_adapter,
+                            ensure_cursor_source, windows_of)
 from ...data.table import Table
 from ...distance import DistanceMeasure
 from ...iteration import (
@@ -109,16 +109,7 @@ class OnlineKMeans(KMeansParams, Estimator[OnlineKMeansModel]):
                     "checkpointed streaming fit needs "
                     "set_initial_model_data: sniffing init centroids "
                     "would consume a window before the cursor restores")
-            if isinstance(source, Table):
-                # a bare Table has no cursor; window it explicitly so the
-                # checkpoint can reposition it (the OLR contract)
-                source = CountWindows(source, max(k, 256))
-            if not (hasattr(source, "snapshot")
-                    and hasattr(source, "restore")):
-                raise ValueError(
-                    "checkpointed streaming fit needs a source with a "
-                    "cursor (snapshot/restore), e.g. CountWindows or a "
-                    "WindowLog-wrapped live feed")
+            source = ensure_cursor_source(source, max(k, 256))
             first = None
         else:
             batches_sniff = windows_of(source, max(k, 256))

@@ -17,8 +17,10 @@ from __future__ import annotations
 import numpy as np
 
 from ...api.stage import Estimator
-from ...data.stream import windows_of
+from ...data.stream import (cursor_adapter,
+                            ensure_cursor_source, windows_of)
 from ...data.table import Table
+from ...iteration import IterationBodyResult, IterationConfig, iterate
 from ...linalg import stack_vectors
 from ...utils import persist
 from .scalers import StandardScalerModel, StandardScalerParams
@@ -69,28 +71,46 @@ class OnlineStandardScalerModel(StandardScalerModel):
 
 class OnlineStandardScaler(StandardScalerParams,
                            Estimator[OnlineStandardScalerModel]):
-    def fit(self, *inputs) -> OnlineStandardScalerModel:
+    WINDOW_ROWS = 4096   # Table windowing granularity
+
+    def fit(self, *inputs, checkpoint=None,
+            resume: bool = False) -> OnlineStandardScalerModel:
         """``fit(stream)``: an iterable of Tables (windows), or one Table
-        (consumed as batches).  Returns when the stream ends."""
+        (consumed as batches).  Returns when the stream ends.
+
+        ``checkpoint``/``resume`` follow the online-estimator contract
+        (OnlineLogisticRegression/OnlineKMeans): the (count, mean, M2)
+        statistics and the source cursor cut together; wrap live feeds
+        in ``data.wal.WindowLog``.  No warm-start requirement — the
+        zero-count state is a clean merge identity, so nothing needs
+        sniffing before the cursor restores."""
         (source,) = inputs
         feat = self.get_features_col()
-        batches = windows_of(source, 4096)
+        if checkpoint is not None:
+            source = ensure_cursor_source(source, self.WINDOW_ROWS)
 
-        count = 0.0
-        mean = None
-        m2 = None
-        versions = 0
-        for t in batches:
-            X = stack_vectors(t[feat])
+        def payloads():
+            for t in windows_of(source, self.WINDOW_ROWS):
+                # empty windows pass through (skipping would desync the
+                # source cursor from the epoch count); body ignores them
+                yield stack_vectors(t[feat])
+
+        def body(state, epoch, X):
             if len(X) == 0:
-                continue
+                return IterationBodyResult(state)
             wc, wm, wm2 = _window_stats(X)
-            if mean is None:
-                count, mean, m2 = wc, wm, wm2
-            else:
-                count, mean, m2 = _merge(count, mean, m2, wc, wm, wm2)
-            versions += 1
-        if mean is None:
+            count, mean, m2 = state
+            if count == 0:
+                return IterationBodyResult((wc, wm, wm2))
+            return IterationBodyResult(_merge(count, mean, m2, wc, wm, wm2))
+
+        state0 = (0.0, np.zeros(0), np.zeros(0))
+        result = iterate(
+            body, state0, cursor_adapter(source, payloads),
+            config=IterationConfig(mode="hosted", jit=False),
+            checkpoint=checkpoint, resume=resume)
+        count, mean, m2 = result.state
+        if count == 0:
             raise ValueError("OnlineStandardScaler.fit got an empty stream")
 
         model = OnlineStandardScalerModel()
@@ -98,5 +118,5 @@ class OnlineStandardScaler(StandardScalerParams,
         model.set_model_data(Table({
             "mean": mean[None],
             "std": np.sqrt(np.maximum(m2 / count, 0.0))[None]}))
-        model.model_version = versions
+        model.model_version = result.num_epochs
         return model

@@ -232,3 +232,54 @@ def test_pairwise_host64_matches_device_small():
             np.asarray(m.pairwise(jnp.asarray(p, jnp.float32),
                                   jnp.asarray(c, jnp.float32))),
             atol=1e-4)
+
+
+class TestOnlineScalerCheckpoint:
+    def test_kill_and_resume_matches_uninterrupted(self, tmp_path):
+        from flink_ml_tpu.data.wal import WindowLog
+        from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+        from flink_ml_tpu.models.feature.online_scaler import (
+            OnlineStandardScaler)
+
+        rng = np.random.default_rng(2)
+        windows = [Table({"features": 1e4 + rng.normal(size=(64, 3))})
+                   for _ in range(10)]
+        oracle = OnlineStandardScaler().fit(iter(windows))
+
+        class Killed(RuntimeError):
+            pass
+
+        def dying(ws, k):
+            for i, w in enumerate(ws):
+                if i == k:
+                    raise Killed()
+                yield w
+
+        wal = str(tmp_path / "wal")
+        ckpt = CheckpointConfig(str(tmp_path / "ckpt"), interval=4)
+        with pytest.raises(Killed):
+            OnlineStandardScaler().fit(WindowLog(dying(windows, 7), wal),
+                                       checkpoint=ckpt)
+        resumed = OnlineStandardScaler().fit(
+            WindowLog(iter(windows[7:]), wal), checkpoint=ckpt,
+            resume=True)
+        (od,), (rd,) = oracle.get_model_data(), resumed.get_model_data()
+        np.testing.assert_allclose(np.asarray(rd["mean"]),
+                                   np.asarray(od["mean"]), rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(rd["std"]),
+                                   np.asarray(od["std"]), rtol=1e-9)
+        assert resumed.model_version == oracle.model_version == 10
+
+    def test_bare_table_checkpoint(self, tmp_path):
+        from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+        from flink_ml_tpu.models.feature.online_scaler import (
+            OnlineStandardScaler)
+
+        rng = np.random.default_rng(3)
+        t = Table({"features": rng.normal(size=(10000, 2)) * 3 + 7})
+        ckpt = CheckpointConfig(str(tmp_path / "c"), interval=1)
+        model = OnlineStandardScaler().fit(t, checkpoint=ckpt)
+        oracle = OnlineStandardScaler().fit(t)
+        (md,), (od,) = model.get_model_data(), oracle.get_model_data()
+        np.testing.assert_allclose(np.asarray(md["mean"]),
+                                   np.asarray(od["mean"]), rtol=1e-12)
