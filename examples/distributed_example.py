@@ -15,12 +15,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 
 def worker(coord: str, nprocs: int, pid: int) -> None:
-    import jax
-    from jax.extend.backend import clear_backends
+    from flink_ml_tpu.utils.backend import force_virtual_cpu
 
-    clear_backends()
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    force_virtual_cpu(2, verify=False)  # jax.distributed owns backend init
 
     import numpy as np
 

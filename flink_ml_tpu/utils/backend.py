@@ -1,0 +1,51 @@
+"""Process-level JAX backend control.
+
+One shared implementation of the "reset to an n-device virtual CPU
+platform" dance used by the driver's multi-chip dryrun, the two-process
+distributed tests, and the multi-host example.  The ordering constraints
+are sharp enough that three hand-rolled copies had already drifted apart:
+
+- ``jax_num_cpu_devices`` has a validator that raises ``RuntimeError``
+  when a backend is already initialized and the value changes, so any
+  live backend must be torn down *before* the config update.
+- With the axon TPU relay registered but unreachable, the first device
+  use (``jax.devices()``, or anything that initializes a backend) blocks
+  for many minutes in backend init, so nothing here may touch devices
+  until the platform is pinned to CPU.  ``JAX_PLATFORMS`` in the
+  environment does not help: the environment's sitecustomize consumes it
+  before user code runs.
+
+Capability parity note: this is the stand-in for the reference's
+MiniCluster test harness (flink-ml-tests
+``.../iteration/UnboundedStreamIterationITCase.java:71``), which brings
+up N task managers in one JVM; here N virtual CPU devices stand in for N
+TPU chips.
+"""
+
+from __future__ import annotations
+
+
+def force_virtual_cpu(n_devices: int, *, verify: bool = True) -> None:
+    """Pin this process to an ``n_devices``-device virtual CPU platform.
+
+    Safe to call whether or not a backend (CPU or the axon TPU relay) is
+    already initialized, and guaranteed never to touch the possibly-dead
+    TPU relay: the check + teardown operate on the backend registry only.
+
+    ``verify=False`` skips the final device-count check, leaving the
+    backend *uninitialized* — required when ``jax.distributed.initialize``
+    runs next, since it refuses to start after any device use.
+    """
+    import jax
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n_devices)
+    if verify and len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"requested {n_devices} virtual CPU devices, "
+            f"got {len(jax.devices())}")

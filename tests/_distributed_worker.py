@@ -18,18 +18,16 @@ import sys
 def main() -> None:
     coord, nprocs, pid, outdir = (sys.argv[1], int(sys.argv[2]),
                                   int(sys.argv[3]), sys.argv[4])
+    # The environment's sitecustomize may import jax and register/initialize
+    # the axon backend at interpreter startup — before this script runs.
+    # Pin a 2-device CPU platform so the distributed runtime owns backend
+    # creation (shared helper: handles the teardown-before-config ordering
+    # and never touches a possibly-dead TPU relay).
+    from flink_ml_tpu.utils.backend import force_virtual_cpu
+
+    force_virtual_cpu(2, verify=False)  # jax.distributed owns backend init
+
     import jax
-
-    # The environment's sitecustomize imports jax and initializes the axon
-    # backend at interpreter startup — before this script runs.  Tear the
-    # live backend down and pin a 2-device CPU platform so the distributed
-    # runtime owns backend creation (the same dance as
-    # __graft_entry__.dryrun_multichip).
-    from jax.extend.backend import clear_backends
-
-    clear_backends()
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
 
     from flink_ml_tpu.parallel import distributed as dist
 
