@@ -88,22 +88,51 @@ def _bind_in_children(children, param: Param, value) -> bool:
     return hit
 
 
-def _clone_with(stage, param_map: Dict[Any, Any]):
+def _clone_with(stage, param_map: Dict[Any, Any], _grid_params=None):
     """Fresh stage with ``stage``'s params plus ``param_map`` overrides.
 
     A Pipeline candidate clones its ESTIMATOR children (nested pipelines
-    recursively); transformer/model children are reused as-is — fit
-    never mutates them, and re-instantiating would drop their fitted
-    data.  Grid keys bind by param-object IDENTITY on every declaring
-    descendant (a shared ``Has*`` mixin param therefore reaches all
-    stages inheriting it); to pin a value to one top-level child, use a
-    ``(child_index, Param)`` tuple key.  A key binding nowhere is an
-    error."""
+    recursively) and any transformer/model child that declares a bound
+    grid param (so ``child.set`` on a candidate never mutates the
+    caller's original pipeline, and candidates don't share one mutable
+    stage).  A fitted Model clones as a shallow copy with its own param
+    map — its fitted data is shared by reference (fit never mutates it;
+    re-instantiating would drop it).  Grid-untouched transformer/model
+    children are reused as-is.  Grid keys bind by param-object IDENTITY
+    on every declaring descendant (a shared ``Has*`` mixin param
+    therefore reaches all stages inheriting it); to pin a value to one
+    top-level child, use a ``(child_index, Param)`` tuple key.  A key
+    binding nowhere is an error."""
     from .pipeline import Pipeline
+    from .stage import Model
+
+    # The full set of grid params steers transformer cloning through
+    # nested-pipeline recursion (where param_map is empty but the outer
+    # _bind_in_children will still reach the descendants).
+    grid_params = (_grid_params if _grid_params is not None else
+                   [key[1] if isinstance(key, tuple) else key
+                    for key in param_map])
+
+    def _clone_transformer(t):
+        if isinstance(t, Model):
+            # keep the fitted data (re-instantiating would drop it):
+            # shallow-copy the instance and give it an independent param
+            # map so grid binds never reach the caller's original
+            import copy
+
+            clone = copy.copy(t)
+            clone.__dict__["_param_map"] = dict(t.get_param_map())
+            return clone
+        clone = type(t)()
+        clone.copy_params_from(t)
+        return clone
 
     if isinstance(stage, Pipeline):
         children = [
-            _clone_with(s, {}) if isinstance(s, (Pipeline, Estimator))
+            _clone_with(s, {}, grid_params)
+            if isinstance(s, (Pipeline, Estimator))
+            else _clone_transformer(s)
+            if any(_declares(s, p) for p in grid_params)
             else s
             for s in stage.stages]
         clone = Pipeline(children)

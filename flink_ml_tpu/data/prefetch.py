@@ -32,6 +32,8 @@ import queue
 import threading
 import time
 
+from concurrent import futures
+
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Iterator, Optional
 
@@ -176,9 +178,19 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
                     if isinstance(item, BaseException):
                         put_or_abandon(q, item)
                         return
-                    batch = item.result()
+                    # stop-aware future wait, mirroring put/get_or_abandon:
+                    # an abandoned consumer must not leave this thread
+                    # blocked behind a hung transform.  Poll done-ness
+                    # rather than catching TimeoutError from result() —
+                    # futures.TimeoutError IS the builtin TimeoutError on
+                    # 3.11+, so a transform failing with e.g.
+                    # socket.timeout must still propagate, not spin.
+                    while not stop.is_set() and not item.done():
+                        futures.wait([item], timeout=0.1)
                     if stop.is_set():
+                        item.cancel()
                         return
+                    batch = item.result()
                     t0 = time.perf_counter()
                     batch = (jax.device_put(batch, sharding)
                              if sharding is not None

@@ -47,7 +47,7 @@ from ...params.shared import (
     HasWeightCol,
 )
 from .logisticregression import LogisticRegressionModel
-from ..common.sgd import LinearState
+from ..common.sgd import DEFAULT_GLOBAL_BATCH, LinearState
 
 __all__ = ["OnlineLogisticRegression", "OnlineLogisticRegressionModel"]
 
@@ -104,7 +104,7 @@ class OnlineLogisticRegression(HasFeaturesCol, HasLabelCol, HasWeightCol,
         (hashed pair columns / SparseVector rows — the Criteo shape)."""
         feat, lab = self.get_features_col(), self.get_label_col()
         wcol = self.get_weight_col()
-        batch = self.get_global_batch_size()
+        batch = self.get_global_batch_size() or DEFAULT_GLOBAL_BATCH
 
         def extract(t: Table):
             kind, feats = resolve_features(t, feat)
@@ -156,8 +156,8 @@ class OnlineLogisticRegression(HasFeaturesCol, HasLabelCol, HasWeightCol,
         if checkpoint is not None:
             from ...data.stream import ensure_cursor_source
 
-            source = ensure_cursor_source(source,
-                                          self.get_global_batch_size())
+            source = ensure_cursor_source(
+                source, self.get_global_batch_size() or DEFAULT_GLOBAL_BATCH)
         reg, alpha_mix = self.get_reg(), self.get_elastic_net()
         l1, l2 = reg * alpha_mix, reg * (1.0 - alpha_mix)
         alpha, beta = self.get_alpha(), self.get_beta()

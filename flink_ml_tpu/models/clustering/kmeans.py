@@ -66,17 +66,19 @@ class KMeansParams(KMeansModelParams, HasSeed, HasMaxIter):
     ``tiePolicy`` (beyond-reference, TPU-specific) picks the Pallas fit
     kernel's handling of EXACTLY-tied point-to-centroid distances:
 
-    - ``"fast"`` (default, what ``fit`` plans and what bench.py times):
-      a tied point counts toward EVERY minimizing centroid — its mass is
+    - ``"split"`` (default): fractional assignment across the tied
+      minimisers (exact expected-assignment semantics, matches the XLA
+      body's expected mass: total cluster mass always sums to n, and the
+      reference's single-assignment Lloyd's fit on tie-free data).
+    - ``"fast"`` (opt-in via ``setTiePolicy``; bench.py times whatever
+      ``fit`` plans, i.e. the "split" default): a tied point
+      counts toward EVERY minimizing centroid — its mass is
       double-counted, biasing the tied centroids' means toward it.  On
       continuous features exact f32 ties are measure-zero, so this is
       free; on DISCRETE/quantized features (integer grids, one-hot),
       distinct equidistant centroids are common and "fast" measurably
-      changes the fit — use "split" there.  ~45% faster per iteration
+      changes the fit — keep "split" there.  ~45% faster per iteration
       than "split" on v5e.
-    - ``"split"``: fractional assignment across the tied minimisers
-      (exact expected-assignment semantics, matches the XLA body's
-      expected mass: total cluster mass always sums to n).
 
     The XLA fallback path (non-TPU, small n, non-euclidean) always uses
     first-index argmin and ignores this param."""
@@ -94,7 +96,7 @@ class KMeansParams(KMeansModelParams, HasSeed, HasMaxIter):
         "tiePolicy",
         "Pallas-kernel handling of exactly-tied distances: 'fast' or "
         "'split'.",
-        default="fast",
+        default="split",
         validator=ParamValidators.in_array(["fast", "split"]))
 
     def get_k(self) -> int:
@@ -243,17 +245,17 @@ def kmeans_epoch_step(measure: DistanceMeasure, k: int):
 
 
 def kmeans_epoch_step_pallas(k: int, mesh=None, *, block_n: int = 8192,
-                             tie_policy: str = "fast",
+                             tie_policy: str = "split",
                              interpret: bool = False):
     """One Lloyd's iteration on the fused Pallas kernel
     (``ops/kmeans_pallas.py``): score/one-hot tiles stay in VMEM, HBM traffic
     drops ~12x vs the XLA expansion (~3.5x measured step speedup on v5e).
 
-    ``tie_policy="fast"`` (the default, what ``KMeans.fit`` plans via its
-    ``tiePolicy`` param, and what bench.py times) assigns exactly-tied
-    points to every minimizing centroid — see ``KMeansParams.TIE_POLICY``
-    for why that is benign; ``"split"`` keeps exact expected-assignment
-    semantics (fractional ties) at ~45% throughput cost.
+    ``tie_policy="split"`` (the default, what ``KMeans.fit`` plans via its
+    ``tiePolicy`` param) keeps exact expected-assignment semantics
+    (fractional ties); ``"fast"`` assigns exactly-tied points to every
+    minimizing centroid at ~45% less cost per iteration — see
+    ``KMeansParams.TIE_POLICY`` for when that is benign.
 
     Requires zero-filled padding (``fill="zero"``) with the per-shard row
     count a multiple of ``block_n``; euclidean metric only.  With a

@@ -164,6 +164,14 @@ class LinearModelBase(LinearModelParams, Model):
         was built from set_model_data/load rather than trained)."""
         return list(getattr(self, "_loss_log", []) or [])
 
+    @property
+    def planned_impl(self) -> Optional[str]:
+        """Which update implementation the fit planned ("ell" / "xla" /
+        "sharded" / "dense" / "*-stream") — what bench.py tags as
+        ``lr_impl``, surfaced on the product path (VERDICT r3 task 3).
+        None when the model was loaded rather than trained."""
+        return self._state.planned_impl if self._state is not None else None
+
     # -- inference ----------------------------------------------------------
     def _margins(self, table: Table) -> np.ndarray:
         self._require_model()

@@ -47,7 +47,11 @@ from ...params.shared import (
 from ...parallel.mesh import default_mesh, replicate
 from ...utils import persist
 from ..common.losses import logistic_loss
-from ..common.sgd import plan_epoch_layout, prepare_epoch_tensor
+from ..common.sgd import (
+    DEFAULT_GLOBAL_BATCH,
+    plan_epoch_layout,
+    prepare_epoch_tensor,
+)
 
 __all__ = ["WideDeep", "WideDeepModel", "WideDeepParams"]
 
@@ -163,7 +167,8 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
 
         n = dense.shape[0]
         steps, batch, perm = plan_epoch_layout(
-            n, self.get_global_batch_size(), n_dev, self.get_seed())
+            n, self.get_global_batch_size() or DEFAULT_GLOBAL_BATCH, n_dev,
+            self.get_seed())
 
         def layout(arr):
             return prepare_epoch_tensor(arr, perm, steps, batch)

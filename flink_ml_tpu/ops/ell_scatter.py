@@ -102,10 +102,36 @@ class EllLayout:
     # implicit-1.0 layout):
     val: Optional[jnp.ndarray] = None      # (steps, rows, 128) f32
     ovf_val: Optional[jnp.ndarray] = None  # (steps, cap) f32
+    # device-builder bookkeeping (None from the host builder, which
+    # raises on overflow instead): slots NEEDED per step, regardless of
+    # what the static caps could hold
+    need_ovf: Optional[jnp.ndarray] = None    # (steps,) i32
+    need_heavy: Optional[jnp.ndarray] = None  # (steps,) i32
 
     @property
     def steps(self) -> int:
         return self.src.shape[0]
+
+    def assert_capacities(self) -> "EllLayout":
+        """Fail loudly if the device builder dropped slots: any step whose
+        required overflow/heavy slots exceed the static caps produced a
+        silently-wrong layout (ADVICE r3).  One tiny device->host read."""
+        if self.need_ovf is not None:
+            cap = self.ovf_idx.shape[1]
+            worst = int(jnp.max(self.need_ovf))
+            if worst > cap:
+                raise ValueError(
+                    f"ELL overflow needs {worst} slots in some step > "
+                    f"ovf_cap {cap}; gradients would silently drop slots "
+                    "— raise ovf_cap")
+        if self.need_heavy is not None:
+            hcap = self.heavy_idx.shape[1]
+            worst_h = int(jnp.max(self.need_heavy))
+            if worst_h > hcap:
+                raise ValueError(
+                    f"ELL heavy path needs {worst_h} indices in some step "
+                    f"> heavy_cap {hcap}; raise heavy_cap")
+        return self
 
 
 HEAVY_THRESHOLD = 512   # slots per index per step before the dense path
@@ -262,10 +288,11 @@ def ell_layout_device(cat_indices: jnp.ndarray, num_features: int,
     """Device-side layout builder (jit, vmapped over steps) for callers
     whose epoch tensor already lives in HBM (e.g. the benchmark, where
     host round-trips are prohibitively slow through a tunnel).  Overflow
-    and heavy capacities are static; slots beyond them are dropped, so
-    callers must size ``ovf_cap``/``heavy_cap`` generously for their
-    distribution (the bench asserts the kernel path against the XLA
-    oracle before timing, which catches an undersized cap)."""
+    and heavy capacities are static; slots beyond them are DROPPED from
+    the layout, so callers must either size ``ovf_cap``/``heavy_cap``
+    generously or call :meth:`EllLayout.assert_capacities` on the result
+    (the returned ``need_ovf``/``need_heavy`` record what each step
+    actually required)."""
     _check_heavy_threshold(heavy_threshold)
     steps, batch, nnz = cat_indices.shape
     rows = num_features // _LANES
@@ -329,18 +356,22 @@ def ell_layout_device(cat_indices: jnp.ndarray, num_features: int,
             h_c = jnp.zeros((heavy_cap, batch), jnp.int16).at[
                 jnp.where(heavy_slot, h_rank, heavy_cap), ssrc].add(
                 1, mode="drop")
-        return src, Pc, mask, ovf_i, ovf_s, h_i, h_c, val, ovf_v
+        n_ovf = jnp.sum(spill.astype(jnp.int32))
+        n_heavy = jnp.sum((is_first & heavy_slot).astype(jnp.int32))
+        return src, Pc, mask, ovf_i, ovf_s, h_i, h_c, val, ovf_v, \
+            n_ovf, n_heavy
 
     flat_steps = cat_indices.reshape(steps, -1).astype(jnp.int32)
     fvals = (values.reshape(steps, -1).astype(jnp.float32) if with_values
              else jnp.zeros((steps, 1), jnp.float32))  # unused placeholder
-    src, Pc, mask, ovf_i, ovf_s, h_i, h_c, val, ovf_v = build(
-        flat_steps, fvals)
+    src, Pc, mask, ovf_i, ovf_s, h_i, h_c, val, ovf_v, n_ovf, n_heavy = \
+        build(flat_steps, fvals)
     return EllLayout(src=src, pos=Pc, mask=mask, ovf_idx=ovf_i,
                      ovf_src=ovf_s, heavy_idx=h_i, heavy_cnt=h_c,
                      val=val if with_values else None,
                      ovf_val=ovf_v if with_values else None,
-                     batch=batch, num_features=num_features)
+                     batch=batch, num_features=num_features,
+                     need_ovf=n_ovf, need_heavy=n_heavy)
 
 
 def _kernel(block_rows: int):

@@ -217,11 +217,20 @@ def put_sharded(arr: np.ndarray, mesh: Mesh, spec: P):
 
 
 def fetch_replicated(tree: Any) -> Any:
-    """device_get that also handles non-fully-addressable replicated
-    arrays (multi-host: read this process's local replica)."""
+    """device_get that also handles non-fully-addressable arrays
+    (multi-host).  A replicated array's local replica IS the global
+    value; a sharded one (e.g. the model-axis LR weight of
+    ``sgd._mixed_update_sharded``) is assembled with one cross-process
+    allgather of its shards — every process gets the full array, the
+    same collective-fetch stance as ``iteration/checkpoint.py``."""
     def get(x):
         if isinstance(x, jax.Array) and not x.is_fully_addressable:
-            return np.asarray(x.addressable_data(0))
+            if x.sharding.is_fully_replicated:
+                return np.asarray(x.addressable_data(0))
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.process_allgather(x, tiled=True))
         return np.asarray(jax.device_get(x))
 
     return jax.tree_util.tree_map(get, tree)

@@ -188,8 +188,13 @@ class HasElasticNet(WithParams):
 
 class HasGlobalBatchSize(WithParams):
     GLOBAL_BATCH_SIZE = IntParam(
-        "globalBatchSize", "Global (across all devices) mini-batch size.",
-        default=32, validator=ParamValidators.gt(0))
+        "globalBatchSize",
+        "Global (across all devices) mini-batch size.  None = auto: 32, "
+        "except mixed/sparse hashed linear fits size the batch so the ELL "
+        "scatter kernel's layout fits its HBM budget "
+        "(sgd.resolve_global_batch_size).",
+        default=None,
+        validator=lambda v: v is None or v > 0)
 
     def get_global_batch_size(self) -> int:
         return self.get(HasGlobalBatchSize.GLOBAL_BATCH_SIZE)

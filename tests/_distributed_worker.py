@@ -154,6 +154,22 @@ def main() -> None:
     np.testing.assert_allclose(log, oracle_log, atol=1e-5)
     assert log[-1] < log[0]
 
+    # dp x model over 2 OS processes (VERDICT r3 task 5): the weight
+    # itself sharded over the 'model' axis with the same shards living on
+    # BOTH hosts' devices — the final fetch is a cross-process allgather
+    # of the model axis (mesh.fetch_replicated).  Must equal the
+    # data-parallel fit above exactly.
+    from flink_ml_tpu.parallel.mesh import device_mesh
+
+    dpmp_mesh = device_mesh({"data": nprocs, "model": 2},
+                            devices=jax.devices())
+    state_mp, log_mp = sgd_fit_mixed(LOSSES["logistic"], dense_l, cat_l,
+                                     y_l, None, 256, cfg, mesh=dpmp_mesh)
+    assert state_mp.planned_impl == "sharded"
+    np.testing.assert_allclose(state_mp.coefficients, state.coefficients,
+                               atol=1e-5)
+    np.testing.assert_allclose(log_mp, log, atol=1e-5)
+
     # multi-host KMeans: each host holds a different half of 4 separated
     # clusters; the replicated centroids must recover all 4 means on BOTH
     # hosts (host 0's local selection seeds the global init).

@@ -41,7 +41,8 @@ def test_logreg_defaults():
     assert lr.get_max_iter() == 20
     assert lr.get_learning_rate() == 0.1
     assert lr.get_reg() == 0.0
-    assert lr.get_global_batch_size() == 32
+    # None = auto batch sizing (layout-aware for hashed fits, r4)
+    assert lr.get_global_batch_size() is None
     assert lr.get_label_col() == "label"
     assert lr.get_raw_prediction_col() == "rawPrediction"
 

@@ -243,3 +243,38 @@ def test_cv_pipeline_reuses_transformer_children():
     model = (CrossValidator(pipe, _auc_eval(), grid)
              .set_num_folds(2).fit(t))
     assert model.best_params[LogisticRegression.MAX_ITER] == 20
+
+
+def test_cv_pipeline_transformer_grid_param_does_not_mutate_original():
+    """ADVICE r3: a grid key targeting a plain TRANSFORMER child must
+    bind on a per-candidate clone — never on the caller's original stage
+    (and candidates must not share one mutable transformer)."""
+    from flink_ml_tpu import Pipeline
+    from flink_ml_tpu.api.model_selection import _clone_with
+    from flink_ml_tpu.models.feature.transforms import Normalizer
+
+    t = _data()
+    norm = Normalizer().set_p(2.0).set_output_col("features")
+    pipe = Pipeline([norm, _lr()])
+    grid = (ParamGridBuilder()
+            .add_grid(Normalizer.P, [1.0, 3.0])
+            .add_grid(LogisticRegression.MAX_ITER, [1, 20]).build())
+
+    # direct clone surface: binding P must not touch the original
+    c = _clone_with(pipe, {Normalizer.P: 1.0})
+    assert c.stages[0].get_p() == 1.0
+    assert norm.get_p() == 2.0
+    assert c.stages[0] is not norm
+
+    # nested pipeline: the same guarantee one level down
+    outer = Pipeline([Pipeline([norm]), _lr()])
+    c2 = _clone_with(outer, {Normalizer.P: 3.0})
+    assert c2.stages[0].stages[0].get_p() == 3.0
+    assert norm.get_p() == 2.0
+
+    # full CV run leaves the original untouched too
+    model = (CrossValidator(pipe, _auc_eval(), grid)
+             .set_num_folds(2).set_seed(5).fit(t))
+    assert norm.get_p() == 2.0
+    assert pipe.stages[1].get_max_iter() == 15
+    assert model.best_params[Normalizer.P] in (1.0, 3.0)
