@@ -1,0 +1,34 @@
+"""TPU-parity tier (VERDICT r3 task 8) — deliberately OUTSIDE tests/ so
+the unit suite's conftest (which pins the virtual CPU mesh) never
+applies.  Run explicitly before benching:
+
+    python -m pytest tests_tpu/ -m tpu -q
+
+Every test here compiles a Mosaic kernel on tiny shapes and parity-checks
+it against its XLA twin (~30 s total on a warm cache), so a
+remote-compiler failure (HTTP 500s on some shapes — a known axon mode)
+localizes to a named kernel instead of poisoning a timed bench leg.
+bench.py runs the same preflight asserts inline; this tier exists to run
+them WITHOUT the bench's data-build cost.
+
+If the axon relay is down, the first device use in here blocks for many
+minutes — that is the signal to skip benching entirely (bench.py's
+subprocess probe handles that case itself).
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def tpu():
+    import jax
+
+    if jax.default_backend() != "tpu":
+        pytest.skip("TPU backend unavailable (axon relay not registered)")
+    return jax.devices()[0]
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)

@@ -1,0 +1,116 @@
+"""Tiny-shape Mosaic compile + XLA-twin parity for every kernel the bench
+times.  Shapes are the smallest each kernel supports, so a failure here
+is a compiler/runtime break, never an OOM or capacity artifact."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+
+def test_ell_scatter_mixed_kernel_parity(tpu, rng):
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.ops.ell_scatter import (
+        ell_layout,
+        ell_scatter_apply,
+        ell_scatter_apply_xla,
+    )
+
+    d = 128 * 128          # smallest supported table
+    cat = rng.integers(0, d, size=(1, 64, 8)).astype(np.int32)
+    lay = ell_layout(cat, d)
+    u = rng.normal(size=(d // 128, 128)).astype(np.float32)
+    w0 = rng.normal(size=d).astype(np.float32)
+    got = np.asarray(ell_scatter_apply(
+        jnp.asarray(w0), jnp.asarray(u), lay.pos[0], lay.mask[0]))
+    want = np.asarray(ell_scatter_apply_xla(
+        jnp.asarray(w0), jnp.asarray(u), lay.pos[0], lay.mask[0]))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_ell_full_step_matches_xla_update(tpu, rng):
+    """One whole _mixed_update_ell step (gather + kernel + overflow +
+    heavy) against the plain-XLA mixed update — the exact pre-timing
+    assert the bench runs, on a 64-row batch."""
+    import jax
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.models.common.losses import LOSSES
+    from flink_ml_tpu.models.common.sgd import (
+        SGDConfig,
+        _mixed_update,
+        _mixed_update_ell,
+    )
+    from flink_ml_tpu.ops.ell_scatter import ell_layout
+
+    d, batch, nnz, nd = 128 * 128, 64, 4, 3
+    dense = rng.normal(size=(batch, nd)).astype(np.float32)
+    cat = rng.integers(nd, d, size=(1, batch, nnz)).astype(np.int32)
+    y = rng.integers(0, 2, size=batch).astype(np.float32)
+    wb = np.ones(batch, np.float32)
+    lay = ell_layout(cat, d)
+    cfg = SGDConfig(learning_rate=0.5, global_batch_size=batch)
+    params = {"w": jnp.zeros((d,), jnp.float32),
+              "b": jnp.zeros((), jnp.float32)}
+
+    p_ell, v_ell = jax.jit(_mixed_update_ell(LOSSES["logistic"], cfg))(
+        params, dense, cat[0], lay.src[0], lay.pos[0], lay.mask[0],
+        lay.ovf_idx[0], lay.ovf_src[0], lay.heavy_idx[0], lay.heavy_cnt[0],
+        y, wb)
+    p_xla, v_xla = jax.jit(_mixed_update(LOSSES["logistic"], cfg))(
+        params, dense, cat[0], y, wb)
+    np.testing.assert_allclose(float(v_ell), float(v_xla), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p_ell["w"]),
+                               np.asarray(p_xla["w"]), atol=1e-4)
+
+
+def test_ell_scatter_values_kernel_parity(tpu, rng):
+    """The values-aware layout (sgd_fit_sparse's path) through the same
+    kernel."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.ops.ell_scatter import (
+        ell_layout,
+        ell_scatter_apply,
+        ell_scatter_apply_xla,
+    )
+
+    d = 128 * 128
+    idx = rng.integers(0, d, size=(1, 64, 8)).astype(np.int32)
+    vals = rng.normal(size=(1, 64, 8)).astype(np.float32)
+    lay = ell_layout(idx, d, values=vals)
+    r = rng.normal(size=65).astype(np.float32)  # extended residual
+    u = np.asarray(lay.val[0]) * r[np.asarray(lay.src[0])]
+    w0 = rng.normal(size=d).astype(np.float32)
+    got = np.asarray(ell_scatter_apply(
+        jnp.asarray(w0), jnp.asarray(u), lay.pos[0], lay.mask[0]))
+    want = np.asarray(ell_scatter_apply_xla(
+        jnp.asarray(w0), jnp.asarray(u), lay.pos[0], lay.mask[0]))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+@pytest.mark.parametrize("tie_policy", ["split", "fast"])
+def test_kmeans_kernel_parity(tpu, rng, tie_policy):
+    """kmeans_update_stats (the fused Lloyd's kernel) vs the XLA epoch
+    body on one tiny block."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.ops.kmeans_pallas import kmeans_update_stats
+
+    n, dcol, k = 8192, 8, 4   # one block_n tile
+    pts = rng.normal(size=(n, dcol)).astype(np.float32)
+    cents = rng.normal(size=(k, dcol)).astype(np.float32)
+    sums, counts = kmeans_update_stats(jnp.asarray(pts), jnp.asarray(cents),
+                                       block_n=8192, tie_policy=tie_policy)
+    # numpy oracle: single-assignment Lloyd's stats (random normal data
+    # has no exact ties, so both policies must agree with it)
+    d2 = ((pts[:, None, :] - cents[None, :, :]) ** 2).sum(-1)
+    assign = d2.argmin(1)
+    want_counts = np.bincount(assign, minlength=k).astype(np.float64)
+    want_sums = np.zeros((k, dcol))
+    np.add.at(want_sums, assign, pts)
+    np.testing.assert_allclose(np.asarray(counts, np.float64), want_counts,
+                               atol=1e-3)
+    np.testing.assert_allclose(np.asarray(sums, np.float64), want_sums,
+                               rtol=2e-4, atol=2e-3)
