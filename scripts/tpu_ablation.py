@@ -1,0 +1,163 @@
+"""ELL mixed-LR step ablation on real TPU (VERDICT r3 task 2).
+
+Attributes the r3-unexplained gap (full ELL epoch measured 10.2 ms/step
+vs ~4 ms predicted from piecewise kernel timings) by dropping one piece
+of the step at a time inside the SAME fused epoch loop used for timing.
+Two-point fits over epoch counts cancel the fixed tunnel round-trip, and
+every timed op's inputs depend on the scan carry (nothing hoistable —
+see the r3 measurement-traps notes).
+
+Run (writes stdout; tee to TPU_ABLATION_r04.txt):
+    timeout 1800 python -u scripts/tpu_ablation.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.models.common.losses import logistic_loss
+from flink_ml_tpu.models.common.sgd import (
+    SGDConfig,
+    _gather_weights,
+    _mixed_update,
+    _mixed_update_ell,
+    resolve_global_batch_size,
+)
+from flink_ml_tpu.ops.ell_scatter import ell_layout_device, ell_scatter_apply
+
+D = 1 << 20
+BATCH = 1 << 15
+NNZ = 26
+STEPS = 8
+LR = 0.5
+cfg = SGDConfig(learning_rate=LR, tol=0)
+
+print("backend:", jax.default_backend(), flush=True)
+print("auto batch at bench shape:",
+      resolve_global_batch_size(SGDConfig(), 1_000_000, D), flush=True)
+
+
+@jax.jit
+def gen(key):
+    kc, kd, ky = jax.random.split(key, 3)
+    y = jax.random.bernoulli(ky, 0.5, (STEPS, BATCH)).astype(jnp.float32)
+    cat = jax.random.randint(kc, (STEPS, BATCH, NNZ), 32, D, jnp.int32)
+    cat = cat.at[:, :, 0].set(jnp.where(y == 1, 16, 17))
+    dense = jax.random.normal(kd, (STEPS, BATCH, 13), jnp.float32)
+    return dense, cat, y
+
+
+dense, cat, y = gen(jax.random.PRNGKey(0))
+t0 = time.perf_counter()
+lay = ell_layout_device(cat, D, ovf_cap=1 << 13).assert_capacities()
+np.asarray(lay.ovf_idx[0, :1])
+print(f"layout build {time.perf_counter()-t0:.1f}s  "
+      f"need_ovf={int(np.asarray(lay.need_ovf).max())} "
+      f"need_heavy={int(np.asarray(lay.need_heavy).max())}", flush=True)
+extra = (lay.src, lay.pos, lay.mask, lay.ovf_idx, lay.ovf_src,
+         lay.heavy_idx, lay.heavy_cnt)
+
+
+def fresh():
+    return {"w": jnp.zeros((D,), jnp.float32),
+            "b": jnp.zeros((), jnp.float32)}
+
+
+def fit_cost(loop_maker, args, reps=(2, 10)):
+    """Two-point fit over EPOCH counts (each epoch = STEPS steps)."""
+    ts = []
+    for n in reps:
+        run = loop_maker(n)
+        out = run(*args)
+        np.asarray(out[0]["w"]).ravel()[:1]
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = run(*args)
+            np.asarray(out[0]["w"]).ravel()[:1]
+            best = min(best, time.perf_counter() - t0)
+        ts.append(best)
+    return (ts[1] - ts[0]) / ((reps[1] - reps[0]) * STEPS)
+
+
+def make_loop(update):
+    def maker(n_epochs):
+        @jax.jit
+        def run(params, dense, cat, y, *ex):
+            ones = jnp.ones(y.shape, jnp.float32)
+
+            def epoch(params, _):
+                def step(params, i):
+                    e = tuple(a[i] for a in ex)
+                    return update(params, dense[i], cat[i], *e, y[i],
+                                  ones[i])
+                p, losses = jax.lax.scan(step, params, jnp.arange(STEPS))
+                return p, jnp.mean(losses)
+            return jax.lax.scan(epoch, params, None, length=n_epochs)
+        return run
+    return maker
+
+
+args_base = (fresh(), dense, cat, y)
+t = fit_cost(make_loop(_mixed_update(logistic_loss, cfg)), args_base)
+print(f"oracle (XLA blocked)        {t*1e3:7.2f} ms/step", flush=True)
+t_ell = fit_cost(make_loop(_mixed_update_ell(logistic_loss, cfg)),
+                 args_base + extra)
+print(f"ELL planned path            {t_ell*1e3:7.2f} ms/step  "
+      f"-> {1.0/(t_ell*32):5.2f} epochs/s @32steps", flush=True)
+
+
+# ---- ablation: drop pieces of the ELL step -------------------------------
+def make_ablated(margin_on, ugather_on, kernel_on, ovf_on, heavy_on):
+    def update(params, dense_b, cat_b, src, pos, mask, oi, osrc, hi, hc,
+               yb, wb):
+        w, b = params["w"], params["b"]
+        nd = dense_b.shape[-1]
+        if margin_on:
+            margin = (dense_b @ w[:nd]
+                      + jnp.sum(_gather_weights(w, cat_b), axis=-1) + b)
+        else:
+            margin = dense_b @ w[:nd] + b
+        value, pull = jax.vjp(lambda m: logistic_loss(m, yb, wb), margin)
+        (r,) = pull(jnp.ones_like(value))
+        pad = 256 - (BATCH % 256) or 256
+        r_ext = jnp.concatenate([r, jnp.zeros((pad,), jnp.float32)])
+        if ugather_on:
+            u = (-LR) * _gather_weights(r_ext, src)
+        else:
+            u = jnp.broadcast_to(r_ext[0], src.shape) * (-LR)
+        if kernel_on:
+            w = ell_scatter_apply(w, u, pos, mask)
+        else:
+            w = w + jnp.sum(u) * 1e-20
+        if ovf_on:
+            w = w.at[oi].add((-LR) * r_ext[osrc])
+        if heavy_on:
+            w = w.at[hi].add((-LR) * (hc.astype(jnp.float32) @ r))
+        w = w.at[:nd].add(-LR * (r @ dense_b))
+        b = b - LR * jnp.sum(r)
+        return {"w": w, "b": b}, value
+    return update
+
+
+ON = dict(margin_on=True, ugather_on=True, kernel_on=True, ovf_on=True,
+          heavy_on=True)
+for name, off in [
+    ("full", {}),
+    ("- margin gather", {"margin_on": False}),
+    ("- u gather", {"ugather_on": False}),
+    ("- kernel", {"kernel_on": False}),
+    ("- overflow scatter", {"ovf_on": False}),
+    ("- heavy matvec", {"heavy_on": False}),
+    ("bare margin+loss", {"ugather_on": False, "kernel_on": False,
+                          "ovf_on": False, "heavy_on": False}),
+]:
+    t = fit_cost(make_loop(make_ablated(**{**ON, **off})),
+                 args_base + extra)
+    print(f"{name:26s} {t*1e3:7.2f} ms/step", flush=True)
