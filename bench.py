@@ -518,20 +518,26 @@ def bench_criteo_e2e(results: dict) -> None:
     notes["ingest_mb_per_sec"] = round(tsv_bytes / ingest_s / 1e6, 1)
     results["criteo_ingest_rows_per_sec"] = notes["ingest_rows_per_sec"]
 
-    # stage 3: one training epoch from the cache (tunnel-calibrated)
+    # stage 3: training epochs from the cache (tunnel-calibrated).
+    # Two epochs, not one (VERDICT r3 task 6): the second epoch exercises
+    # the per-epoch cache re-read + prefetch machinery that a single
+    # pass never touches, and the per-row rate below is per epoch-row.
+    train_epochs = 2
     t0 = time.perf_counter()
     one = jnp.asarray(np.zeros((1 << 14, 26), np.int32))
     np.asarray(one[0, :1])
     per_batch_s = time.perf_counter() - t0
     train_rows = rows
-    projected = per_batch_s * (rows / (1 << 14)) * 2.5
+    projected = per_batch_s * (rows / (1 << 14)) * 2.5 * train_epochs
     if projected > 150:
         train_rows = min(rows, 1 << 18)
         notes["train_leg"] = (
             f"subset of {train_rows} rows: calibration projects "
-            f"{projected:.0f}s for a full epoch through the tunnel")
+            f"{projected:.0f}s for {train_epochs} epochs through the "
+            "tunnel")
+    notes["train_epochs"] = train_epochs
 
-    cfg = SGDConfig(learning_rate=0.5, max_epochs=1, tol=0)
+    cfg = SGDConfig(learning_rate=0.5, max_epochs=train_epochs, tol=0)
     stats = PrefetchStats()
 
     def make_reader():
@@ -554,13 +560,16 @@ def bench_criteo_e2e(results: dict) -> None:
         dense_key="features_dense", indices_key="features_indices",
         prefetch_workers=workers, prefetch_stats=stats)
     train_s = time.perf_counter() - t0
-    notes["train_rows_per_sec"] = round(train_rows / train_s, 1)
+    notes["train_rows_per_sec"] = round(
+        train_rows * train_epochs / train_s, 1)   # per epoch-row
     notes["train_stage_s"] = stats.as_dict()
 
     # the e2e metric: full-pipeline rows/sec over the stages all run at
     # the same size; when the train leg was truncated, scale its cost to
-    # full size for the combined figure and say so
-    train_full_s = train_s * (rows / train_rows)
+    # full size for the combined figure and say so.  Train cost is
+    # normalised to ONE full-size epoch so the metric's definition is
+    # unchanged from r2/r3.
+    train_full_s = train_s * (rows / train_rows) / train_epochs
     notes["e2e_wall_s"] = round(ingest_s + train_full_s, 1)
     if train_rows < rows:
         notes["e2e_wall_s_note"] = "train leg scaled from subset"
