@@ -623,11 +623,10 @@ def bench_kmeans(results: dict) -> None:
     xla_body = km.kmeans_epoch_step(measure, K)
     if impl == "pallas":
         # EXACTLY what KMeans.fit plans: tie_policy comes from the
-        # estimator's default (KMeansParams.TIE_POLICY — "split" since r4,
-        # restoring exact single-assignment-equivalent semantics as the
-        # product default per ADVICE r3).  Random normal data has no exact
-        # ties, so it must agree with the XLA body up to f32 reduction
-        # order — asserted on device before timing.
+        # estimator's default (KMeansParams.TIE_POLICY — "first" since
+        # r4: the reference's argmin semantics, ties included, per
+        # ADVICE r3).  It must agree with the XLA body up to f32
+        # reduction order — asserted on device before timing.
         tie = km.KMeans().get_tie_policy()
         body = km.kmeans_epoch_step_pallas(K, block_n=block_n,
                                            tie_policy=tie)
@@ -684,10 +683,11 @@ def bench_kmeans(results: dict) -> None:
     # kmeans_vs_baseline cliff is that redefinition, not a regression);
     # v3 (r3) = device rate is the KMeans.fit-planned kernel config
     # (tiePolicy param default), measured methodology otherwise unchanged;
-    # v4 (r4) = tiePolicy default flipped to "split" (exact tie
-    # semantics, ADVICE r3 medium) — fit-planned path still what's timed,
-    # ~45% more work per iteration than the v3 "fast" series.
-    results["notes"]["kmeans_metric_version"] = 4
+    # v4 (r4, never benched) = tiePolicy default flipped to "split";
+    # v5 (r4) = default becomes "first" (exact reference argmin tie
+    # semantics, ADVICE r3 medium) — fit-planned path still what's
+    # timed; slightly more work per iteration than v3's "fast".
+    results["notes"]["kmeans_metric_version"] = 5
     # assign+reduce are two (n, K, D)-scale matmuls: ~4*n*K*D flops/iter
     results["notes"]["kmeans_tflops"] = round(
         4 * n * K * D * tpu_rate / 1e12, 1)

@@ -66,19 +66,23 @@ class KMeansParams(KMeansModelParams, HasSeed, HasMaxIter):
     ``tiePolicy`` (beyond-reference, TPU-specific) picks the Pallas fit
     kernel's handling of EXACTLY-tied point-to-centroid distances:
 
-    - ``"split"`` (default): fractional assignment across the tied
-      minimisers (exact expected-assignment semantics, matches the XLA
-      body's expected mass: total cluster mass always sums to n, and the
-      reference's single-assignment Lloyd's fit on tie-free data).
+    - ``"first"`` (default): first-index argmin — EXACTLY the
+      reference's and the XLA body's single-assignment Lloyd's
+      semantics, ties included, computed without Mosaic's slow argmin
+      loop (smallest tied column index via where/min/compare — cheaper
+      than "split"'s division).
+    - ``"split"``: fractional assignment across the tied minimisers
+      (exact expected-assignment semantics: total cluster mass always
+      sums to n).
     - ``"fast"`` (opt-in via ``setTiePolicy``; bench.py times whatever
-      ``fit`` plans, i.e. the "split" default): a tied point
+      ``fit`` plans, i.e. the "first" default): a tied point
       counts toward EVERY minimizing centroid — its mass is
       double-counted, biasing the tied centroids' means toward it.  On
       continuous features exact f32 ties are measure-zero, so this is
       free; on DISCRETE/quantized features (integer grids, one-hot),
       distinct equidistant centroids are common and "fast" measurably
-      changes the fit — keep "split" there.  ~45% faster per iteration
-      than "split" on v5e.
+      changes the fit.  ~45% faster per iteration than "split" on v5e
+      (r3 numbers; "first" re-measured r4).
 
     The XLA fallback path (non-TPU, small n, non-euclidean) always uses
     first-index argmin and ignores this param."""
@@ -94,10 +98,10 @@ class KMeansParams(KMeansModelParams, HasSeed, HasMaxIter):
         validator=ParamValidators.in_array(["random", "k-means++"]))
     TIE_POLICY = StringParam(
         "tiePolicy",
-        "Pallas-kernel handling of exactly-tied distances: 'fast' or "
-        "'split'.",
-        default="split",
-        validator=ParamValidators.in_array(["fast", "split"]))
+        "Pallas-kernel handling of exactly-tied distances: 'first' "
+        "(reference argmin semantics), 'fast', or 'split'.",
+        default="first",
+        validator=ParamValidators.in_array(["first", "fast", "split"]))
 
     def get_k(self) -> int:
         return self.get(KMeansParams.K)
@@ -245,17 +249,17 @@ def kmeans_epoch_step(measure: DistanceMeasure, k: int):
 
 
 def kmeans_epoch_step_pallas(k: int, mesh=None, *, block_n: int = 8192,
-                             tie_policy: str = "split",
+                             tie_policy: str = "first",
                              interpret: bool = False):
     """One Lloyd's iteration on the fused Pallas kernel
     (``ops/kmeans_pallas.py``): score/one-hot tiles stay in VMEM, HBM traffic
     drops ~12x vs the XLA expansion (~3.5x measured step speedup on v5e).
 
-    ``tie_policy="split"`` (the default, what ``KMeans.fit`` plans via its
-    ``tiePolicy`` param) keeps exact expected-assignment semantics
-    (fractional ties); ``"fast"`` assigns exactly-tied points to every
-    minimizing centroid at ~45% less cost per iteration — see
-    ``KMeansParams.TIE_POLICY`` for when that is benign.
+    ``tie_policy="first"`` (the default, what ``KMeans.fit`` plans via
+    its ``tiePolicy`` param) keeps the XLA body's exact first-index
+    argmin semantics; ``"split"`` gives fractional expected-assignment
+    ties, ``"fast"`` assigns exactly-tied points to every minimizing
+    centroid — see ``KMeansParams.TIE_POLICY``.
 
     Requires zero-filled padding (``fill="zero"``) with the per-shard row
     count a multiple of ``block_n``; euclidean metric only.  With a

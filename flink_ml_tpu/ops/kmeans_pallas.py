@@ -12,6 +12,10 @@ points once (~256 MB) plus the (k, d) outputs:
     XLA fused path                          : ~300 iter/s   (3.3 ms/it)
     kmeans_update_stats  tie_policy="split" : ~730 iter/s   (1.4 ms/it)
     kmeans_update_stats  tie_policy="fast"  : ~1070 iter/s  (0.93 ms/it)
+    kmeans_update_stats  tie_policy="first" : r3 numbers above; "first"
+        (the r4 fit default) replaces "split"'s division with
+        where/min/compare passes — expected between the two, measured
+        on TPU by tests_tpu + bench each round.
 
 (one v5e chip, 480-iteration fused scans so the ~70 ms tunnel round-trip is
 amortised; bf16 dots measure within noise of f32 — the MXU is not the
@@ -31,11 +35,16 @@ Design notes:
   receive identical (double-counted) updates and therefore stay identical —
   the same fixed point Lloyd's has.  **"split"** divides tied points
   fractionally among the minimisers (exact expected-assignment semantics)
-  at ~30% throughput cost.
-- ``argmin`` inside a Mosaic kernel lowers to a slow index-tracking loop
-  (~6 ms/it measured), so the fit kernels never compute indices; the
-  transform kernel (:func:`kmeans_assign_reduce`) does, because prediction
-  needs them and runs once, not ``max_iter`` times.
+  at ~30% throughput cost.  **"first"** (the fit default since r4) keeps
+  the reference's exact first-index-argmin semantics: the smallest tied
+  column index via where/row-min/compare over an iota tile — no argmin
+  loop, no division.
+- a true ``argmin`` inside a Mosaic kernel lowers to a slow
+  index-tracking loop (~6 ms/it measured), so the fit kernels compute
+  assignment one-hots directly (see the policies above) rather than
+  indices; the transform kernel (:func:`kmeans_assign_reduce`) does use
+  argmin, because prediction needs indices and runs once, not
+  ``max_iter`` times.
 - ``||p||^2`` is omitted everywhere: it shifts each score row uniformly and
   cannot change which centroids attain the row minimum.
 
@@ -104,9 +113,22 @@ def _stats_kernel(tie_policy: str, compute_dtype):
                                  preferred_element_type=jnp.float32)
                   + c2_ref[:])                                    # (bn, k)
         mins = jnp.min(scores, axis=1, keepdims=True)
-        onehot = (scores <= mins).astype(jnp.float32)
-        if tie_policy == "split":
-            onehot = onehot / jnp.sum(onehot, axis=1, keepdims=True)
+        is_min = scores <= mins
+        if tie_policy == "first":
+            # exact first-index-argmin semantics WITHOUT an argmin loop
+            # (which lowers to a ~6 ms index-tracking scan in Mosaic):
+            # the first minimiser is the smallest column index among the
+            # tied minima — one where + row-min + compare, all cheap VPU
+            # passes (no division like "split").
+            k = scores.shape[1]
+            iota = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+            first = jnp.min(jnp.where(is_min, iota, k), axis=1,
+                            keepdims=True)
+            onehot = (iota == first).astype(jnp.float32)
+        else:
+            onehot = is_min.astype(jnp.float32)
+            if tie_policy == "split":
+                onehot = onehot / jnp.sum(onehot, axis=1, keepdims=True)
         sums_ref[:] += jnp.dot(onehot.T.astype(compute_dtype),
                                pts.astype(compute_dtype),
                                preferred_element_type=jnp.float32)
@@ -159,8 +181,8 @@ def kmeans_update_stats(points: jnp.ndarray, centroids: jnp.ndarray, *,
     ``n`` must be a multiple of ``block_n``; pad with all-zero rows and
     correct the counts with :func:`pad_correction`.
     """
-    if tie_policy not in ("fast", "split"):
-        raise ValueError(f"tie_policy must be 'fast' or 'split', "
+    if tie_policy not in ("first", "fast", "split"):
+        raise ValueError(f"tie_policy must be 'first', 'fast' or 'split', "
                          f"got {tie_policy!r}")
     n, d = points.shape
     k = centroids.shape[0]
@@ -238,11 +260,12 @@ def pad_correction(counts: jnp.ndarray, centroids: jnp.ndarray,
     - ``"fast"``   — :func:`kmeans_update_stats` counted padding fully on
       *every* tied centroid
     - ``"split"``  — fractionally across the tied centroids
-    - ``"argmin"`` — :func:`kmeans_assign_reduce` counted it on the first
-      tied index only (first-index argmin semantics)
+    - ``"argmin"`` / ``"first"`` — :func:`kmeans_assign_reduce` /
+      :func:`kmeans_update_stats` with ``tie_policy="first"`` counted it
+      on the first tied index only (first-index argmin semantics)
     """
     c2 = jnp.sum(centroids * centroids, axis=1)
-    if tie_policy == "argmin":
+    if tie_policy in ("argmin", "first"):
         tied = jax.nn.one_hot(jnp.argmin(c2), counts.shape[0],
                               dtype=counts.dtype)
     elif tie_policy in ("fast", "split"):
@@ -250,8 +273,9 @@ def pad_correction(counts: jnp.ndarray, centroids: jnp.ndarray,
         if tie_policy == "split":
             tied = tied / jnp.sum(tied)
     else:
-        raise ValueError(f"tie_policy must be 'fast', 'split' or 'argmin', "
-                         f"got {tie_policy!r}")
+        raise ValueError(
+            f"tie_policy must be 'first', 'fast', 'split' or 'argmin', "
+            f"got {tie_policy!r}")
     return counts - n_pad * tied
 
 

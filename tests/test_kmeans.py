@@ -165,7 +165,7 @@ def test_pallas_epoch_step_matches_xla_step():
 
     xla_body = kmeans_epoch_step(DistanceMeasure.get_instance("euclidean"), 5)
     expected = np.asarray(xla_body(jnp.asarray(cents), 0, data).feedback)
-    for tie_policy in ("fast", "split"):
+    for tie_policy in ("first", "fast", "split"):
         body = kmeans_epoch_step_pallas(5, block_n=128, tie_policy=tie_policy,
                                         interpret=True)
         got = np.asarray(body(jnp.asarray(cents), 0, data).feedback)
@@ -359,3 +359,36 @@ class TestKMeansPlusPlus:
         assert assign[0] != assign[100]
         with pytest.raises(Exception):
             KMeans().set_init_mode("banana")
+
+
+def test_tie_policy_first_matches_argmin_under_real_ties():
+    """'first' (the r4 default) must reproduce numpy first-index argmin
+    EXACTLY on discrete data with real ties — where 'fast' double-counts
+    and 'split' fractions.  This is the reference's Lloyd's semantics
+    (KMeans.java:238-315 assigns each point to exactly one centroid)."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.ops.kmeans_pallas import kmeans_update_stats
+
+    rng = np.random.default_rng(0)
+    pts = rng.integers(0, 3, size=(1024, 8)).astype(np.float32)
+    cents = np.stack([
+        pts[0], pts[1],
+        pts[0] + np.eye(8, dtype=np.float32)[0],
+        pts[0] - np.eye(8, dtype=np.float32)[0]])
+    d2 = ((pts[:, None, :] - cents[None, :, :]) ** 2).sum(-1)
+    assert int(((d2 == d2.min(1, keepdims=True)).sum(1) > 1).sum()) > 0
+
+    sums, counts = kmeans_update_stats(
+        jnp.asarray(pts), jnp.asarray(cents), block_n=1024,
+        tie_policy="first", interpret=True)
+    assign = d2.argmin(1)
+    want_counts = np.bincount(assign, minlength=4).astype(np.float64)
+    want_sums = np.zeros((4, 8))
+    np.add.at(want_sums, assign, pts)
+    np.testing.assert_allclose(np.asarray(counts, np.float64), want_counts,
+                               atol=1e-3)
+    np.testing.assert_allclose(np.asarray(sums, np.float64), want_sums,
+                               rtol=1e-5, atol=1e-3)
+    # total mass is exactly n ('fast' would double-count ties)
+    assert float(np.asarray(counts).sum()) == len(pts)

@@ -171,3 +171,27 @@ def test_supported_budget_and_block_pick():
     assert pick_block_n(1_048_576, 64, 256) == 8192
     assert pick_block_n(640, 16, 8) == 128
     assert pick_block_n(100, 16, 8) is None
+
+
+def test_pad_correction_exact_under_min_norm_ties_first():
+    """'first' (the r4 fit default) with duplicated min-norm centroids:
+    the kernel counts ALL padding on the first tied column, and
+    pad_correction's argmin(c2) must name that same column — real rows
+    tying on the duplicate pair land on its first index too, so counts
+    match the single-assignment oracle exactly."""
+    rng = np.random.default_rng(5)
+    n, n_pad = 128, 32
+    pts = rng.normal(loc=5.0, size=(n, 8)).astype(np.float32)
+    pts[-n_pad:] = 0.0
+    dup = pts[0] * 0.01  # small-norm duplicate pair -> tied c2
+    cents = jnp.asarray(np.stack([dup, dup, pts[1], pts[2]]))
+    exp_counts = _oracle(jnp.asarray(pts), cents, n_pad)[2]
+    _, counts = kmeans_update_stats(jnp.asarray(pts), cents, block_n=128,
+                                    tie_policy="first", interpret=True)
+    counts = np.asarray(pad_correction(counts, cents, n_pad,
+                                       tie_policy="first"))
+    # single assignment: the whole tied mass sits on column 0
+    np.testing.assert_allclose(counts[2:], exp_counts[2:], atol=1e-4)
+    np.testing.assert_allclose(counts[0], exp_counts[:2].sum(), atol=1e-3)
+    np.testing.assert_allclose(counts[1], 0.0, atol=1e-4)
+    assert (counts >= -1e-4).all()

@@ -90,7 +90,7 @@ def test_ell_scatter_values_kernel_parity(tpu, rng):
     np.testing.assert_allclose(got, want, atol=1e-4)
 
 
-@pytest.mark.parametrize("tie_policy", ["split", "fast"])
+@pytest.mark.parametrize("tie_policy", ["first", "split", "fast"])
 def test_kmeans_kernel_parity(tpu, rng, tie_policy):
     """kmeans_update_stats (the fused Lloyd's kernel) vs the XLA epoch
     body on one tiny block."""
