@@ -563,6 +563,49 @@ def _mixed_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
     return update
 
 
+def _sparse_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
+                               num_features: int, use_pallas: bool = True):
+    """Values-aware twin of :func:`_mixed_update_ell_sharded` for the
+    generic (indices, values) layout — the same device-local-grid + psum
+    scatter, with per-slot updates ``-lr * value * r`` carried by the
+    layout's value arrays."""
+    from ...ops.ell_scatter import ell_scatter_apply, ell_scatter_apply_xla
+
+    lr = config.learning_rate
+    finish = _finish_sparse_step(config)
+    apply_ell = ell_scatter_apply if use_pallas else ell_scatter_apply_xla
+
+    def _local_delta(r_l, src, pos, mask, val, ovf_idx, ovf_src, ovf_val,
+                     heavy_idx, heavy_cnt):
+        r_ext = _extended_r(r_l)
+        delta = _apply_ell_categorical(
+            apply_ell, lr, jnp.zeros((num_features,), jnp.float32), r_l,
+            r_ext, src[0], pos[0], mask[0], ovf_idx[0], ovf_src[0],
+            heavy_idx[0], heavy_cnt[0], val_ell=val[0], ovf_val=ovf_val[0])
+        return jax.lax.psum(delta, "data")
+
+    ell_delta = _shard_map(
+        _local_delta, mesh,
+        in_specs=(P("data"),) + (P("data", None, None),) * 4
+        + (P("data", None),) * 4 + (P("data", None, None),),
+        out_specs=P())
+
+    def update(params, idx, vals, src, pos, mask, val_ell, ovf_idx,
+               ovf_src, ovf_val, heavy_idx, heavy_cnt, yb, wb):
+        w, b = params["w"], params["b"]
+        margin = jnp.sum(vals * _gather_weights(w, idx), axis=-1) + b
+        value, pull = jax.vjp(lambda m: loss_fn(m, yb, wb), margin)
+        (r,) = pull(jnp.ones_like(value))
+
+        def apply_grad(w):
+            return w + ell_delta(r, src, pos, mask, val_ell, ovf_idx,
+                                 ovf_src, ovf_val, heavy_idx, heavy_cnt)
+
+        return finish(w, b, value, r, apply_grad)
+
+    return update
+
+
 def sgd_fit_sparse(loss_fn: LossFn, indices: np.ndarray, values: np.ndarray,
                    labels: np.ndarray, weights: Optional[np.ndarray],
                    num_features: int, config: SGDConfig,
@@ -592,15 +635,37 @@ def sgd_fit_sparse(loss_fn: LossFn, indices: np.ndarray, values: np.ndarray,
 
     # the values-aware layout adds a fourth f32 grid (val): 16 B/slot/step
     impl = plan_mixed_impl(num_features, mesh, steps,
-                           layout_bytes_per_slot=16)
-    if impl == "ell":
+                           layout_bytes_per_slot=16, allow_sharded=True)
+    n_dev_data = int(mesh.shape.get("data", 1))
+    ell_sharded = impl == "ell" and n_dev_data > 1
+    if ell_sharded:
+        # per-device shard layouts, same stance as sgd_fit_mixed
+        from ...ops.ell_scatter import ell_layout
+
+        local = batch // n_dev_data
+        lay = ell_layout(
+            idx.reshape(steps * n_dev_data, local, idx.shape[-1]),
+            num_features,
+            values=vals.reshape(steps * n_dev_data, local, vals.shape[-1]))
+
+        def dev_stack(a):
+            return a.reshape((steps, n_dev_data) + a.shape[1:])
+
+        extra = tuple(dev_stack(a) for a in (
+            lay.src, lay.pos, lay.mask, lay.val, lay.ovf_idx, lay.ovf_src,
+            lay.ovf_val, lay.heavy_idx, lay.heavy_cnt))
+        update = _sparse_update_ell_sharded(
+            loss_fn, config, mesh, num_features,
+            use_pallas=jax.default_backend() == "tpu")
+    elif impl == "ell":
         from ...ops.ell_scatter import ell_layout
 
         layout = ell_layout(idx, num_features, values=vals)
         extra = (layout.src, layout.pos, layout.mask, layout.val,
                  layout.ovf_idx, layout.ovf_src, layout.ovf_val,
                  layout.heavy_idx, layout.heavy_cnt)
-        update = _sparse_update_ell(loss_fn, config)
+        update = _sparse_update_ell(
+            loss_fn, config, use_pallas=jax.default_backend() == "tpu")
     else:
         extra = ()
         update = _sparse_update(loss_fn, config)
@@ -609,7 +674,14 @@ def sgd_fit_sparse(loss_fn: LossFn, indices: np.ndarray, values: np.ndarray,
     vals = _put_epoch_tensor(vals, mesh, P(None, "data", None))
     y = _put_epoch_tensor(y, mesh, P(None, "data"))
     w = _put_epoch_tensor(w, mesh, P(None, "data"))
-    extra = tuple(jax.device_put(a) for a in extra)  # single-device path
+    if ell_sharded:
+        specs = ([P(None, "data", None, None)] * 4
+                 + [P(None, "data", None)] * 4
+                 + [P(None, "data", None, None)])
+        extra = tuple(_put_epoch_tensor(a, mesh, s)
+                      for a, s in zip(extra, specs))
+    else:
+        extra = tuple(jax.device_put(a) for a in extra)  # single-device
 
     params, loss_log = _run_minibatch_epochs(
         update, (idx, vals) + extra + (y, w),

@@ -404,3 +404,37 @@ def test_plan_mixed_impl_admits_data_axis_meshes(monkeypatch):
     assert S.plan_mixed_impl(d, mesh_mp, 32, allow_sharded=True) == "xla"
     # budget still enforced per device
     assert S.plan_mixed_impl(d, mesh8, 1 << 15, allow_sharded=True) == "xla"
+
+
+def test_sharded_ell_sparse_fit_matches_single_device_oracle(monkeypatch):
+    """Values-aware (indices, values) twin of the sharded-ELL oracle:
+    device-local grids + psum must reproduce the single-device sparse fit
+    on the 8-device CPU mesh."""
+    from flink_ml_tpu.models.common import sgd as S
+    from flink_ml_tpu.models.common.losses import LOSSES
+    from flink_ml_tpu.parallel.mesh import device_mesh
+
+    rng = np.random.default_rng(9)
+    n_dev = 8
+    batch = 4 * n_dev
+    n, nnz, d = 8 * batch, 4, 128 * 128
+    idx = rng.integers(0, d, size=(n, nnz)).astype(np.int32)
+    vals = rng.normal(size=(n, nnz)).astype(np.float32)
+    y = (vals[:, 0] > 0).astype(np.float64)
+    cfg = S.SGDConfig(learning_rate=0.3, max_epochs=3,
+                      global_batch_size=batch, tol=0, seed=0, reg=0.01)
+
+    monkeypatch.setattr(S, "plan_mixed_impl", lambda *a, **k: "ell")
+    mesh8 = device_mesh({"data": n_dev})
+    state_s, log_s = S.sgd_fit_sparse(LOSSES["logistic"], idx, vals, y,
+                                      None, d, cfg, mesh=mesh8)
+    assert state_s.planned_impl == "ell"
+
+    monkeypatch.setattr(S, "plan_mixed_impl", lambda *a, **k: "xla")
+    mesh1 = device_mesh({"data": 1}, devices=jax.devices()[:1])
+    state_1, log_1 = S.sgd_fit_sparse(LOSSES["logistic"], idx, vals, y,
+                                      None, d, cfg, mesh=mesh1)
+    np.testing.assert_allclose(state_s.coefficients, state_1.coefficients,
+                               atol=1e-5)
+    np.testing.assert_allclose(log_s, log_1, atol=1e-6)
+    assert log_s[-1] < log_s[0]
