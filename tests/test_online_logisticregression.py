@@ -25,7 +25,8 @@ def test_defaults():
     olr = OnlineLogisticRegression()
     assert olr.get_alpha() == 0.1
     assert olr.get_beta() == 0.1
-    assert olr.get_global_batch_size() == 32
+    # None = auto (r4); the online trainer resolves it to DEFAULT_GLOBAL_BATCH
+    assert olr.get_global_batch_size() is None
     with pytest.raises(Exception):
         olr.set_alpha(0.0)
 
