@@ -1039,15 +1039,24 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                          "for the mixed layout)")
     if dense_key is not None and indices_key is None:
         raise ValueError("dense_key requires indices_key")
-    # mixed batches on a single TPU device route through the ELL kernel:
-    # the per-batch routing builds in the PREFETCH decode workers, so the
+    # mixed batches on a TPU data mesh route through the ELL kernel: the
+    # per-batch routing builds in the PREFETCH decode workers, so the
     # host sort overlaps the device step like any other decode work.
-    # Caps are static (one compiled program for every batch).
-    stream_ell = mixed and plan_mixed_impl(num_features, mesh) == "ell"
+    # Caps are static (one compiled program for every batch).  On a
+    # multi-device data axis the decode builds PER-DEVICE shard layouts
+    # and the update is the device-local-grid + psum variant (same
+    # stance as the fused sgd_fit_mixed, r4).
+    stream_ell = (mixed and plan_mixed_impl(num_features, mesh,
+                                            allow_sharded=True) == "ell")
+    stream_sharded = stream_ell and n_dev > 1
     stream_impl = ("ell-stream" if stream_ell
                    else ("xla-stream" if (mixed or sparse)
                          else "dense-stream"))
-    if stream_ell:
+    if stream_sharded:
+        update = _mixed_update_ell_sharded(
+            loss_fn, config, mesh, num_features,
+            use_pallas=jax.default_backend() == "tpu")
+    elif stream_ell:
         update = _mixed_update_ell(
             loss_fn, config, use_pallas=jax.default_backend() == "tpu")
     else:
@@ -1064,8 +1073,13 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
 
     x_sh = NamedSharding(mesh, P("data", None))
     v_sh = NamedSharding(mesh, P("data"))
-    r_sh = NamedSharding(mesh, P())      # layout grids: single device
-    if stream_ell:
+    if stream_sharded:
+        # layout stacks carry a leading device dim sharded over 'data'
+        g3 = NamedSharding(mesh, P("data", None, None))
+        g2 = NamedSharding(mesh, P("data", None))
+        sharding = (x_sh, x_sh, g3, g3, g3, g2, g2, g2, g3, v_sh, v_sh)
+    elif stream_ell:
+        r_sh = NamedSharding(mesh, P())  # layout grids: single device
         # (dense, cat, src, pos, mask, ovf_idx, ovf_src, heavy_idx,
         #  heavy_cnt, y, w)
         sharding = (x_sh, x_sh, r_sh, r_sh, r_sh, r_sh, r_sh, r_sh, r_sh,
@@ -1127,6 +1141,21 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                 # their margin gathers clamp and carry weight 0)
                 cat_p = cat_p.copy()
                 cat_p[n_valid:] = num_features
+            if stream_sharded:
+                # per-device shard layouts: slot sources numbered inside
+                # each device's contiguous local row block (P("data")
+                # shards dim 0 the same way)
+                local = batch_rows[0] // n_dev
+                cap = (ell_ovf_cap if ell_ovf_cap is not None
+                       else max(1024, local))
+                lay = ell_layout(
+                    cat_p.reshape(n_dev, local, cat_p.shape[-1]),
+                    num_features, pad_ovf_cap=cap,
+                    pad_heavy_cap=ell_heavy_cap, device=False)
+                return (dense_p, cat_p,
+                        lay.src, lay.pos, lay.mask, lay.ovf_idx,
+                        lay.ovf_src, lay.heavy_idx,
+                        lay.heavy_cnt) + padded[2:]
             cap = (ell_ovf_cap if ell_ovf_cap is not None
                    else max(1024, batch_rows[0]))
             lay = ell_layout(cat_p[None], num_features,

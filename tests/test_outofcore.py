@@ -281,6 +281,9 @@ def test_streaming_ell_path_matches_xla(tmp_path, monkeypatch):
 
     s_ell, log_ell = fit(True)
     s_xla, log_xla = fit(False)
+    # the 8-device default mesh takes the SHARDED streaming route
+    assert s_ell.planned_impl == "ell-stream"
+    assert s_xla.planned_impl == "xla-stream"
     np.testing.assert_allclose(s_ell.coefficients, s_xla.coefficients,
                                atol=1e-5)
     np.testing.assert_allclose(log_ell, log_xla, rtol=1e-6)
@@ -304,9 +307,26 @@ def test_streaming_ell_cap_exceeded_raises(tmp_path, monkeypatch):
     w.append({"d": dense, "c": cat, "label": y})
     w.finish()
 
+    import jax as _jax
+
+    from flink_ml_tpu.parallel.mesh import device_mesh
+
     monkeypatch.setattr(sgd, "plan_mixed_impl", lambda *a, **k: "ell")
+    # single-device grid: the full 600-deep runs are heavy; cap of 1 must
+    # fail loudly (on the sharded mesh each 75-row shard absorbs this
+    # load legally, so the mesh is pinned)
     with pytest.raises(ValueError, match="heavy indices > forced cap"):
         sgd.sgd_fit_outofcore(
             logistic_loss, lambda: DataCacheReader(cache, batch_rows=600),
             num_features=d, config=sgd.SGDConfig(max_epochs=1, tol=0),
-            dense_key="d", indices_key="c", ell_heavy_cap=1)
+            dense_key="d", indices_key="c", ell_heavy_cap=1,
+            mesh=device_mesh({"data": 1}, devices=_jax.devices()[:1]))
+
+    # sharded streaming (default 8-device mesh): per-shard overflow caps
+    # are enforced the same way — 600/8-row shards spill row 2 past a
+    # forced tiny cap
+    with pytest.raises(ValueError, match="overflow needs"):
+        sgd.sgd_fit_outofcore(
+            logistic_loss, lambda: DataCacheReader(cache, batch_rows=600),
+            num_features=d, config=sgd.SGDConfig(max_epochs=1, tol=0),
+            dense_key="d", indices_key="c", ell_ovf_cap=4)
