@@ -69,7 +69,8 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
                        sharding: Optional[Any] = None,
                        transform: Optional[Callable[[Any], Any]] = None,
                        workers: int = 1,
-                       stats: Optional[PrefetchStats] = None
+                       stats: Optional[PrefetchStats] = None,
+                       put_fn: Optional[Callable[[Any, Any], Any]] = None
                        ) -> Iterator[Any]:
     """Iterate device-resident copies of ``batches``, staying ``depth``
     batches ahead of the consumer.
@@ -82,12 +83,24 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
 
     Exceptions raised by the source iterator or the transform are re-raised
     at the consuming ``next()`` call.
+
+    ``put_fn(batch, sharding)`` overrides the transfer itself (default
+    ``jax.device_put``) — multi-host callers pass an assembly that builds
+    non-fully-addressable global arrays from each process's local batch
+    (``jax.make_array_from_process_local_data``).
     """
     if depth < 1:
         raise ValueError(f"depth must be >= 1, got {depth}")
     if workers < 1:
         raise ValueError(f"workers must be >= 1, got {workers}")
     st = stats or PrefetchStats()
+
+    def put(batch, sh):
+        # honor the documented 2-arg put_fn contract on BOTH branches
+        if put_fn is not None:
+            return put_fn(batch, sh)
+        return jax.device_put(batch, sh) if sh is not None \
+            else jax.device_put(batch)
     q: queue.Queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
 
@@ -123,9 +136,7 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
                         return
                     batch = timed_transform(batch)
                     t0 = time.perf_counter()
-                    batch = (jax.device_put(batch, sharding)
-                             if sharding is not None
-                             else jax.device_put(batch))
+                    batch = put(batch, sharding)
                     st.put_s += time.perf_counter() - t0
                     put_or_abandon(q, batch)
                 put_or_abandon(q, _END)
@@ -192,9 +203,7 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
                         return
                     batch = item.result()
                     t0 = time.perf_counter()
-                    batch = (jax.device_put(batch, sharding)
-                             if sharding is not None
-                             else jax.device_put(batch))
+                    batch = put(batch, sharding)
                     st.put_s += time.perf_counter() - t0
                     put_or_abandon(q, batch)
             except BaseException as exc:  # noqa: BLE001

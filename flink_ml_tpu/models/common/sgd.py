@@ -1017,6 +1017,18 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     reader (e.g. shuffle when writing the cache, or shuffle segment order
     per epoch).
 
+    **Multi-host** (r4): pass a process-spanning mesh and call from EVERY
+    process with a reader over THAT process's data shard (the reference's
+    parallelism-P source posture — each TaskManager reads its own split).
+    The global batch is the per-step concatenation over processes in
+    process order, assembled inside the prefetch pipeline
+    (``make_array_from_process_local_data``); the gradient reduction rides
+    the mesh like the in-memory fits.  SPMD contract: every process must
+    deliver the SAME number of equal-sized batches per epoch — mismatched
+    readers deadlock in the collectives.  The ELL streaming kernel stays
+    single-process for now (multi-process mixed batches run the XLA
+    scatter).
+
     **Mid-epoch checkpoints** (``checkpoint`` + ``checkpoint_every_steps``):
     on a 1TB pass one epoch is hours, so an epoch-boundary-only cut (the
     ``iterate`` default) loses the whole pass on a crash — the reference
@@ -1030,8 +1042,19 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     continues as if never interrupted — deterministic-replay exactness is
     asserted in tests/test_checkpoint.py.
     """
+    from ...parallel.mesh import local_axis_multiple
+
     mesh = mesh or default_mesh()
     n_dev = int(mesh.shape["data"])
+    procs = _mesh_process_count(mesh)
+    # each PROCESS runs its own reader over its own data shard; the
+    # global batch is the concatenation over processes (the reference's
+    # parallelism-P source posture).  Local rows pad to the local device
+    # multiple along the DATA axis (clear errors for bad layouts live in
+    # local_axis_multiple); every process must deliver the SAME batch
+    # count per epoch (the SPMD contract — mismatches deadlock in the
+    # collectives).
+    n_local_dev = local_axis_multiple(mesh, "data")
     mixed = dense_key is not None and indices_key is not None
     sparse = indices_key is not None and not mixed
     if sparse and values_key is None:
@@ -1087,6 +1110,16 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     else:
         sharding = ((x_sh, x_sh, v_sh, v_sh) if (sparse or mixed)
                     else (x_sh, v_sh, v_sh))
+    if procs > 1:
+        # process-spanning mesh: each process's decoded batch is its LOCAL
+        # slice; assemble the global (non-fully-addressable) batch arrays
+        def put_fn(batch, shardings):
+            return tuple(
+                jax.make_array_from_process_local_data(sh, np.asarray(a))
+                for a, sh in zip(batch, shardings))
+    else:
+        put_fn = None
+
     batch_rows: list = []   # fixed after first batch
     import threading as _threading
     _rows_lock = _threading.Lock()
@@ -1126,7 +1159,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
             # transformed first — still fails loudly in _pad_rows)
             if not batch_rows:
                 rows = y.shape[0]
-                rows += (-rows) % n_dev   # data-axis divisibility
+                rows += (-rows) % n_local_dev   # data-axis divisibility
                 batch_rows.append(rows)
         # final partial batch: pad, weight 0
         padded = _pad_rows(feats + (y, w), batch_rows[0])
@@ -1145,11 +1178,11 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                 # per-device shard layouts: slot sources numbered inside
                 # each device's contiguous local row block (P("data")
                 # shards dim 0 the same way)
-                local = batch_rows[0] // n_dev
+                local = batch_rows[0] // n_local_dev
                 cap = (ell_ovf_cap if ell_ovf_cap is not None
                        else max(1024, local))
                 lay = ell_layout(
-                    cat_p.reshape(n_dev, local, cat_p.shape[-1]),
+                    cat_p.reshape(n_local_dev, local, cat_p.shape[-1]),
                     num_features, pad_ovf_cap=cap,
                     pad_heavy_cap=ell_heavy_cap, device=False)
                 return (dense_p, cat_p,
@@ -1229,7 +1262,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                     next(reader)
         if not batch_rows and hasattr(reader, "batch_rows"):
             rows = int(reader.batch_rows)
-            batch_rows.append(rows + (-rows) % n_dev)
+            batch_rows.append(rows + (-rows) % n_local_dev)
 
         # Running on-device sum: memory stays flat over millions of batches
         # (a list of live per-batch scalars would grow O(n_batches)).
@@ -1240,7 +1273,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         for dev_batch in prefetch_to_device(
                 reader, depth=prefetch_depth,
                 transform=to_host_batch, sharding=sharding,
-                workers=prefetch_workers, stats=prefetch_stats):
+                workers=prefetch_workers, stats=prefetch_stats,
+                put_fn=put_fn):
             params, value = batch_step(params, *dev_batch)
             loss_sum = value if loss_sum is None else add(loss_sum, value)
             n_batches += 1
@@ -1251,7 +1285,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                 _save(epoch, step_in_epoch, loss_sum, n_batches)
         if loss_sum is None:
             raise ValueError("make_reader() returned an empty epoch")
-        epoch_loss = float(jax.device_get(loss_sum)) / n_batches
+        epoch_loss = float(
+            np.asarray(_fetch_replicated(loss_sum))) / n_batches
         loss_log.append(epoch_loss)
         stop = config.tol > 0 and abs(prev_loss - epoch_loss) <= config.tol
         if not stop:
@@ -1260,7 +1295,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
             _save(epoch + 1, 0, None, 0, converged=stop)  # epoch-boundary cut
         if stop:
             break
-    params = jax.device_get(params)
+    params = _fetch_replicated(params)
     return LinearState(np.asarray(params["w"], np.float64),
                        float(params["b"]),
                        planned_impl=stream_impl), loss_log

@@ -154,6 +154,51 @@ def main() -> None:
     np.testing.assert_allclose(log, oracle_log, atol=1e-5)
     assert log[-1] < log[0]
 
+    # multi-host STREAMING fit (r4): each process feeds its own reader
+    # (its own data shard, the parallelism-P source posture); the global
+    # batch is the per-step concatenation over processes, assembled by
+    # make_array_from_process_local_data inside the prefetch pipeline.
+    # Must equal a manual single-program loop over the concatenated
+    # batches (deterministic shards => every process computes the oracle).
+    from flink_ml_tpu.models.common.sgd import sgd_fit_outofcore
+
+    def stream_shard(p):
+        srng = np.random.default_rng(300 + p)
+        nloc, nd2, nc2 = 96, 3, 2
+        return (srng.normal(size=(nloc, nd2)).astype(np.float32),
+                srng.integers(nd2, 256, size=(nloc, nc2)).astype(np.int32),
+                (srng.normal(size=nloc) > 0).astype(np.float32))
+
+    def make_stream_reader():
+        d_l, c_l, y_loc = stream_shard(pid)
+        return iter([{"fd": d_l[i:i + 32], "fi": c_l[i:i + 32],
+                      "label": y_loc[i:i + 32]} for i in range(0, 96, 32)])
+
+    scfg = SGDConfig(learning_rate=0.4, max_epochs=2, tol=0)
+    st_state, st_log = sgd_fit_outofcore(
+        LOSSES["logistic"], make_stream_reader, num_features=256,
+        config=scfg, mesh=mesh, dense_key="fd", indices_key="fi")
+    assert st_state.planned_impl == "xla-stream"
+
+    st_update = jax.jit(_mixed_update(LOSSES["logistic"], scfg))
+    sp = {"w": jnp.zeros((256,), jnp.float32),
+          "b": jnp.zeros((), jnp.float32)}
+    shards = [stream_shard(p) for p in range(nprocs)]
+    s_log = []
+    for _ in range(scfg.max_epochs):
+        losses = []
+        for i in range(0, 96, 32):
+            gd = np.concatenate([sh[0][i:i + 32] for sh in shards])
+            gc = np.concatenate([sh[1][i:i + 32] for sh in shards])
+            gy = np.concatenate([sh[2][i:i + 32] for sh in shards])
+            sp, v = st_update(sp, gd, gc, gy,
+                              np.ones(len(gy), np.float32))
+            losses.append(float(v))
+        s_log.append(float(np.mean(losses)))
+    np.testing.assert_allclose(st_state.coefficients,
+                               np.asarray(sp["w"], np.float64), atol=1e-5)
+    np.testing.assert_allclose(st_log, s_log, atol=1e-5)
+
     # dp x model over 2 OS processes (VERDICT r3 task 5): the weight
     # itself sharded over the 'model' axis with the same shards living on
     # BOTH hosts' devices — the final fetch is a cross-process allgather
