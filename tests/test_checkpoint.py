@@ -377,3 +377,48 @@ def test_outofcore_midepoch_resume_without_seek_protocol(tmp_path):
         checkpoint=ckpt, checkpoint_every_steps=2, resume=True)
     np.testing.assert_array_equal(resumed_state.coefficients,
                                   ref_state.coefficients)
+
+
+def test_outofcore_midepoch_resume_exact_sharded_ell(tmp_path, monkeypatch):
+    """Mid-epoch kill/resume exactness through the r4 SHARDED streaming
+    ELL path (per-device shard layouts on the 8-device mesh): the resumed
+    run must land bit-exactly on the uninterrupted run's parameters."""
+    from flink_ml_tpu.data.datacache import DataCacheReader, DataCacheWriter
+    from flink_ml_tpu.models.common import sgd
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    rng = np.random.default_rng(11)
+    n, nd, nc, d = 1536, 3, 4, 128 * 128
+    dense = rng.normal(size=(n, nd)).astype(np.float32)
+    cat = rng.integers(0, d, size=(n, nc)).astype(np.int32)
+    y = rng.integers(0, 2, size=n).astype(np.float32)
+    cache = str(tmp_path / "mixed")
+    w = DataCacheWriter(cache, segment_rows=512)
+    w.append({"fd": dense, "fi": cat, "label": y})
+    w.finish()
+
+    monkeypatch.setattr(sgd, "plan_mixed_impl", lambda *a, **k: "ell")
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=3, tol=0.0)
+    kw = dict(num_features=d, config=cfg, dense_key="fd", indices_key="fi")
+
+    def reader():
+        return DataCacheReader(cache, batch_rows=256)
+
+    ref_state, ref_log = sgd_fit_outofcore(logistic_loss, reader, **kw)
+    assert ref_state.planned_impl == "ell-stream"   # sharded on 8 devices
+
+    ckpt = CheckpointConfig(str(tmp_path / "ck"), max_to_keep=3)
+    _FailingReader.fail_counter = 0
+    with pytest.raises(RuntimeError, match="injected"):
+        sgd_fit_outofcore(
+            logistic_loss, lambda: _FailingReader(reader(), 9), **kw,
+            checkpoint=ckpt, checkpoint_every_steps=2)
+    _FailingReader.fail_counter = None
+
+    resumed_state, resumed_log = sgd_fit_outofcore(
+        logistic_loss, reader, **kw,
+        checkpoint=ckpt, checkpoint_every_steps=2, resume=True)
+    np.testing.assert_array_equal(resumed_state.coefficients,
+                                  ref_state.coefficients)
+    np.testing.assert_array_equal(resumed_log, ref_log)
