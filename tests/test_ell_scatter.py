@@ -438,3 +438,80 @@ def test_sharded_ell_sparse_fit_matches_single_device_oracle(monkeypatch):
                                atol=1e-5)
     np.testing.assert_allclose(log_s, log_1, atol=1e-6)
     assert log_s[-1] < log_s[0]
+
+
+def test_native_layout_builder_matches_numpy():
+    """native/ell_layout.cpp (counting-sort, ~13x the numpy builder at
+    product shape) must reproduce the numpy builder exactly: grids,
+    overflow order, heavy routing, sentinel handling, forced-cap raises.
+    Heavy f32 VALUE sums may differ in summation order only."""
+    import flink_ml_tpu.ops.ell_scatter as E
+
+    lib = E._native_ell()
+    if lib is None:
+        pytest.skip("native ell_layout unavailable (no toolchain)")
+
+    def both(cat, d, values=None, **kw):
+        nat = E.ell_layout(cat, d, values=values, device=False, **kw)
+        E._ELL_NATIVE, E._ELL_NATIVE_TRIED = None, True   # force numpy
+        try:
+            ref = E.ell_layout(cat, d, values=values, device=False, **kw)
+        finally:
+            E._ELL_NATIVE_TRIED = False
+        return nat, ref
+
+    def check(cat, d, values=None, **kw):
+        nat, ref = both(cat, d, values=values, **kw)
+        for f in ("src", "pos", "mask", "ovf_idx", "ovf_src", "heavy_idx",
+                  "need_ovf", "need_heavy"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(nat, f)), np.asarray(getattr(ref, f)),
+                err_msg=f)
+        if values is None:
+            np.testing.assert_array_equal(np.asarray(nat.heavy_cnt),
+                                          np.asarray(ref.heavy_cnt))
+        else:
+            np.testing.assert_allclose(np.asarray(nat.heavy_cnt),
+                                       np.asarray(ref.heavy_cnt), atol=1e-4)
+            np.testing.assert_array_equal(np.asarray(nat.val),
+                                          np.asarray(ref.val))
+            np.testing.assert_array_equal(np.asarray(nat.ovf_val),
+                                          np.asarray(ref.ovf_val))
+
+    rng = np.random.default_rng(0)
+    d = 128 * 128
+    check(rng.integers(0, d, size=(3, 96, 5)).astype(np.int32), d)
+
+    # heavy + overflow flood + a second light index sharing the row
+    cat2 = rng.integers(0, d, size=(2, 700, 4)).astype(np.int32)
+    cat2[:, :, 0] = 777
+    cat2[:, ::2, 1] = 778
+    check(cat2, d)
+
+    # sentinel padding rows drop out of the layout
+    cat3 = rng.integers(0, d, size=(2, 64, 4)).astype(np.int32)
+    cat3[:, 50:, :] = d
+    check(cat3, d)
+
+    # values variant (sgd_fit_sparse's layout)
+    check(cat2, d, values=rng.normal(size=cat2.shape).astype(np.float32))
+
+    # forced caps raise identically on both paths
+    cat4 = cat2.copy()
+    cat4[:, :, 1] = 9999   # two heavy indices
+    for forced in ({"pad_heavy_cap": 1}, {"pad_ovf_cap": 8}):
+        with pytest.raises(ValueError, match="forced cap"):
+            E.ell_layout(cat2 if "pad_ovf_cap" in forced else cat4, d,
+                         device=False, **forced)
+        E._ELL_NATIVE, E._ELL_NATIVE_TRIED = None, True
+        try:
+            with pytest.raises(ValueError, match="forced cap"):
+                E.ell_layout(cat2 if "pad_ovf_cap" in forced else cat4, d,
+                             device=False, **forced)
+        finally:
+            E._ELL_NATIVE_TRIED = False
+
+    # forced caps that fit produce exact forced shapes
+    nat, ref = both(cat2, d, pad_ovf_cap=2048, pad_heavy_cap=4)
+    assert nat.ovf_idx.shape == ref.ovf_idx.shape == (2, 2048)
+    assert nat.heavy_idx.shape == ref.heavy_idx.shape == (2, 4)
