@@ -22,8 +22,10 @@
 // exceed a cap (outputs are then partial garbage — the caller re-calls
 // with caps >= the returned needs).
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 #include <algorithm>
 
@@ -48,13 +50,16 @@ extern "C" {
 //   heavy_idx (steps, heavy_cap) int32
 //   heavy_cnt (steps, heavy_cap, batch) int16 without values, f32 with
 //   need_ovf/need_heavy (steps,) int32
-int ell_build(const int32_t* flat, const float* values,
-              int64_t steps, int64_t batch, int64_t nnz, int64_t rows,
-              int64_t heavy_threshold, int64_t ovf_cap, int64_t heavy_cap,
-              int32_t* src, int32_t* pos, float* mask, float* val,
-              int32_t* ovf_idx, int32_t* ovf_src, float* ovf_val,
-              int32_t* heavy_idx, void* heavy_cnt,
-              int32_t* need_ovf, int32_t* need_heavy) {
+static void build_steps(const int32_t* flat, const float* values,
+                        int64_t s_begin, int64_t s_end,
+                        int64_t batch, int64_t nnz, int64_t rows,
+                        int64_t heavy_threshold, int64_t ovf_cap,
+                        int64_t heavy_cap,
+                        int32_t* src, int32_t* pos, float* mask, float* val,
+                        int32_t* ovf_idx, int32_t* ovf_src, float* ovf_val,
+                        int32_t* heavy_idx, void* heavy_cnt,
+                        int32_t* need_ovf, int32_t* need_heavy,
+                        std::atomic<int>* rc_out) {
   const int64_t d = rows * 128;
   const int64_t n = batch * nnz;
   const int64_t grid = rows * 128;
@@ -64,9 +69,8 @@ int ell_build(const int32_t* flat, const float* values,
   std::vector<Spill> spills;
   std::vector<int32_t> hvec;
   std::vector<Spill> heavy_slots;
-  int rc = 0;
 
-  for (int64_t s = 0; s < steps; ++s) {
+  for (int64_t s = s_begin; s < s_end; ++s) {
     const int32_t* f = flat + s * n;
     const float* fv = values ? values + s * n : nullptr;
     std::memset(cnt.data(), 0, d * sizeof(int32_t));
@@ -141,7 +145,7 @@ int ell_build(const int32_t* flat, const float* values,
     need_heavy[s] = static_cast<int32_t>(hvec.size());
     if (static_cast<int64_t>(spills.size()) > ovf_cap ||
         static_cast<int64_t>(hvec.size()) > heavy_cap) {
-      rc = 1;
+      rc_out->store(1);
       continue;  // still fill remaining steps' needs
     }
     std::sort(spills.begin(), spills.end(),
@@ -185,7 +189,42 @@ int ell_build(const int32_t* flat, const float* values,
       }
     }
   }
-  return rc;
+}
+
+// Entry point: steps are independent (disjoint output slices), so they
+// split across hardware threads, each with its own ~9 MB scratch.  On
+// the 1-core bench host this degenerates to the serial loop.
+int ell_build(const int32_t* flat, const float* values,
+              int64_t steps, int64_t batch, int64_t nnz, int64_t rows,
+              int64_t heavy_threshold, int64_t ovf_cap, int64_t heavy_cap,
+              int32_t* src, int32_t* pos, float* mask, float* val,
+              int32_t* ovf_idx, int32_t* ovf_src, float* ovf_val,
+              int32_t* heavy_idx, void* heavy_cnt,
+              int32_t* need_ovf, int32_t* need_heavy) {
+  std::atomic<int> rc(0);
+  int64_t hw = static_cast<int64_t>(std::thread::hardware_concurrency());
+  if (hw < 1) hw = 1;
+  const int64_t n_threads = std::min<int64_t>(std::min<int64_t>(hw, 8),
+                                              steps);
+  if (n_threads <= 1) {
+    build_steps(flat, values, 0, steps, batch, nnz, rows, heavy_threshold,
+                ovf_cap, heavy_cap, src, pos, mask, val, ovf_idx, ovf_src,
+                ovf_val, heavy_idx, heavy_cnt, need_ovf, need_heavy, &rc);
+    return rc.load();
+  }
+  std::vector<std::thread> pool;
+  const int64_t per = (steps + n_threads - 1) / n_threads;
+  for (int64_t t = 0; t < n_threads; ++t) {
+    const int64_t b = t * per;
+    const int64_t e = std::min(steps, b + per);
+    if (b >= e) break;
+    pool.emplace_back(build_steps, flat, values, b, e, batch, nnz, rows,
+                      heavy_threshold, ovf_cap, heavy_cap, src, pos, mask,
+                      val, ovf_idx, ovf_src, ovf_val, heavy_idx, heavy_cnt,
+                      need_ovf, need_heavy, &rc);
+  }
+  for (auto& th : pool) th.join();
+  return rc.load();
 }
 
 }  // extern "C"
