@@ -73,8 +73,11 @@ def test_termination_criteria_fused_matches_hosted():
 
     hosted = iterate(body, jnp.asarray(1.0), max_epochs=100,
                      config=IterationConfig(mode="hosted"))
-    fused = iterate(body, jnp.asarray(1.0), max_epochs=100,
-                    config=IterationConfig(mode="fused"))
+    # fused + outputs + criteria: the documented keeps-last-epoch warning
+    # must fire (the IterationListener-era evidence, VERDICT row 18)
+    with pytest.warns(UserWarning, match="LAST epoch's outputs"):
+        fused = iterate(body, jnp.asarray(1.0), max_epochs=100,
+                        config=IterationConfig(mode="fused"))
     assert float(fused.state) == float(hosted.state)
     assert fused.num_epochs == hosted.num_epochs
 
