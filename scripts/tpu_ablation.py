@@ -161,3 +161,59 @@ for name, off in [
     t = fit_cost(make_loop(make_ablated(**{**ON, **off})),
                  args_base + extra)
     print(f"{name:26s} {t*1e3:7.2f} ms/step", flush=True)
+
+
+# ---- gather-implementation variants --------------------------------------
+# The r3 gap's prime suspect is the u-gather (r_ext[src]); the blocked
+# 256-lane row-gather wins MICRObenchmarks (1.7 vs 6-7 ns/slot
+# elementwise), but inside the fused step XLA may materialize the
+# blocked path's (slots, lanes) intermediates.  One timed leg per
+# implementation of each gather answers it.
+def _blocked_gather_lanes(w, idx, lanes):
+    flat = idx.reshape(-1)
+    hi, lo = flat // lanes, flat % lanes
+    onehot = lo[:, None] == jnp.arange(lanes, dtype=lo.dtype)[None, :]
+    rows_ = w.reshape(-1, lanes)[hi]
+    return jnp.sum(jnp.where(onehot, rows_, 0), axis=-1).reshape(idx.shape)
+
+
+def make_gather_variant(u_mode, margin_mode):
+    def update(params, dense_b, cat_b, src, pos, mask, oi, osrc, hi, hc,
+               yb, wb):
+        w, b = params["w"], params["b"]
+        nd = dense_b.shape[-1]
+        if margin_mode == "blocked":
+            mg = jnp.sum(_gather_weights(w, cat_b), axis=-1)
+        else:
+            mg = jnp.sum(w[cat_b], axis=-1)
+        margin = dense_b @ w[:nd] + mg + b
+        value, pull = jax.vjp(lambda m: logistic_loss(m, yb, wb), margin)
+        (r,) = pull(jnp.ones_like(value))
+        pad = 256 - (BATCH % 256) or 256
+        r_ext = jnp.concatenate([r, jnp.zeros((pad,), jnp.float32)])
+        if u_mode == "blocked256":
+            u = (-LR) * _gather_weights(r_ext, src)
+        elif u_mode == "blocked128":
+            u = (-LR) * _blocked_gather_lanes(r_ext, src, 128)
+        else:
+            u = (-LR) * r_ext[src]
+        w = ell_scatter_apply(w, u, pos, mask)
+        w = w.at[oi].add((-LR) * r_ext[osrc])
+        w = w.at[hi].add((-LR) * (hc.astype(jnp.float32) @ r))
+        w = w.at[:nd].add(-LR * (r @ dense_b))
+        b = b - LR * jnp.sum(r)
+        return {"w": w, "b": b}, value
+    return update
+
+
+print("--- gather variants (full step, one knob changed) ---", flush=True)
+for u_mode in ("blocked256", "blocked128", "elementwise"):
+    t = fit_cost(make_loop(make_gather_variant(u_mode, "blocked")),
+                 args_base + extra)
+    print(f"u={u_mode:12s} margin=blocked    {t*1e3:7.2f} ms/step",
+          flush=True)
+for margin_mode in ("elementwise",):
+    t = fit_cost(make_loop(make_gather_variant("blocked256", margin_mode)),
+                 args_base + extra)
+    print(f"u=blocked256   margin={margin_mode:12s} {t*1e3:6.2f} ms/step",
+          flush=True)
