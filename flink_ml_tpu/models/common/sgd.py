@@ -700,7 +700,8 @@ _ELL_LAYOUT_BUDGET_BYTES = 2 << 30
 
 def plan_mixed_impl(num_features: int, mesh, steps: int = 1,
                     layout_bytes_per_slot: int = 12,
-                    allow_sharded: bool = False) -> str:
+                    allow_sharded: bool = False,
+                    allow_multiprocess: bool = False) -> str:
     """Which categorical-scatter implementation :func:`sgd_fit_mixed`
     runs: ``"ell"`` (the Pallas static-routing kernel,
     ``ops/ell_scatter.py``) on TPU when the weight size tiles into
@@ -708,12 +709,15 @@ def plan_mixed_impl(num_features: int, mesh, steps: int = 1,
     HBM budget, else ``"xla"``.
 
     ``allow_sharded=True`` (what ``sgd_fit_mixed`` passes) additionally
-    admits single-process data-axis meshes: each device routes its own
-    batch shard through a device-local grid and one psum completes the
-    scatter (:func:`_mixed_update_ell_sharded`) — the layout budget is
-    per-device, so the check does not change with the axis size.  Callers
-    whose ELL wiring is single-device-shaped (the streaming fit) keep the
-    default and fall back to XLA on any multi-device mesh."""
+    admits data-axis meshes: each device routes its own batch shard
+    through a device-local grid and one psum completes the scatter
+    (:func:`_mixed_update_ell_sharded`) — the layout budget is
+    per-device, so the check does not change with the axis size.
+    ``allow_multiprocess=True`` extends that to process-spanning meshes —
+    only for callers whose layout build is per-process-local (the
+    STREAMING fit, whose decode workers build each host's own device
+    stacks); the fused fit builds the whole global batch's layout in one
+    process and stays single-process."""
     import jax as _jax
 
     from ...ops.ell_scatter import supported as _ell_supported
@@ -723,8 +727,8 @@ def plan_mixed_impl(num_features: int, mesh, steps: int = 1,
     except Exception:
         n_dev = len(mesh.devices.flat)
     data_only = n_dev == int(mesh.shape.get("data", 0))
-    mesh_ok = n_dev == 1 or (allow_sharded and data_only
-                             and _mesh_process_count(mesh) == 1)
+    procs_ok = _mesh_process_count(mesh) == 1 or allow_multiprocess
+    mesh_ok = n_dev == 1 or (allow_sharded and data_only and procs_ok)
     if (_jax.default_backend() == "tpu" and mesh_ok
             and _ell_supported(num_features)
             and steps * num_features * layout_bytes_per_slot
@@ -1025,9 +1029,10 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     (``make_array_from_process_local_data``); the gradient reduction rides
     the mesh like the in-memory fits.  SPMD contract: every process must
     deliver the SAME number of equal-sized batches per epoch — mismatched
-    readers deadlock in the collectives.  The ELL streaming kernel stays
-    single-process for now (multi-process mixed batches run the XLA
-    scatter).
+    readers deadlock in the collectives.  The ELL streaming path works
+    across processes too: each host's decode workers build the layouts
+    for its OWN devices' row blocks, and the assembled global stacks
+    drive the device-local-grid + psum update.
 
     **Mid-epoch checkpoints** (``checkpoint`` + ``checkpoint_every_steps``):
     on a 1TB pass one epoch is hours, so an epoch-boundary-only cut (the
@@ -1069,8 +1074,9 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     # multi-device data axis the decode builds PER-DEVICE shard layouts
     # and the update is the device-local-grid + psum variant (same
     # stance as the fused sgd_fit_mixed, r4).
-    stream_ell = (mixed and plan_mixed_impl(num_features, mesh,
-                                            allow_sharded=True) == "ell")
+    stream_ell = (mixed and plan_mixed_impl(
+        num_features, mesh, allow_sharded=True,
+        allow_multiprocess=True) == "ell")
     stream_sharded = stream_ell and n_dev > 1
     stream_impl = ("ell-stream" if stream_ell
                    else ("xla-stream" if (mixed or sparse)

@@ -180,6 +180,39 @@ def main() -> None:
         config=scfg, mesh=mesh, dense_key="fd", indices_key="fi")
     assert st_state.planned_impl == "xla-stream"
 
+    # cross-process SHARDED ELL streaming (r4): per-host decode builds
+    # its own devices' layout stacks; forced plan (the planner is
+    # TPU-gated) runs the kernel's XLA twin through the full multi-host
+    # wiring.  Must equal the xla-stream fit above... on the same data
+    # but the ELL layout needs an ELL-supported hash space, so rerun
+    # both paths at d=128*128 and compare against each other.
+    from flink_ml_tpu.models.common import sgd as S
+
+    d_ell = 128 * 128
+
+    def make_stream_reader_ell():
+        d_l, c_l, y_loc = stream_shard(pid)
+        c_big = (c_l.astype(np.int64) * 131) % (d_ell - 3) + 3
+        return iter([{"fd": d_l[i:i + 32],
+                      "fi": c_big[i:i + 32].astype(np.int32),
+                      "label": y_loc[i:i + 32]} for i in range(0, 96, 32)])
+
+    real_plan = S.plan_mixed_impl
+    S.plan_mixed_impl = lambda *a, **k: "ell"
+    try:
+        ell_state, ell_log = sgd_fit_outofcore(
+            LOSSES["logistic"], make_stream_reader_ell, num_features=d_ell,
+            config=scfg, mesh=mesh, dense_key="fd", indices_key="fi")
+    finally:
+        S.plan_mixed_impl = real_plan
+    assert ell_state.planned_impl == "ell-stream"
+    xla_state, xla_log = sgd_fit_outofcore(
+        LOSSES["logistic"], make_stream_reader_ell, num_features=d_ell,
+        config=scfg, mesh=mesh, dense_key="fd", indices_key="fi")
+    np.testing.assert_allclose(ell_state.coefficients,
+                               xla_state.coefficients, atol=1e-5)
+    np.testing.assert_allclose(ell_log, xla_log, atol=1e-6)
+
     st_update = jax.jit(_mixed_update(LOSSES["logistic"], scfg))
     sp = {"w": jnp.zeros((256,), jnp.float32),
           "b": jnp.zeros((), jnp.float32)}
