@@ -498,19 +498,24 @@ def ell_layout_device(cat_indices: jnp.ndarray, num_features: int,
                      need_ovf=n_ovf, need_heavy=n_heavy)
 
 
+def _csum_pick_tail(x, p, m, w, block_rows: int):
+    """THE scatter tail shared by both Mosaic kernels: exact inclusive
+    cumsum along lanes (7 shifted adds — fixed f32 order, deterministic,
+    no MXU rounding), static-position pick, boundary difference."""
+    for k in (1, 2, 4, 8, 16, 32, 64):
+        x = x + jnp.concatenate(
+            [jnp.zeros((block_rows, k), jnp.float32), x[:, :-k]],
+            axis=1)
+    G = jnp.take_along_axis(x, p, axis=1) * m
+    Gs = jnp.concatenate(
+        [jnp.zeros((block_rows, 1), jnp.float32), G[:, :-1]], axis=1)
+    return w + G - Gs
+
+
 def _kernel(block_rows: int):
     def kern(u_ref, p_ref, m_ref, w_ref, out_ref):
-        x = u_ref[:]
-        # exact inclusive cumsum along lanes: 7 shifted adds (f32 adds in
-        # fixed order — deterministic, no MXU rounding)
-        for k in (1, 2, 4, 8, 16, 32, 64):
-            x = x + jnp.concatenate(
-                [jnp.zeros((block_rows, k), jnp.float32), x[:, :-k]],
-                axis=1)
-        G = jnp.take_along_axis(x, p_ref[:], axis=1) * m_ref[:]
-        Gs = jnp.concatenate(
-            [jnp.zeros((block_rows, 1), jnp.float32), G[:, :-1]], axis=1)
-        out_ref[:] = w_ref[:] + G - Gs
+        out_ref[:] = _csum_pick_tail(u_ref[:], p_ref[:], m_ref[:],
+                                     w_ref[:], block_rows)
     return kern
 
 
@@ -536,6 +541,82 @@ def ell_scatter_apply(w: jnp.ndarray, upd: jnp.ndarray, pos: jnp.ndarray,
         out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
         interpret=interpret,
     )(upd, pos, mask, w2)
+    return out.reshape(-1)
+
+
+def _fused_kernel(block_rows: int, r_rows: int):
+    """EXPERIMENTAL (r4, pending TPU measurement): compute the u-gather
+    ``u = -lr * r_ext[src]`` INSIDE the kernel via a one-hot MXU matmul
+    + lane-local pick, then run the csum/pick/diff scatter.  Rationale:
+    the XLA blocked gather is DMA-transaction-bound (~1.7-2.5 ns/slot =
+    ~2-2.5 ms/step at 1M slots — the r3 ablation's prime suspect), while
+    r_ext is tiny (fits VMEM): per 128-slot row the one-hot contraction
+    against the (r_rows, 128) view of r_ext costs ~33 kMAC/slot — ~0.35
+    ms/step of MXU work instead of the transaction stall."""
+    def kern(src_ref, p_ref, m_ref, r2d_ref, w_ref, out_ref):
+        src = src_ref[:]                       # (block_rows, 128) i32
+        r2d = r2d_ref[:]                       # (r_rows, 128) f32, holds
+        hi = src // 128                        #   the PRE-SCALED -lr*r_ext
+        lo = src % 128
+        cols = []
+        for r in range(block_rows):
+            # OH2[j, s] = [hi[r, s] == j] over the r_ext rows
+            oh = (jax.lax.broadcasted_iota(jnp.int32, (r_rows, 128), 0)
+                  == hi[r][None, :]).astype(jnp.float32)
+            # G1[s, l] = r2d[hi[r, s], l]
+            g1 = jnp.dot(oh.T, r2d, preferred_element_type=jnp.float32)
+            # pick each slot's lane: (128, 1) column of u values
+            cols.append(jnp.take_along_axis(g1, lo[r][:, None], axis=1))
+        u = jnp.concatenate(cols, axis=1).T    # (block_rows, 128)
+        out_ref[:] = _csum_pick_tail(u, p_ref[:], m_ref[:], w_ref[:],
+                                     block_rows)
+    return kern
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def ell_scatter_apply_fused(w: jnp.ndarray, r_ext: jnp.ndarray,
+                            src: jnp.ndarray, pos: jnp.ndarray,
+                            mask: jnp.ndarray, *, lr,
+                            interpret: bool = False) -> jnp.ndarray:
+    """``w + scatter(-lr * r_ext[src])`` with the gather fused into the
+    Mosaic kernel (see :func:`_fused_kernel`).  ``r_ext`` length must be
+    a multiple of 128 (:func:`sgd._extended_r` pads to 256) and the
+    table must have a multiple of 8 rows (every ``supported()`` power-of
+    -two size does).  ``lr`` is traced — it scales ``r_ext`` OUTSIDE the
+    kernel, so learning-rate sweeps share one compiled executable.
+    Small block (8 rows) keeps the per-block one-hot tile in VMEM."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows = src.shape[0]
+    r_rows = r_ext.shape[0] // 128
+    assert r_ext.shape[0] % 128 == 0
+    if rows % 8:
+        raise ValueError(
+            f"fused kernel needs rows % 8 == 0, got {rows}; use "
+            "ell_scatter_apply")
+    br = 8
+    r2d = ((-lr) * r_ext).reshape(r_rows, 128)
+    w2 = w.reshape(rows, _LANES)
+    out = pl.pallas_call(
+        _fused_kernel(br, r_rows), grid=(rows // br,),
+        in_specs=[
+            pl.BlockSpec((br, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((r_rows, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((br, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+        interpret=interpret,
+    )(src, pos, mask, r2d, w2)
     return out.reshape(-1)
 
 

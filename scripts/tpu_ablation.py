@@ -217,3 +217,44 @@ for margin_mode in ("elementwise",):
                  args_base + extra)
     print(f"u=blocked256   margin={margin_mode:12s} {t*1e3:6.2f} ms/step",
           flush=True)
+
+
+# ---- EXPERIMENTAL fused-gather kernel -------------------------------------
+# Replaces u-gather + kernel with one Mosaic call (one-hot MXU
+# contraction in-kernel).  If the u-gather dominates the ablation above,
+# this leg is the candidate fix; ~0.35 ms/step of MXU work instead of
+# the ~2-2.5 ms transaction-bound gather.
+from flink_ml_tpu.models.common.sgd import _extended_r
+from flink_ml_tpu.ops.ell_scatter import ell_scatter_apply_fused
+
+
+def make_fused(margin_on=True):
+    def update(params, dense_b, cat_b, src, pos, mask, oi, osrc, hi, hc,
+               yb, wb):
+        w, b = params["w"], params["b"]
+        nd = dense_b.shape[-1]
+        if margin_on:
+            margin = (dense_b @ w[:nd]
+                      + jnp.sum(_gather_weights(w, cat_b), axis=-1) + b)
+        else:
+            margin = dense_b @ w[:nd] + b
+        value, pull = jax.vjp(lambda m: logistic_loss(m, yb, wb), margin)
+        (r,) = pull(jnp.ones_like(value))
+        r_ext = _extended_r(r)
+        w = ell_scatter_apply_fused(w, r_ext, src, pos, mask, lr=LR)
+        w = w.at[oi].add((-LR) * r_ext[osrc])
+        w = w.at[hi].add((-LR) * (hc.astype(jnp.float32) @ r))
+        w = w.at[:nd].add(-LR * (r @ dense_b))
+        b = b - LR * jnp.sum(r)
+        return {"w": w, "b": b}, value
+    return update
+
+
+print("--- fused-gather kernel (experimental) ---", flush=True)
+try:
+    t = fit_cost(make_loop(make_fused()), args_base + extra)
+    print(f"fused gather+kernel        {t*1e3:7.2f} ms/step", flush=True)
+    t = fit_cost(make_loop(make_fused(margin_on=False)), args_base + extra)
+    print(f"fused, - margin gather     {t*1e3:7.2f} ms/step", flush=True)
+except Exception as exc:  # noqa: BLE001 - Mosaic compile risk, keep going
+    print(f"fused kernel leg failed: {exc!r}"[:300], flush=True)

@@ -515,3 +515,27 @@ def test_native_layout_builder_matches_numpy():
     nat, ref = both(cat2, d, pad_ovf_cap=2048, pad_heavy_cap=4)
     assert nat.ovf_idx.shape == ref.ovf_idx.shape == (2, 2048)
     assert nat.heavy_idx.shape == ref.heavy_idx.shape == (2, 4)
+
+
+def test_fused_gather_kernel_matches_twin_interpret():
+    """ell_scatter_apply_fused (EXPERIMENTAL r4: u-gather inside the
+    kernel via one-hot MXU contraction) must equal gather-then-apply in
+    interpret mode, including pad slots (src == batch -> r_ext zero pad)."""
+    from flink_ml_tpu.ops.ell_scatter import ell_scatter_apply_fused
+
+    rng = np.random.default_rng(3)
+    d, batch, nnz = 128 * 128, 96, 7
+    cat = rng.integers(0, d, size=(1, batch, nnz)).astype(np.int32)
+    lay = ell_layout(cat, d, device=False)
+    r = rng.normal(size=batch).astype(np.float32)
+    r_ext = np.concatenate([r, np.zeros(256 - batch % 256, np.float32)])
+    w0 = rng.normal(size=d).astype(np.float32)
+    lr = 0.35
+    got = np.asarray(ell_scatter_apply_fused(
+        jnp.asarray(w0), jnp.asarray(r_ext), jnp.asarray(lay.src[0]),
+        jnp.asarray(lay.pos[0]), jnp.asarray(lay.mask[0]), lr=lr,
+        interpret=True))
+    u = (-lr) * r_ext[np.asarray(lay.src[0])]
+    want = np.asarray(ell_scatter_apply_xla(
+        jnp.asarray(w0), jnp.asarray(u), lay.pos[0], lay.mask[0]))
+    np.testing.assert_allclose(got, want, atol=1e-5)

@@ -114,3 +114,30 @@ def test_kmeans_kernel_parity(tpu, rng, tie_policy):
                                atol=1e-3)
     np.testing.assert_allclose(np.asarray(sums, np.float64), want_sums,
                                rtol=2e-4, atol=2e-3)
+
+
+def test_ell_fused_gather_kernel_parity(tpu, rng):
+    """Mosaic compile + parity for the EXPERIMENTAL fused-gather kernel
+    (per-row one-hot MXU contraction + transpose — the riskiest Mosaic
+    surface in the repo; a compile failure here names it cheaply)."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.ops.ell_scatter import (
+        ell_layout,
+        ell_scatter_apply_fused,
+        ell_scatter_apply_xla,
+    )
+
+    d, batch, nnz = 128 * 128, 96, 7
+    cat = rng.integers(0, d, size=(1, batch, nnz)).astype(np.int32)
+    lay = ell_layout(cat, d)
+    r = rng.normal(size=batch).astype(np.float32)
+    r_ext = np.concatenate([r, np.zeros(256 - batch % 256, np.float32)])
+    w0 = rng.normal(size=d).astype(np.float32)
+    got = np.asarray(ell_scatter_apply_fused(
+        jnp.asarray(w0), jnp.asarray(r_ext), lay.src[0], lay.pos[0],
+        lay.mask[0], lr=0.35))
+    u = (-0.35) * jnp.asarray(r_ext)[lay.src[0]]
+    want = np.asarray(ell_scatter_apply_xla(
+        jnp.asarray(w0), u, lay.pos[0], lay.mask[0]))
+    np.testing.assert_allclose(got, want, atol=1e-4)
