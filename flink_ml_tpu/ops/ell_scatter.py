@@ -544,7 +544,7 @@ def ell_scatter_apply(w: jnp.ndarray, upd: jnp.ndarray, pos: jnp.ndarray,
     return out.reshape(-1)
 
 
-def _fused_kernel(block_rows: int, r_rows: int):
+def _fused_kernel(block_rows: int, r_rows: int, precision):
     """EXPERIMENTAL (r4, pending TPU measurement): compute the u-gather
     ``u = -lr * r_ext[src]`` INSIDE the kernel via a one-hot MXU matmul
     + lane-local pick, then run the csum/pick/diff scatter.  Rationale:
@@ -558,25 +558,31 @@ def _fused_kernel(block_rows: int, r_rows: int):
         r2d = r2d_ref[:]                       # (r_rows, 128) f32, holds
         hi = src // 128                        #   the PRE-SCALED -lr*r_ext
         lo = src % 128
+        lane = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1)
         cols = []
         for r in range(block_rows):
-            # OH2[j, s] = [hi[r, s] == j] over the r_ext rows
-            oh = (jax.lax.broadcasted_iota(jnp.int32, (r_rows, 128), 0)
-                  == hi[r][None, :]).astype(jnp.float32)
+            # OH[s, j] = [hi[r, s] == j] over the r_ext rows
+            oh = (hi[r][:, None]
+                  == jax.lax.broadcasted_iota(jnp.int32, (128, r_rows), 1)
+                  ).astype(jnp.float32)
             # G1[s, l] = r2d[hi[r, s], l]
-            g1 = jnp.dot(oh.T, r2d, preferred_element_type=jnp.float32)
-            # pick each slot's lane: (128, 1) column of u values
-            cols.append(jnp.take_along_axis(g1, lo[r][:, None], axis=1))
+            g1 = jnp.dot(oh, r2d, preferred_element_type=jnp.float32,
+                         precision=precision)
+            # pick each slot's lane via masked row-sum (Mosaic's gather
+            # lowering rejects (128, 1)-index take_along_axis)
+            pick = jnp.where(lane == lo[r][:, None], g1, 0.0)
+            cols.append(jnp.sum(pick, axis=1)[:, None])
         u = jnp.concatenate(cols, axis=1).T    # (block_rows, 128)
         out_ref[:] = _csum_pick_tail(u, p_ref[:], m_ref[:], w_ref[:],
                                      block_rows)
     return kern
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "precision"))
 def ell_scatter_apply_fused(w: jnp.ndarray, r_ext: jnp.ndarray,
                             src: jnp.ndarray, pos: jnp.ndarray,
                             mask: jnp.ndarray, *, lr,
+                            precision: str = "default",
                             interpret: bool = False) -> jnp.ndarray:
     """``w + scatter(-lr * r_ext[src])`` with the gather fused into the
     Mosaic kernel (see :func:`_fused_kernel`).  ``r_ext`` length must be
@@ -584,13 +590,21 @@ def ell_scatter_apply_fused(w: jnp.ndarray, r_ext: jnp.ndarray,
     table must have a multiple of 8 rows (every ``supported()`` power-of
     -two size does).  ``lr`` is traced — it scales ``r_ext`` OUTSIDE the
     kernel, so learning-rate sweeps share one compiled executable.
-    Small block (8 rows) keeps the per-block one-hot tile in VMEM."""
+    Small block (8 rows) keeps the per-block one-hot tile in VMEM.
+
+    ``precision`` sets the one-hot contraction's MXU mode: ``"default"``
+    (single bf16 pass — gathered values carry ~2^-8 relative truncation,
+    harmless gradient noise for SGD) or ``"highest"`` (multi-pass f32 —
+    exact parity with the XLA gather, ~3x the contraction's MXU cost)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     rows = src.shape[0]
+    if r_ext.shape[0] % 128:
+        raise ValueError(
+            f"fused kernel needs len(r_ext) % 128 == 0, got "
+            f"{r_ext.shape[0]}; pad with sgd._extended_r")
     r_rows = r_ext.shape[0] // 128
-    assert r_ext.shape[0] % 128 == 0
     if rows % 8:
         raise ValueError(
             f"fused kernel needs rows % 8 == 0, got {rows}; use "
@@ -599,7 +613,7 @@ def ell_scatter_apply_fused(w: jnp.ndarray, r_ext: jnp.ndarray,
     r2d = ((-lr) * r_ext).reshape(r_rows, 128)
     w2 = w.reshape(rows, _LANES)
     out = pl.pallas_call(
-        _fused_kernel(br, r_rows), grid=(rows // br,),
+        _fused_kernel(br, r_rows, precision), grid=(rows // br,),
         in_specs=[
             pl.BlockSpec((br, 128), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
