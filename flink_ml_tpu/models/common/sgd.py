@@ -55,6 +55,16 @@ class SGDConfig:
     tol: float = 1e-6           # epoch-loss-change termination; <=0 disables
     seed: int = 0
     fit_intercept: bool = True
+    #: MXU precision of the fused ELL kernels' in-kernel one-hot
+    #: contractions.  "default" (one bf16 pass) measured 4.39 ms/step at
+    #: bench shape vs 10.49 for "highest" (multi-pass f32) and 11.0 for
+    #: the XLA oracle (TPU_FUSED_STEP_r04.txt), and passes the bench's
+    #: epoch-level parity gate (rtol=1e-3): the contracted residuals are
+    #: batch-normalized, so their ~2^-8 relative truncation lands below
+    #: the f32 summation-order noise every ELL path already carries.
+    #: "highest" restores bit-comparable-to-XLA gathers at ~2.4x the
+    #: step cost.
+    ell_precision: str = "default"
 
 
 #: Classic minibatch default when nothing layout-aware applies.
@@ -429,26 +439,74 @@ def _mixed_update(loss_fn: LossFn, config: SGDConfig):
     return update
 
 
+def _ext_len(batch: int) -> int:
+    """Length of the extended per-sample tables (:func:`_extended_r` and
+    the ELL margin accumulator): batch plus a nonempty zero pad rounding
+    up to whole 256-lane rows (pad slots carry ``src == batch``)."""
+    return batch + (_GATHER_LANES - (batch % _GATHER_LANES)
+                    or _GATHER_LANES)
+
+
 def _extended_r(r: jnp.ndarray) -> jnp.ndarray:
     """r with a zero pad: padding slots carry ``src == batch`` and the pad
     rounds the gather table up to a whole number of 256-lane rows."""
     batch = r.shape[0]
-    pad = _GATHER_LANES - (batch % _GATHER_LANES) or _GATHER_LANES
-    return jnp.concatenate([r, jnp.zeros((pad,), jnp.float32)])
+    return jnp.concatenate(
+        [r, jnp.zeros((_ext_len(batch) - batch,), jnp.float32)])
 
 
-def _apply_ell_categorical(apply_ell, lr, w, r, r_ext, src, pos, mask,
-                           ovf_idx, ovf_src, heavy_idx, heavy_cnt,
-                           val_ell=None, ovf_val=None):
+def _ell_margin(use_pallas, precision, w, batch, src, pos, mask, ovf_idx,
+                ovf_src, heavy_idx, heavy_cnt, val_ell=None, ovf_val=None):
+    """Per-sample categorical margin ``sum_j v_j * w[idx_j]`` computed
+    over the SAME ELL routing the scatter uses — the forward half of the
+    r4 kernel plan (the ``w[cat]`` gather measured ~3.4 ms of the 7.79 ms
+    bench-shape step; the Mosaic margin kernel replaces it with one-hot
+    MXU contractions).  In-grid slots via :func:`ops.ell_margin_fused`
+    (or the XLA twin off-TPU), overflow via a tiny gather + extended-
+    table scatter-add (pad entries carry ``ovf_src == batch`` and land
+    in the discarded pad), heavy hitters via one ``(H,) @ (H, batch)``
+    matvec."""
+    from ...ops.ell_scatter import ell_margin_fused, ell_margin_xla
+
+    m_len = _ext_len(batch)
+    if use_pallas and src.shape[0] % 8 == 0:
+        mext = ell_margin_fused(w, src, pos, mask, m_len=m_len,
+                                val=val_ell, precision=precision)
+    else:
+        mext = ell_margin_xla(w, src, pos, mask, m_len, val=val_ell)
+    o = w[ovf_idx] if ovf_val is None else ovf_val * w[ovf_idx]
+    mext = mext.at[ovf_src].add(o, mode="drop")
+    return mext[:batch] + w[heavy_idx] @ heavy_cnt.astype(jnp.float32)
+
+
+def _apply_ell_categorical(use_pallas, precision, lr, w, r, r_ext, src,
+                           pos, mask, ovf_idx, ovf_src, heavy_idx,
+                           heavy_cnt, val_ell=None, ovf_val=None):
     """THE single copy of the ELL gradient application shared by the
     mixed (implicit value 1.0) and generic sparse (explicit values)
     update builders: slot gather -> kernel scatter -> overflow scatter ->
     heavy-hitter matvec ((H, batch) @ (batch,) replaces thousands of
     per-slot updates; padding entries carry zero counts and add 0 at
-    w[0])."""
-    g = _gather_weights(r_ext, src)
-    u = (-lr) * (g if val_ell is None else val_ell * g)
-    w = apply_ell(w, u, pos, mask)
+    w[0]).
+
+    On TPU (``use_pallas``) the slot gather + scatter run as ONE fused
+    Mosaic kernel — the r4 ablation measured the standalone XLA u-gather
+    as the dominant step cost (~5.6 ms of a 7.79 ms step; fused step
+    6.53 ms vs 8.92 ms XLA oracle) — with a per-shape fallback to the
+    gather + scatter-kernel pair when the grid doesn't divide into the
+    fused kernel's 8-row blocks."""
+    from ...ops.ell_scatter import (ell_scatter_apply,
+                                    ell_scatter_apply_fused,
+                                    ell_scatter_apply_xla)
+
+    if use_pallas and src.shape[0] % 8 == 0:
+        w = ell_scatter_apply_fused(w, r_ext, src, pos, mask, lr=lr,
+                                    val=val_ell, precision=precision)
+    else:
+        g = _gather_weights(r_ext, src)
+        u = (-lr) * (g if val_ell is None else val_ell * g)
+        apply_ell = ell_scatter_apply if use_pallas else ell_scatter_apply_xla
+        w = apply_ell(w, u, pos, mask)
     o = r_ext[ovf_src] if ovf_val is None else ovf_val * r_ext[ovf_src]
     w = w.at[ovf_idx].add((-lr) * o)
     return w.at[heavy_idx].add((-lr) * (heavy_cnt.astype(jnp.float32) @ r))
@@ -464,26 +522,26 @@ def _mixed_update_ell(loss_fn: LossFn, config: SGDConfig,
     are the per-step layout stacks produced by ``ell_layout`` at fit
     time; results differ from the XLA path only in f32 summation
     order."""
-    from ...ops.ell_scatter import ell_scatter_apply, ell_scatter_apply_xla
-
     lr = config.learning_rate
     finish = _finish_sparse_step(config)
-    apply_ell = ell_scatter_apply if use_pallas else ell_scatter_apply_xla
 
     def update(params, dense, cat, src, pos, mask, ovf_idx, ovf_src,
                heavy_idx, heavy_cnt, yb, wb):
         w, b = params["w"], params["b"]
         n_dense = dense.shape[-1]
         margin = (dense @ w[:n_dense]
-                  + jnp.sum(_gather_weights(w, cat), axis=-1) + b)
+                  + _ell_margin(use_pallas, config.ell_precision,
+                                w, dense.shape[0], src, pos,
+                                mask, ovf_idx, ovf_src, heavy_idx,
+                                heavy_cnt) + b)
         value, pull = jax.vjp(lambda m: loss_fn(m, yb, wb), margin)
         (r,) = pull(jnp.ones_like(value))
         r_ext = _extended_r(r)
 
         def apply_grad(w):
             w = _apply_ell_categorical(
-                apply_ell, lr, w, r, r_ext, src, pos, mask, ovf_idx,
-                ovf_src, heavy_idx, heavy_cnt)
+                use_pallas, config.ell_precision, lr, w, r, r_ext, src,
+                pos, mask, ovf_idx, ovf_src, heavy_idx, heavy_cnt)
             return w.at[:n_dense].add(-lr * (r @ dense))
 
         return finish(w, b, value, r, apply_grad)
@@ -520,11 +578,8 @@ def _mixed_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
     like the dense gradient's contraction.  Scatter compute and layout
     HBM both scale 1/D with the data axis; summation order differs from
     the single-device kernel only by the per-device partial-sum split."""
-    from ...ops.ell_scatter import ell_scatter_apply, ell_scatter_apply_xla
-
     lr = config.learning_rate
     finish = _finish_sparse_step(config)
-    apply_ell = ell_scatter_apply if use_pallas else ell_scatter_apply_xla
     d_spec = P("data")
 
     def _local_delta(r_l, src, pos, mask, ovf_idx, ovf_src, heavy_idx,
@@ -533,7 +588,8 @@ def _mixed_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
         # device dim; r_l is this device's residual shard
         r_ext = _extended_r(r_l)
         delta = _apply_ell_categorical(
-            apply_ell, lr, jnp.zeros((num_features,), jnp.float32), r_l,
+            use_pallas, config.ell_precision, lr,
+            jnp.zeros((num_features,), jnp.float32), r_l,
             r_ext, src[0], pos[0], mask[0], ovf_idx[0], ovf_src[0],
             heavy_idx[0], heavy_cnt[0])
         return jax.lax.psum(delta, "data")
@@ -569,17 +625,15 @@ def _sparse_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
     generic (indices, values) layout — the same device-local-grid + psum
     scatter, with per-slot updates ``-lr * value * r`` carried by the
     layout's value arrays."""
-    from ...ops.ell_scatter import ell_scatter_apply, ell_scatter_apply_xla
-
     lr = config.learning_rate
     finish = _finish_sparse_step(config)
-    apply_ell = ell_scatter_apply if use_pallas else ell_scatter_apply_xla
 
     def _local_delta(r_l, src, pos, mask, val, ovf_idx, ovf_src, ovf_val,
                      heavy_idx, heavy_cnt):
         r_ext = _extended_r(r_l)
         delta = _apply_ell_categorical(
-            apply_ell, lr, jnp.zeros((num_features,), jnp.float32), r_l,
+            use_pallas, config.ell_precision, lr,
+            jnp.zeros((num_features,), jnp.float32), r_l,
             r_ext, src[0], pos[0], mask[0], ovf_idx[0], ovf_src[0],
             heavy_idx[0], heavy_cnt[0], val_ell=val[0], ovf_val=ovf_val[0])
         return jax.lax.psum(delta, "data")
@@ -744,25 +798,25 @@ def _sparse_update_ell(loss_fn: LossFn, config: SGDConfig,
     carried by the layout's value arrays (``EllLayout.val`` /
     ``ovf_val`` / value-sum ``heavy_cnt``).  Same algebra as the XLA
     path up to f32 summation order."""
-    from ...ops.ell_scatter import ell_scatter_apply, ell_scatter_apply_xla
-
     lr = config.learning_rate
     finish = _finish_sparse_step(config)
-    apply_ell = ell_scatter_apply if use_pallas else ell_scatter_apply_xla
 
     def update(params, idx, vals, src, pos, mask, val_ell, ovf_idx,
                ovf_src, ovf_val, heavy_idx, heavy_cnt, yb, wb):
         w, b = params["w"], params["b"]
-        margin = jnp.sum(vals * _gather_weights(w, idx), axis=-1) + b
+        margin = _ell_margin(use_pallas, config.ell_precision, w,
+                             idx.shape[0], src, pos, mask,
+                             ovf_idx, ovf_src, heavy_idx, heavy_cnt,
+                             val_ell=val_ell, ovf_val=ovf_val) + b
         value, pull = jax.vjp(lambda m: loss_fn(m, yb, wb), margin)
         (r,) = pull(jnp.ones_like(value))
         r_ext = _extended_r(r)
 
         def apply_grad(w):
             return _apply_ell_categorical(
-                apply_ell, lr, w, r, r_ext, src, pos, mask, ovf_idx,
-                ovf_src, heavy_idx, heavy_cnt, val_ell=val_ell,
-                ovf_val=ovf_val)
+                use_pallas, config.ell_precision, lr, w, r, r_ext, src,
+                pos, mask, ovf_idx, ovf_src, heavy_idx, heavy_cnt,
+                val_ell=val_ell, ovf_val=ovf_val)
 
         return finish(w, b, value, r, apply_grad)
 

@@ -544,16 +544,21 @@ def ell_scatter_apply(w: jnp.ndarray, upd: jnp.ndarray, pos: jnp.ndarray,
     return out.reshape(-1)
 
 
-def _fused_kernel(block_rows: int, r_rows: int, precision):
-    """EXPERIMENTAL (r4, pending TPU measurement): compute the u-gather
-    ``u = -lr * r_ext[src]`` INSIDE the kernel via a one-hot MXU matmul
-    + lane-local pick, then run the csum/pick/diff scatter.  Rationale:
-    the XLA blocked gather is DMA-transaction-bound (~1.7-2.5 ns/slot =
-    ~2-2.5 ms/step at 1M slots — the r3 ablation's prime suspect), while
-    r_ext is tiny (fits VMEM): per 128-slot row the one-hot contraction
-    against the (r_rows, 128) view of r_ext costs ~33 kMAC/slot — ~0.35
-    ms/step of MXU work instead of the transaction stall."""
-    def kern(src_ref, p_ref, m_ref, r2d_ref, w_ref, out_ref):
+def _fused_kernel(block_rows: int, r_rows: int, precision,
+                  with_val: bool):
+    """Compute the u-gather ``u = -lr * r_ext[src]`` INSIDE the kernel
+    via a one-hot MXU matmul + lane-local pick, then run the csum/pick/
+    diff scatter.  Rationale: the XLA blocked gather is DMA-transaction-
+    bound (~1.7-2.5 ns/slot = ~2-2.5 ms/step at 1M slots — confirmed the
+    dominant step cost by the r4 ablation: dropping it moved the full
+    step 7.79 -> 2.17 ms), while r_ext is tiny (fits VMEM): per 128-slot
+    row the one-hot contraction against the (r_rows, 128) view of r_ext
+    costs ~33 kMAC/slot — MXU work instead of the transaction stall
+    (measured: full step 6.53 ms fused vs 8.92 XLA-oracle, r4 ablation).
+    ``with_val`` multiplies each slot by a per-slot value (the generic
+    sparse layout's explicit feature values)."""
+    def kern(src_ref, p_ref, m_ref, r2d_ref, w_ref, *rest):
+        (val_ref, out_ref) = rest if with_val else (None, rest[0])
         src = src_ref[:]                       # (block_rows, 128) i32
         r2d = r2d_ref[:]                       # (r_rows, 128) f32, holds
         hi = src // 128                        #   the PRE-SCALED -lr*r_ext
@@ -573,6 +578,8 @@ def _fused_kernel(block_rows: int, r_rows: int, precision):
             pick = jnp.where(lane == lo[r][:, None], g1, 0.0)
             cols.append(jnp.sum(pick, axis=1)[:, None])
         u = jnp.concatenate(cols, axis=1).T    # (block_rows, 128)
+        if with_val:
+            u = u * val_ref[:]
         out_ref[:] = _csum_pick_tail(u, p_ref[:], m_ref[:], w_ref[:],
                                      block_rows)
     return kern
@@ -582,15 +589,19 @@ def _fused_kernel(block_rows: int, r_rows: int, precision):
 def ell_scatter_apply_fused(w: jnp.ndarray, r_ext: jnp.ndarray,
                             src: jnp.ndarray, pos: jnp.ndarray,
                             mask: jnp.ndarray, *, lr,
+                            val: Optional[jnp.ndarray] = None,
                             precision: str = "default",
                             interpret: bool = False) -> jnp.ndarray:
-    """``w + scatter(-lr * r_ext[src])`` with the gather fused into the
-    Mosaic kernel (see :func:`_fused_kernel`).  ``r_ext`` length must be
-    a multiple of 128 (:func:`sgd._extended_r` pads to 256) and the
+    """``w + scatter(-lr * val * r_ext[src])`` with the gather fused into
+    the Mosaic kernel (see :func:`_fused_kernel`).  ``r_ext`` length must
+    be a multiple of 128 (:func:`sgd._extended_r` pads to 256) and the
     table must have a multiple of 8 rows (every ``supported()`` power-of
     -two size does).  ``lr`` is traced — it scales ``r_ext`` OUTSIDE the
     kernel, so learning-rate sweeps share one compiled executable.
     Small block (8 rows) keeps the per-block one-hot tile in VMEM.
+    ``val`` is an optional per-slot ``(rows, 128)`` multiplier (the
+    explicit feature values of the generic sparse layout); None means
+    the mixed layout's implicit 1.0.
 
     ``precision`` sets the one-hot contraction's MXU mode: ``"default"``
     (single bf16 pass — gathered values carry ~2^-8 relative truncation,
@@ -612,25 +623,25 @@ def ell_scatter_apply_fused(w: jnp.ndarray, r_ext: jnp.ndarray,
     br = 8
     r2d = ((-lr) * r_ext).reshape(r_rows, 128)
     w2 = w.reshape(rows, _LANES)
+    block = pl.BlockSpec((br, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    operands = [src, pos, mask, r2d, w2]
+    in_specs = [block, block, block,
+                pl.BlockSpec((r_rows, 128), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                block]
+    if val is not None:
+        operands.append(val)
+        in_specs.append(block)
     out = pl.pallas_call(
-        _fused_kernel(br, r_rows, precision), grid=(rows // br,),
-        in_specs=[
-            pl.BlockSpec((br, 128), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((br, 128), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((br, 128), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((r_rows, 128), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((br, 128), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        _fused_kernel(br, r_rows, precision, val is not None),
+        grid=(rows // br,),
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((br, 128), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
         interpret=interpret,
-    )(src, pos, mask, r2d, w2)
+    )(*operands)
     return out.reshape(-1)
 
 
@@ -646,3 +657,145 @@ def ell_scatter_apply_xla(w: jnp.ndarray, upd: jnp.ndarray,
     Gs = jnp.concatenate(
         [jnp.zeros((rows, 1), jnp.float32), G[:, :-1]], axis=1)
     return (w.reshape(rows, _LANES) + G - Gs).reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# Forward (margin) path over the SAME layout: the r4 TPU ablation showed
+# the ``w[cat]`` forward gather costs ~3.4 ms/step at bench shape — the
+# other transaction-bound half of the mixed step.  Every slot's table
+# position is already encoded in pos/mask (slots sorted by lane within a
+# row; ``pos[l]`` = last slot with lane <= l, mask = lane non-empty), so
+# the margin contribution of the in-grid slots is computable with zero
+# extra layout state: recover each slot's own lane as
+# ``lane(s) = #{l : pos_eff[l] < s}`` (pos_eff = pos restored to -1 on
+# masked lanes), pick ``w`` at that lane (a full-shape lane-local
+# take_along_axis — the Mosaic-supported gather form), and accumulate
+# per-sample sums with two one-hot MXU contractions into an extended
+# margin table (pad slots carry ``src == batch`` and land in the
+# discarded pad region, exactly like the backward path's r_ext pad).
+# ---------------------------------------------------------------------------
+
+def _slot_lanes_xla(pos: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Per-slot lane recovery, XLA form: vmapped searchsorted over rows.
+    ``pos_eff`` is nondecreasing per row, so ``#{l : pos_eff[l] < s}`` is
+    a left-insertion point.  Clamped to 127: pad slots (beyond every
+    boundary) pick an arbitrary real lane and are discarded via their
+    ``src == batch`` routing."""
+    pos_eff = pos + mask.astype(jnp.int32) - 1
+    s_iota = jnp.arange(ELL_WIDTH, dtype=jnp.int32)
+    lanes = jax.vmap(
+        lambda p: jnp.searchsorted(p, s_iota, side="left"))(pos_eff)
+    return jnp.minimum(lanes, ELL_WIDTH - 1).astype(jnp.int32)
+
+
+def ell_margin_xla(w: jnp.ndarray, src: jnp.ndarray, pos: jnp.ndarray,
+                   mask: jnp.ndarray, m_len: int,
+                   val: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """In-grid margin contributions, scattered to an ``(m_len,)`` extended
+    per-sample table (``m_len`` = the :func:`sgd._extended_r` length;
+    callers slice ``[:batch]``).  Pure-XLA twin of
+    :func:`ell_margin_fused` for CPU backends and as the oracle."""
+    lanes = _slot_lanes_xla(pos, mask)
+    g = jnp.take_along_axis(w.reshape(-1, _LANES), lanes, axis=1)
+    if val is not None:
+        g = g * val
+    return jnp.zeros((m_len,), jnp.float32).at[src.reshape(-1)].add(
+        g.reshape(-1), mode="drop")
+
+
+def _margin_kernel(block_rows: int, m_rows: int, precision,
+                   with_val: bool):
+    """Mosaic margin kernel: per block of ``block_rows`` table rows,
+    recover slot lanes from pos/mask (VPU compare + row-sum), pick the
+    block's weights at those lanes (full-shape lane-local gather), and
+    accumulate ``margin_ext[m, l] += sum_s [src==m*128+l] * g[s]`` via a
+    per-row one-hot MXU contraction into the grid-shared accumulator."""
+    from jax.experimental import pallas as pl
+
+    def kern(src_ref, p_ref, m_ref, w_ref, *rest):
+        (val_ref, out_ref) = rest if with_val else (None, rest[0])
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        src = src_ref[:]                        # (block_rows, 128) i32
+        p_eff = p_ref[:] + m_ref[:].astype(jnp.int32) - 1
+        s_iota = jax.lax.broadcasted_iota(
+            jnp.int32, (ELL_WIDTH, ELL_WIDTH), 1)   # [l, s] = s
+        lane_rows = []
+        for r in range(block_rows):
+            # lane(s) = #{l : p_eff[l] < s}; (1, 128) row, no transpose
+            cmp = (p_eff[r][:, None] < s_iota).astype(jnp.int32)
+            lane_rows.append(jnp.sum(cmp, axis=0, keepdims=True))
+        lanes = jnp.minimum(jnp.concatenate(lane_rows, axis=0),
+                            ELL_WIDTH - 1)
+        g = jnp.take_along_axis(w_ref[:], lanes, axis=1)
+        if with_val:
+            g = g * val_ref[:]
+        hi = src // 128
+        lo = src % 128
+        acc = jnp.zeros((m_rows, ELL_WIDTH), jnp.float32)
+        for r in range(block_rows):
+            # A[s, m] = [hi[s] == m] * g[s];  B[s, l] = [lo[s] == l]
+            a = jnp.where(
+                hi[r][:, None] == jax.lax.broadcasted_iota(
+                    jnp.int32, (ELL_WIDTH, m_rows), 1),
+                g[r][:, None], 0.0)
+            b = (lo[r][:, None] == jax.lax.broadcasted_iota(
+                jnp.int32, (ELL_WIDTH, ELL_WIDTH), 1)).astype(jnp.float32)
+            acc = acc + jax.lax.dot_general(
+                a, b, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, precision=precision)
+        out_ref[:] += acc
+    return kern
+
+
+@functools.partial(jax.jit, static_argnames=("m_len", "interpret",
+                                             "precision"))
+def ell_margin_fused(w: jnp.ndarray, src: jnp.ndarray, pos: jnp.ndarray,
+                     mask: jnp.ndarray, *, m_len: int,
+                     val: Optional[jnp.ndarray] = None,
+                     precision: str = "default",
+                     interpret: bool = False) -> jnp.ndarray:
+    """Forward twin of :func:`ell_scatter_apply_fused`: per-sample margin
+    contributions of the in-grid slots, on the MXU instead of the
+    transaction-bound ``w[cat]`` gather.  Returns a flat f32 table of
+    length >= ``m_len`` (rounded up to whole 8x128 tiles — callers slice
+    ``[:batch]``).  ``val`` is the per-slot explicit-value multiplier of
+    the generic sparse layout; ``precision`` as in
+    :func:`ell_scatter_apply_fused`."""
+    rows = src.shape[0]
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if rows % 8:
+        raise ValueError(
+            f"fused margin kernel needs rows % 8 == 0, got {rows}; use "
+            "ell_margin_xla")
+    if m_len % 128:
+        raise ValueError(
+            f"m_len must be a multiple of 128, got {m_len}; use the "
+            "sgd._extended_r length")
+    br = 8
+    m_rows = m_len // 128
+    m_rows += (-m_rows) % 8          # whole sublane tiles for the MXU
+    w2 = w.reshape(rows, _LANES)
+    block = pl.BlockSpec((br, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    operands = [src, pos, mask, w2]
+    in_specs = [block] * 4
+    if val is not None:
+        operands.append(val)
+        in_specs.append(block)
+    out = pl.pallas_call(
+        _margin_kernel(br, m_rows, precision, val is not None),
+        grid=(rows // br,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((m_rows, 128), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m_rows, ELL_WIDTH), jnp.float32),
+        interpret=interpret,
+    )(*operands)
+    return out.reshape(-1)

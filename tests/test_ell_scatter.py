@@ -539,3 +539,65 @@ def test_fused_gather_kernel_matches_twin_interpret():
     want = np.asarray(ell_scatter_apply_xla(
         jnp.asarray(w0), jnp.asarray(u), lay.pos[0], lay.mask[0]))
     np.testing.assert_allclose(got, want, atol=1e-5)
+
+def test_margin_kernel_matches_direct_gather_interpret():
+    """ell_margin_xla / ell_margin_fused (r4: forward half of the ELL
+    plan) must reproduce sum_j v_j * w[idx_j] exactly when the whole
+    batch fits the grid, for both the implicit-1.0 mixed layout and the
+    values-aware sparse layout; the pad region (slot/ovf pads carry
+    src == batch) is discarded by the [:batch] slice."""
+    from flink_ml_tpu.ops.ell_scatter import ell_margin_fused, ell_margin_xla
+
+    rng = np.random.default_rng(11)
+    d, batch, nnz, m_len = 128 * 128, 96, 7, 256
+    cat = rng.integers(0, d, size=(1, batch, nnz)).astype(np.int32)
+    w = rng.normal(size=d).astype(np.float32)
+    lay = ell_layout(cat, d, device=False)
+    want = w[cat[0]].sum(axis=1)
+    got = np.asarray(ell_margin_xla(
+        jnp.asarray(w), jnp.asarray(lay.src[0]), jnp.asarray(lay.pos[0]),
+        jnp.asarray(lay.mask[0]), m_len))
+    np.testing.assert_allclose(got[:batch], want, atol=1e-4)
+    got_f = np.asarray(ell_margin_fused(
+        jnp.asarray(w), jnp.asarray(lay.src[0]), jnp.asarray(lay.pos[0]),
+        jnp.asarray(lay.mask[0]), m_len=m_len, interpret=True))
+    np.testing.assert_allclose(got_f[:batch], want, atol=1e-4)
+
+    vals = rng.normal(size=(1, batch, nnz)).astype(np.float32)
+    layv = ell_layout(cat, d, values=vals, device=False)
+    wantv = (vals[0] * w[cat[0]]).sum(axis=1)
+    gotv = np.asarray(ell_margin_fused(
+        jnp.asarray(w), jnp.asarray(layv.src[0]), jnp.asarray(layv.pos[0]),
+        jnp.asarray(layv.mask[0]), m_len=m_len,
+        val=jnp.asarray(layv.val[0]), interpret=True))
+    np.testing.assert_allclose(gotv[:batch], wantv, atol=1e-4)
+
+
+def test_margin_decomposition_with_overflow_and_heavy():
+    """The three-way margin decomposition (grid + overflow + heavy) must
+    be exact when slots spill and a heavy index exists — the sgd helper's
+    algebra, driven directly: a skewed batch where one index repeats past
+    HEAVY_THRESHOLD and one row overflows its 128 slots."""
+    from flink_ml_tpu.ops.ell_scatter import ell_margin_xla
+
+    rng = np.random.default_rng(12)
+    d, batch, nnz = 128 * 128, 1024, 8
+    cat = rng.integers(0, d, size=(1, batch, nnz)).astype(np.int32)
+    cat[0, :, 0] = 777            # heavy: 1024 > HEAVY_THRESHOLD slots
+    cat[0, :200, 1] = 128 * 5 + np.arange(200) % 3  # row 5 overflows
+    w = rng.normal(size=d).astype(np.float32)
+    lay = ell_layout(cat, d, device=False)
+    assert int(np.asarray(lay.need_heavy).max()) >= 1
+    assert int(np.asarray(lay.need_ovf).max()) >= 1
+    m_len = 1024 + 256
+    mext = np.asarray(ell_margin_xla(
+        jnp.asarray(w), jnp.asarray(lay.src[0]), jnp.asarray(lay.pos[0]),
+        jnp.asarray(lay.mask[0]), m_len))
+    ovf = np.zeros(m_len, np.float32)
+    np.add.at(ovf, np.asarray(lay.ovf_src[0]),
+              w[np.asarray(lay.ovf_idx[0])])
+    margin = (mext + ovf)[:batch] + (
+        w[np.asarray(lay.heavy_idx[0])]
+        @ np.asarray(lay.heavy_cnt[0]).astype(np.float32))
+    want = w[cat[0]].sum(axis=1)
+    np.testing.assert_allclose(margin, want, rtol=1e-5, atol=1e-4)
