@@ -525,7 +525,7 @@ def _mixed_update_ell(loss_fn: LossFn, config: SGDConfig,
     lr = config.learning_rate
     finish = _finish_sparse_step(config)
 
-    def update(params, dense, cat, src, pos, mask, ovf_idx, ovf_src,
+    def update(params, dense, src, pos, mask, ovf_idx, ovf_src,
                heavy_idx, heavy_cnt, yb, wb):
         w, b = params["w"], params["b"]
         n_dense = dense.shape[-1]
@@ -581,6 +581,8 @@ def _mixed_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
     lr = config.learning_rate
     finish = _finish_sparse_step(config)
     d_spec = P("data")
+    layout_specs = ((P("data", None, None),) * 3 + (P("data", None),) * 3
+                    + (P("data", None, None),))
 
     def _local_delta(r_l, src, pos, mask, ovf_idx, ovf_src, heavy_idx,
                      heavy_cnt):
@@ -596,16 +598,32 @@ def _mixed_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
 
     ell_delta = _shard_map(
         _local_delta, mesh,
-        in_specs=(d_spec,) + (P("data", None, None),) * 3
-        + (P("data", None),) * 3 + (P("data", None, None),),
+        in_specs=(d_spec,) + layout_specs,
         out_specs=P())
 
-    def update(params, dense, cat, src, pos, mask, ovf_idx, ovf_src,
+    def _local_margin(w, src, pos, mask, ovf_idx, ovf_src, heavy_idx,
+                      heavy_cnt):
+        # per-device margins of the device's own batch shard: its layout
+        # slots cover exactly its samples (local src numbering), w is
+        # replicated — no collective needed, margins reassemble over
+        # 'data' (the local batch size is heavy_cnt's trailing dim)
+        return _ell_margin(
+            use_pallas, config.ell_precision, w, heavy_cnt.shape[-1],
+            src[0], pos[0], mask[0], ovf_idx[0], ovf_src[0],
+            heavy_idx[0], heavy_cnt[0])
+
+    ell_margin_sm = _shard_map(
+        _local_margin, mesh,
+        in_specs=(P(),) + layout_specs,
+        out_specs=d_spec)
+
+    def update(params, dense, src, pos, mask, ovf_idx, ovf_src,
                heavy_idx, heavy_cnt, yb, wb):
         w, b = params["w"], params["b"]
         n_dense = dense.shape[-1]
         margin = (dense @ w[:n_dense]
-                  + jnp.sum(_gather_weights(w, cat), axis=-1) + b)
+                  + ell_margin_sm(w, src, pos, mask, ovf_idx, ovf_src,
+                                  heavy_idx, heavy_cnt) + b)
         value, pull = jax.vjp(lambda m: loss_fn(m, yb, wb), margin)
         (r,) = pull(jnp.ones_like(value))
 
@@ -627,6 +645,8 @@ def _sparse_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
     layout's value arrays."""
     lr = config.learning_rate
     finish = _finish_sparse_step(config)
+    layout_specs = ((P("data", None, None),) * 4 + (P("data", None),) * 4
+                    + (P("data", None, None),))
 
     def _local_delta(r_l, src, pos, mask, val, ovf_idx, ovf_src, ovf_val,
                      heavy_idx, heavy_cnt):
@@ -640,14 +660,29 @@ def _sparse_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
 
     ell_delta = _shard_map(
         _local_delta, mesh,
-        in_specs=(P("data"),) + (P("data", None, None),) * 4
-        + (P("data", None),) * 4 + (P("data", None, None),),
+        in_specs=(P("data"),) + layout_specs,
         out_specs=P())
 
-    def update(params, idx, vals, src, pos, mask, val_ell, ovf_idx,
+    def _local_margin(w, src, pos, mask, val, ovf_idx, ovf_src, ovf_val,
+                      heavy_idx, heavy_cnt):
+        # same stance as _mixed_update_ell_sharded: local layout covers
+        # local samples, w replicated, margins reassemble over 'data'
+        return _ell_margin(
+            use_pallas, config.ell_precision, w, heavy_cnt.shape[-1],
+            src[0], pos[0], mask[0], ovf_idx[0], ovf_src[0],
+            heavy_idx[0], heavy_cnt[0], val_ell=val[0],
+            ovf_val=ovf_val[0])
+
+    ell_margin_sm = _shard_map(
+        _local_margin, mesh,
+        in_specs=(P(),) + layout_specs,
+        out_specs=P("data"))
+
+    def update(params, src, pos, mask, val_ell, ovf_idx,
                ovf_src, ovf_val, heavy_idx, heavy_cnt, yb, wb):
         w, b = params["w"], params["b"]
-        margin = jnp.sum(vals * _gather_weights(w, idx), axis=-1) + b
+        margin = ell_margin_sm(w, src, pos, mask, val_ell, ovf_idx,
+                               ovf_src, ovf_val, heavy_idx, heavy_cnt) + b
         value, pull = jax.vjp(lambda m: loss_fn(m, yb, wb), margin)
         (r,) = pull(jnp.ones_like(value))
 
@@ -724,8 +759,6 @@ def sgd_fit_sparse(loss_fn: LossFn, indices: np.ndarray, values: np.ndarray,
         extra = ()
         update = _sparse_update(loss_fn, config)
 
-    idx = _put_epoch_tensor(idx, mesh, P(None, "data", None))
-    vals = _put_epoch_tensor(vals, mesh, P(None, "data", None))
     y = _put_epoch_tensor(y, mesh, P(None, "data"))
     w = _put_epoch_tensor(w, mesh, P(None, "data"))
     if ell_sharded:
@@ -734,11 +767,19 @@ def sgd_fit_sparse(loss_fn: LossFn, indices: np.ndarray, values: np.ndarray,
                  + [P(None, "data", None, None)])
         extra = tuple(_put_epoch_tensor(a, mesh, s)
                       for a, s in zip(extra, specs))
-    else:
+    elif impl == "ell":
         extra = tuple(jax.device_put(a) for a in extra)  # single-device
+    if impl in ("ell",):
+        # margins and scatters both ride the layout: the raw
+        # (steps, batch, nnz) idx/vals epoch tensors stay host-side
+        epoch_args = extra + (y, w)
+    else:
+        idx = _put_epoch_tensor(idx, mesh, P(None, "data", None))
+        vals = _put_epoch_tensor(vals, mesh, P(None, "data", None))
+        epoch_args = (idx, vals) + extra + (y, w)
 
     params, loss_log = _run_minibatch_epochs(
-        update, (idx, vals) + extra + (y, w),
+        update, epoch_args,
         {"w": jnp.zeros((num_features,), jnp.float32),
          "b": jnp.zeros((), jnp.float32)}, steps, config, mesh)
     return LinearState(np.asarray(params["w"], np.float64),
@@ -801,11 +842,11 @@ def _sparse_update_ell(loss_fn: LossFn, config: SGDConfig,
     lr = config.learning_rate
     finish = _finish_sparse_step(config)
 
-    def update(params, idx, vals, src, pos, mask, val_ell, ovf_idx,
+    def update(params, src, pos, mask, val_ell, ovf_idx,
                ovf_src, ovf_val, heavy_idx, heavy_cnt, yb, wb):
         w, b = params["w"], params["b"]
         margin = _ell_margin(use_pallas, config.ell_precision, w,
-                             idx.shape[0], src, pos, mask,
+                             yb.shape[0], src, pos, mask,
                              ovf_idx, ovf_src, heavy_idx, heavy_cnt,
                              val_ell=val_ell, ovf_val=ovf_val) + b
         value, pull = jax.vjp(lambda m: loss_fn(m, yb, wb), margin)
@@ -1006,7 +1047,6 @@ def sgd_fit_mixed(loss_fn: LossFn, dense_features: np.ndarray,
         update = _mixed_update(loss_fn, config)
 
     dense = _put_epoch_tensor(dense, mesh, P(None, "data", None))
-    cat = _put_epoch_tensor(cat, mesh, P(None, "data", None))
     y = _put_epoch_tensor(y, mesh, P(None, "data"))
     w = _put_epoch_tensor(w, mesh, P(None, "data"))
     if ell_sharded:
@@ -1015,11 +1055,19 @@ def sgd_fit_mixed(loss_fn: LossFn, dense_features: np.ndarray,
                  + [P(None, "data", None, None)])
         extra = tuple(_put_epoch_tensor(a, mesh, s)
                       for a, s in zip(extra, specs))
-    else:
+    elif impl == "ell":
         extra = tuple(jax.device_put(a) for a in extra)  # single-device
+    if impl in ("ell",):
+        # the ELL updates never read the raw index tensor — margins and
+        # scatters both ride the layout — so the (steps, batch, nnz)
+        # epoch tensor stays host-side (~steps*batch*nnz*4 B of HBM)
+        epoch_args = (dense,) + extra + (y, w)
+    else:
+        cat = _put_epoch_tensor(cat, mesh, P(None, "data", None))
+        epoch_args = (dense, cat) + extra + (y, w)
 
     params, loss_log = _run_minibatch_epochs(
-        update, (dense, cat) + extra + (y, w), init_params, steps, config,
+        update, epoch_args, init_params, steps, config,
         mesh, place_params=place_params)
     return LinearState(np.asarray(params["w"], np.float64),
                        float(params["b"]), planned_impl=impl), loss_log
@@ -1160,12 +1208,13 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         # layout stacks carry a leading device dim sharded over 'data'
         g3 = NamedSharding(mesh, P("data", None, None))
         g2 = NamedSharding(mesh, P("data", None))
-        sharding = (x_sh, x_sh, g3, g3, g3, g2, g2, g2, g3, v_sh, v_sh)
+        sharding = (x_sh, g3, g3, g3, g2, g2, g2, g3, v_sh, v_sh)
     elif stream_ell:
         r_sh = NamedSharding(mesh, P())  # layout grids: single device
-        # (dense, cat, src, pos, mask, ovf_idx, ovf_src, heavy_idx,
-        #  heavy_cnt, y, w)
-        sharding = (x_sh, x_sh, r_sh, r_sh, r_sh, r_sh, r_sh, r_sh, r_sh,
+        # (dense, src, pos, mask, ovf_idx, ovf_src, heavy_idx,
+        #  heavy_cnt, y, w) — the raw cat tensor never ships: margins
+        # and scatters both ride the layout (r4)
+        sharding = (x_sh, r_sh, r_sh, r_sh, r_sh, r_sh, r_sh, r_sh,
                     v_sh, v_sh)
     else:
         sharding = ((x_sh, x_sh, v_sh, v_sh) if (sparse or mixed)
@@ -1230,8 +1279,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
             n_valid = y.shape[0]
             if n_valid < batch_rows[0]:
                 # padding rows' indices become sentinels the layout
-                # drops (zero-pads would fabricate a heavy index 0;
-                # their margin gathers clamp and carry weight 0)
+                # drops (zero-pads would fabricate a heavy index 0);
+                # their margins are dense-part-only and carry weight 0
                 cat_p = cat_p.copy()
                 cat_p[n_valid:] = num_features
             if stream_sharded:
@@ -1245,7 +1294,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                     cat_p.reshape(n_local_dev, local, cat_p.shape[-1]),
                     num_features, pad_ovf_cap=cap,
                     pad_heavy_cap=ell_heavy_cap, device=False)
-                return (dense_p, cat_p,
+                return (dense_p,
                         lay.src, lay.pos, lay.mask, lay.ovf_idx,
                         lay.ovf_src, lay.heavy_idx,
                         lay.heavy_cnt) + padded[2:]
@@ -1254,7 +1303,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
             lay = ell_layout(cat_p[None], num_features,
                              pad_ovf_cap=cap,
                              pad_heavy_cap=ell_heavy_cap, device=False)
-            return (dense_p, cat_p,
+            return (dense_p,
                     lay.src[0], lay.pos[0], lay.mask[0], lay.ovf_idx[0],
                     lay.ovf_src[0], lay.heavy_idx[0],
                     lay.heavy_cnt[0]) + padded[2:]

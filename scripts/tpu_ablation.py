@@ -86,7 +86,8 @@ def fit_cost(loop_maker, args, reps=(2, 10)):
     return (ts[1] - ts[0]) / ((reps[1] - reps[0]) * STEPS)
 
 
-def make_loop(update):
+def make_loop(update, with_cat=True):
+    # with_cat=False: the r4 planned ELL update reads no raw cat tensor
     def maker(n_epochs):
         @jax.jit
         def run(params, dense, cat, y, *ex):
@@ -95,7 +96,8 @@ def make_loop(update):
             def epoch(params, _):
                 def step(params, i):
                     e = tuple(a[i] for a in ex)
-                    return update(params, dense[i], cat[i], *e, y[i],
+                    lead = (dense[i], cat[i]) if with_cat else (dense[i],)
+                    return update(params, *lead, *e, y[i],
                                   ones[i])
                 p, losses = jax.lax.scan(step, params, jnp.arange(STEPS))
                 return p, jnp.mean(losses)
@@ -107,7 +109,8 @@ def make_loop(update):
 args_base = (fresh(), dense, cat, y)
 t = fit_cost(make_loop(_mixed_update(logistic_loss, cfg)), args_base)
 print(f"oracle (XLA blocked)        {t*1e3:7.2f} ms/step", flush=True)
-t_ell = fit_cost(make_loop(_mixed_update_ell(logistic_loss, cfg)),
+t_ell = fit_cost(make_loop(_mixed_update_ell(logistic_loss, cfg),
+                           with_cat=False),
                  args_base + extra)
 print(f"ELL planned path            {t_ell*1e3:7.2f} ms/step  "
       f"-> {1.0/(t_ell*32):5.2f} epochs/s @32steps", flush=True)

@@ -190,7 +190,7 @@ class TestMixedUpdateEll:
                                      jnp.asarray(wb))
             ell = _mixed_update_ell(logistic_loss, cfg, use_pallas=False)
             got, got_loss = ell(params, jnp.asarray(dense),
-                                jnp.asarray(cat[0]), layout.src[0],
+                                layout.src[0],
                                 layout.pos[0], layout.mask[0],
                                 layout.ovf_idx[0], layout.ovf_src[0],
                                 layout.heavy_idx[0], layout.heavy_cnt[0],
@@ -268,7 +268,7 @@ class TestSparseUpdateEll:
                 jnp.asarray(y), jnp.asarray(wb))
             got, got_loss = _sparse_update_ell(
                 logistic_loss, cfg, use_pallas=False)(
-                params, jnp.asarray(idx[0]), jnp.asarray(vals[0]),
+                params,
                 layout.src[0], layout.pos[0], layout.mask[0],
                 layout.val[0], layout.ovf_idx[0], layout.ovf_src[0],
                 layout.ovf_val[0], layout.heavy_idx[0],
@@ -299,7 +299,7 @@ class TestSparseUpdateEll:
             params, jnp.asarray(idx[0]), jnp.asarray(vals[0]),
             jnp.asarray(y), jnp.asarray(wb))
         got, _ = _sparse_update_ell(logistic_loss, cfg, use_pallas=False)(
-            params, jnp.asarray(idx[0]), jnp.asarray(vals[0]),
+            params,
             layout.src[0], layout.pos[0], layout.mask[0], layout.val[0],
             layout.ovf_idx[0], layout.ovf_src[0], layout.ovf_val[0],
             layout.heavy_idx[0], layout.heavy_cnt[0],
@@ -341,7 +341,7 @@ class TestSparseUpdateEll:
         for L in (host, dev):
             params = {"w": jnp.zeros(d, jnp.float32),
                       "b": jnp.zeros((), jnp.float32)}
-            got, _ = upd(params, jnp.asarray(idx[0]), jnp.asarray(vals[0]),
+            got, _ = upd(params,
                          L.src[0], L.pos[0], L.mask[0], L.val[0],
                          L.ovf_idx[0], L.ovf_src[0], L.ovf_val[0],
                          L.heavy_idx[0], L.heavy_cnt[0],

@@ -55,7 +55,7 @@ def test_ell_full_step_matches_xla_update(tpu, rng):
               "b": jnp.zeros((), jnp.float32)}
 
     p_ell, v_ell = jax.jit(_mixed_update_ell(LOSSES["logistic"], cfg))(
-        params, dense, cat[0], lay.src[0], lay.pos[0], lay.mask[0],
+        params, dense, lay.src[0], lay.pos[0], lay.mask[0],
         lay.ovf_idx[0], lay.ovf_src[0], lay.heavy_idx[0], lay.heavy_cnt[0],
         y, wb)
     p_xla, v_xla = jax.jit(_mixed_update(LOSSES["logistic"], cfg))(
