@@ -225,8 +225,8 @@ def bench_logreg(results: dict) -> None:
         # feature routed to the heavy path, spill is the Poisson tail;
         # assert_capacities turns an undersized cap into a named error
         # instead of a parity-assert failure downstream
-        lay = ell_layout_device(cat, LR_DIM,
-                                ovf_cap=1 << 13).assert_capacities()
+        lay = ell_layout_device(
+            cat, LR_DIM, ovf_cap=1 << 13).assert_capacities().trim_overflow()
         return (lay.src, lay.pos, lay.mask, lay.ovf_idx, lay.ovf_src,
                 lay.heavy_idx, lay.heavy_cnt)
 
@@ -284,9 +284,9 @@ def bench_logreg(results: dict) -> None:
 
             # heavy_cap: the pair encoding makes EVERY dense slot index
             # (0..12, batch occurrences each) heavy, plus label markers
-            lay = ell_layout_device(idx0, LR_DIM, ovf_cap=1 << 13,
-                                    heavy_cap=24,
-                                    values=vals0).assert_capacities()
+            lay = ell_layout_device(
+                idx0, LR_DIM, ovf_cap=1 << 13, heavy_cap=24,
+                values=vals0).assert_capacities().trim_overflow()
             sparse_args_ell = sparse_args + (
                 lay.src, lay.pos, lay.mask, lay.val, lay.ovf_idx,
                 lay.ovf_src, lay.ovf_val, lay.heavy_idx, lay.heavy_cnt)

@@ -45,7 +45,7 @@ scale the Criteo config (BASELINE.md) demands.
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Optional, Tuple
 
 import jax
@@ -133,6 +133,26 @@ class EllLayout:
                     f"ELL heavy path needs {worst_h} indices in some step "
                     f"> heavy_cap {hcap}; raise heavy_cap")
         return self
+
+    def trim_overflow(self, margin: int = 2) -> "EllLayout":
+        """Slice the overflow arrays down to the measured need (x
+        ``margin``, rounded to 8).  The XLA overflow scatter's cost
+        scales with the STATIC cap, not the real spill count — a
+        generous 2^13 cap measured ~1.8 ms/step against a need of 180
+        (r4 TPU_STEP_BREAKDOWN) — and every builder front-compacts the
+        real entries, so slicing is exact.  No-op when the cap is
+        already tight or the need is unknown."""
+        if self.need_ovf is None:
+            return self
+        cap = max(8, int(np.asarray(self.need_ovf).max()) * margin)
+        cap += (-cap) % 8
+        if cap >= self.ovf_idx.shape[1]:
+            return self
+        return replace(
+            self, ovf_idx=self.ovf_idx[:, :cap],
+            ovf_src=self.ovf_src[:, :cap],
+            ovf_val=None if self.ovf_val is None
+            else self.ovf_val[:, :cap])
 
 
 HEAVY_THRESHOLD = 512   # slots per index per step before the dense path
@@ -557,27 +577,30 @@ def _fused_kernel(block_rows: int, r_rows: int, precision,
     (measured: full step 6.53 ms fused vs 8.92 XLA-oracle, r4 ablation).
     ``with_val`` multiplies each slot by a per-slot value (the generic
     sparse layout's explicit feature values)."""
-    def kern(src_ref, p_ref, m_ref, r2d_ref, w_ref, *rest):
+    def kern(src_ref, p_ref, m_ref, r2dt_ref, w_ref, *rest):
         (val_ref, out_ref) = rest if with_val else (None, rest[0])
         src = src_ref[:]                       # (block_rows, 128) i32
-        r2d = r2d_ref[:]                       # (r_rows, 128) f32, holds
-        hi = src // 128                        #   the PRE-SCALED -lr*r_ext
-        lo = src % 128
-        lane = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1)
-        cols = []
+        r2dt = r2dt_ref[:]                     # (128, r_rows) f32: the
+        hi = src // 128                        #   PRE-SCALED -lr*r_ext,
+        lo = src % 128                         #   lane-major
+        # everything below is built in its CONSUMED orientation — no
+        # transposes or (128, 1) concats anywhere (per-iteration Mosaic
+        # relayouts measured ~10x the contraction's MXU floor, r4
+        # TPU_STEP_BREAKDOWN)
+        lane0 = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0)
+        rows_out = []
         for r in range(block_rows):
-            # OH[s, j] = [hi[r, s] == j] over the r_ext rows
-            oh = (hi[r][:, None]
-                  == jax.lax.broadcasted_iota(jnp.int32, (128, r_rows), 1)
-                  ).astype(jnp.float32)
-            # G1[s, l] = r2d[hi[r, s], l]
-            g1 = jnp.dot(oh, r2d, preferred_element_type=jnp.float32,
-                         precision=precision)
-            # pick each slot's lane via masked row-sum (Mosaic's gather
-            # lowering rejects (128, 1)-index take_along_axis)
-            pick = jnp.where(lane == lo[r][:, None], g1, 0.0)
-            cols.append(jnp.sum(pick, axis=1)[:, None])
-        u = jnp.concatenate(cols, axis=1).T    # (block_rows, 128)
+            # OHT[j, s] = [hi[r, s] == j] over the r_ext rows
+            oht = (jax.lax.broadcasted_iota(jnp.int32, (r_rows, 128), 0)
+                   == hi[r][None, :]).astype(jnp.float32)
+            # G1T[l, s] = r_ext2d[l, hi[r, s]]
+            g1t = jnp.dot(r2dt, oht, preferred_element_type=jnp.float32,
+                          precision=precision)
+            # pick each slot's lane via masked column-sum (Mosaic's
+            # gather lowering rejects (128, 1)-index take_along_axis)
+            pick = jnp.where(lane0 == lo[r][None, :], g1t, 0.0)
+            rows_out.append(jnp.sum(pick, axis=0, keepdims=True))
+        u = jnp.concatenate(rows_out, axis=0)  # (block_rows, 128)
         if with_val:
             u = u * val_ref[:]
         out_ref[:] = _csum_pick_tail(u, p_ref[:], m_ref[:], w_ref[:],
@@ -621,13 +644,15 @@ def ell_scatter_apply_fused(w: jnp.ndarray, r_ext: jnp.ndarray,
             f"fused kernel needs rows % 8 == 0, got {rows}; use "
             "ell_scatter_apply")
     br = 8
-    r2d = ((-lr) * r_ext).reshape(r_rows, 128)
+    # lane-major view of the scaled residuals, transposed ONCE here so
+    # the kernel's per-row contraction consumes it without relayout
+    r2dt = ((-lr) * r_ext).reshape(r_rows, 128).T
     w2 = w.reshape(rows, _LANES)
     block = pl.BlockSpec((br, 128), lambda i: (i, 0),
                          memory_space=pltpu.VMEM)
-    operands = [src, pos, mask, r2d, w2]
+    operands = [src, pos, mask, r2dt, w2]
     in_specs = [block, block, block,
-                pl.BlockSpec((r_rows, 128), lambda i: (0, 0),
+                pl.BlockSpec((128, r_rows), lambda i: (0, 0),
                              memory_space=pltpu.VMEM),
                 block]
     if val is not None:
@@ -738,16 +763,19 @@ def _margin_kernel(block_rows: int, m_rows: int, precision,
         lo = src % 128
         acc = jnp.zeros((m_rows, ELL_WIDTH), jnp.float32)
         for r in range(block_rows):
-            # A[s, m] = [hi[s] == m] * g[s];  B[s, l] = [lo[s] == l]
-            a = jnp.where(
-                hi[r][:, None] == jax.lax.broadcasted_iota(
-                    jnp.int32, (ELL_WIDTH, m_rows), 1),
-                g[r][:, None], 0.0)
+            # AT[m, s] = [hi[s] == m] * g[s];  B[s, l] = [lo[s] == l] —
+            # both built in the dot's consumed orientation (a dim-0
+            # dot_general contraction forces a per-iteration Mosaic
+            # relayout, measured ~10x the MXU floor, r4 breakdown)
+            at = jnp.where(
+                jax.lax.broadcasted_iota(
+                    jnp.int32, (m_rows, ELL_WIDTH), 0) == hi[r][None, :],
+                g[r][None, :], 0.0)
             b = (lo[r][:, None] == jax.lax.broadcasted_iota(
                 jnp.int32, (ELL_WIDTH, ELL_WIDTH), 1)).astype(jnp.float32)
-            acc = acc + jax.lax.dot_general(
-                a, b, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32, precision=precision)
+            acc = acc + jnp.dot(at, b,
+                                preferred_element_type=jnp.float32,
+                                precision=precision)
         out_ref[:] += acc
     return kern
 

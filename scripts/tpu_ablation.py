@@ -55,7 +55,7 @@ def gen(key):
 
 dense, cat, y = gen(jax.random.PRNGKey(0))
 t0 = time.perf_counter()
-lay = ell_layout_device(cat, D, ovf_cap=1 << 13).assert_capacities()
+lay = ell_layout_device(cat, D, ovf_cap=1 << 13).assert_capacities().trim_overflow()
 np.asarray(lay.ovf_idx[0, :1])
 print(f"layout build {time.perf_counter()-t0:.1f}s  "
       f"need_ovf={int(np.asarray(lay.need_ovf).max())} "

@@ -49,7 +49,7 @@ def gen(key):
 
 
 dense, cat, y = gen(jax.random.PRNGKey(0))
-lay = ell_layout_device(cat, D, ovf_cap=1 << 13).assert_capacities()
+lay = ell_layout_device(cat, D, ovf_cap=1 << 13).assert_capacities().trim_overflow()
 np.asarray(lay.ovf_idx[0, :1])
 extra = (lay.src, lay.pos, lay.mask, lay.ovf_idx, lay.ovf_src,
          lay.heavy_idx, lay.heavy_cnt)

@@ -1,0 +1,179 @@
+"""Attribute the CURRENT (r4 two-kernel) mixed-ELL step cost on real TPU.
+
+One run, shared chip conditions: in-situ drop-one legs of the planned
+step inside the same fused epoch loop the bench times, plus standalone
+per-call timings of each Mosaic kernel at bench shape.  Two-point fits
+over epoch counts cancel fixed dispatch.
+
+Run: timeout 1800 python -u scripts/tpu_step_breakdown.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_ml_tpu.models.common.losses import logistic_loss
+from flink_ml_tpu.models.common.sgd import (
+    SGDConfig,
+    _ext_len,
+    _extended_r,
+    _mixed_update_ell,
+)
+from flink_ml_tpu.ops.ell_scatter import (
+    ell_layout_device,
+    ell_margin_fused,
+    ell_scatter_apply_fused,
+)
+
+D = 1 << 20
+BATCH = 1 << 15
+NNZ = 26
+STEPS = 8
+LR = 0.5
+cfg = SGDConfig(learning_rate=LR, tol=0)
+print("backend:", jax.default_backend(), flush=True)
+
+
+@jax.jit
+def gen(key):
+    kc, kd, ky = jax.random.split(key, 3)
+    y = jax.random.bernoulli(ky, 0.5, (STEPS, BATCH)).astype(jnp.float32)
+    cat = jax.random.randint(kc, (STEPS, BATCH, NNZ), 32, D, jnp.int32)
+    cat = cat.at[:, :, 0].set(jnp.where(y == 1, 16, 17))
+    dense = jax.random.normal(kd, (STEPS, BATCH, 13), jnp.float32)
+    return dense, cat, y
+
+
+dense, cat, y = gen(jax.random.PRNGKey(0))
+lay = ell_layout_device(cat, D, ovf_cap=1 << 13).assert_capacities().trim_overflow()
+np.asarray(lay.ovf_idx[0, :1])
+extra = (lay.src, lay.pos, lay.mask, lay.ovf_idx, lay.ovf_src,
+         lay.heavy_idx, lay.heavy_cnt)
+M_LEN = _ext_len(BATCH)
+
+
+def fresh():
+    return {"w": jnp.zeros((D,), jnp.float32),
+            "b": jnp.zeros((), jnp.float32)}
+
+
+def make_loop(update):
+    def maker(n_epochs):
+        @jax.jit
+        def run(params, dense, y, *ex):
+            ones = jnp.ones(y.shape, jnp.float32)
+
+            def epoch(params, _):
+                def step(params, i):
+                    e = tuple(a[i] for a in ex)
+                    return update(params, dense[i], *e, y[i], ones[i])
+                p, losses = jax.lax.scan(step, params, jnp.arange(STEPS))
+                return p, jnp.mean(losses)
+            return jax.lax.scan(epoch, params, None, length=n_epochs)
+        return run
+    return maker
+
+
+def fit_cost(loop_maker, args, reps=(2, 10)):
+    ts = []
+    for n in reps:
+        run = loop_maker(n)
+        out = run(*args)
+        np.asarray(out[0]["w"]).ravel()[:1]
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = run(*args)
+            np.asarray(out[0]["w"]).ravel()[:1]
+            best = min(best, time.perf_counter() - t0)
+        ts.append(best)
+    return (ts[1] - ts[0]) / ((reps[1] - reps[0]) * STEPS)
+
+
+args = (fresh(), dense, y) + extra
+
+t_full = fit_cost(make_loop(_mixed_update_ell(logistic_loss, cfg)), args)
+print(f"{'planned step (full)':26s} {t_full*1e3:7.2f} ms/step", flush=True)
+
+
+def make_ablated(margin_k=True, margin_oh=True, scatter_k=True, ovf=True,
+                 heavy=True, dense_on=True):
+    def update(params, dense_b, src, pos, mask, oi, osrc, hi, hc, yb, wb):
+        w, b = params["w"], params["b"]
+        nd = dense_b.shape[-1]
+        margin = (dense_b @ w[:nd] + b) if dense_on else jnp.broadcast_to(
+            b, (BATCH,))
+        if margin_k:
+            mext = ell_margin_fused(w, src, pos, mask, m_len=M_LEN)
+            if margin_oh:
+                mext = mext.at[osrc].add(w[oi], mode="drop")
+                margin = margin + mext[:BATCH] + w[hi] @ hc.astype(
+                    jnp.float32)
+            else:
+                margin = margin + mext[:BATCH]
+        value, pull = jax.vjp(lambda m: logistic_loss(m, yb, wb), margin)
+        (r,) = pull(jnp.ones_like(value))
+        r_ext = _extended_r(r)
+        if scatter_k:
+            w = ell_scatter_apply_fused(w, r_ext, src, pos, mask, lr=LR)
+        else:
+            w = w + jnp.sum(r_ext) * 1e-20
+        if ovf:
+            w = w.at[oi].add((-LR) * r_ext[osrc])
+        if heavy:
+            w = w.at[hi].add((-LR) * (hc.astype(jnp.float32) @ r))
+        if dense_on:
+            w = w.at[:nd].add(-LR * (r @ dense_b))
+            b = b - LR * jnp.sum(r)
+        return {"w": w, "b": b}, value
+    return update
+
+
+for name, off in [
+    ("inline full", {}),
+    ("- margin kernel", {"margin_k": False, "margin_oh": False}),
+    ("- margin ovf+heavy", {"margin_oh": False}),
+    ("- scatter kernel", {"scatter_k": False}),
+    ("- grad ovf", {"ovf": False}),
+    ("- grad heavy", {"heavy": False}),
+    ("- dense+bias", {"dense_on": False}),
+    ("kernels only", {"margin_oh": False, "ovf": False, "heavy": False,
+                      "dense_on": False}),
+    ("loss only", {"margin_k": False, "margin_oh": False,
+                   "scatter_k": False, "ovf": False, "heavy": False,
+                   "dense_on": False}),
+]:
+    t = fit_cost(make_loop(make_ablated(**off)), args)
+    print(f"{name:26s} {t*1e3:7.2f} ms/step", flush=True)
+
+
+# ---- standalone kernel timings (outside the scan) -------------------------
+w0 = jnp.zeros((D,), jnp.float32)
+r_ext0 = _extended_r(jnp.ones((BATCH,), jnp.float32) * 1e-5)
+src0, pos0, mask0 = lay.src[0], lay.pos[0], lay.mask[0]
+
+
+def time_op(fn, *a):
+    out = fn(*a)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*a))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+t = time_op(lambda: ell_margin_fused(w0, src0, pos0, mask0, m_len=M_LEN))
+print(f"{'margin kernel alone':26s} {t*1e3:7.2f} ms/call "
+      "(incl dispatch)", flush=True)
+t = time_op(lambda: ell_scatter_apply_fused(w0, r_ext0, src0, pos0, mask0,
+                                            lr=LR))
+print(f"{'scatter kernel alone':26s} {t*1e3:7.2f} ms/call "
+      "(incl dispatch)", flush=True)

@@ -601,3 +601,47 @@ def test_margin_decomposition_with_overflow_and_heavy():
         @ np.asarray(lay.heavy_cnt[0]).astype(np.float32))
     want = w[cat[0]].sum(axis=1)
     np.testing.assert_allclose(margin, want, rtol=1e-5, atol=1e-4)
+
+
+def test_trim_overflow_preserves_update_exactly():
+    """trim_overflow slices the overflow arrays to measured need; its
+    exactness rests on every builder front-compacting real entries, so
+    assert the trimmed layout yields the IDENTICAL update as the full
+    one (any dropped real slot would move the overflow scatter), for
+    both the host and device builders."""
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, _mixed_update_ell
+    from flink_ml_tpu.ops.ell_scatter import ell_layout_device
+
+    rng = np.random.default_rng(17)
+    d, batch, nnz = 128 * 128, 1024, 8
+    cat = rng.integers(0, d, size=(1, batch, nnz)).astype(np.int32)
+    cat[0, :300, 1] = 128 * 7 + np.arange(300) % 5   # row 7 spills
+    y = rng.integers(0, 2, size=batch).astype(np.float32)
+    wb = np.ones(batch, np.float32)
+    dense = rng.normal(size=(batch, 3)).astype(np.float32)
+    upd = _mixed_update_ell(logistic_loss,
+                            SGDConfig(learning_rate=0.4, tol=0),
+                            use_pallas=False)
+    for builder in ("host", "device"):
+        lay = (ell_layout(cat, d, pad_ovf_cap=2048)
+               if builder == "host"
+               else ell_layout_device(jnp.asarray(cat), d, ovf_cap=2048))
+        trimmed = lay.assert_capacities().trim_overflow()
+        need = int(np.asarray(lay.need_ovf).max())
+        assert need > 0, "test data must actually spill"
+        assert trimmed.ovf_idx.shape[1] < lay.ovf_idx.shape[1]
+        assert trimmed.ovf_idx.shape[1] >= need
+        outs = []
+        for L in (lay, trimmed):
+            params = {"w": jnp.zeros((d,), jnp.float32),
+                      "b": jnp.zeros((), jnp.float32)}
+            got, _ = upd(params, jnp.asarray(dense),
+                         jnp.asarray(L.src[0]), jnp.asarray(L.pos[0]),
+                         jnp.asarray(L.mask[0]), jnp.asarray(L.ovf_idx[0]),
+                         jnp.asarray(L.ovf_src[0]),
+                         jnp.asarray(L.heavy_idx[0]),
+                         jnp.asarray(L.heavy_cnt[0]),
+                         jnp.asarray(y), jnp.asarray(wb))
+            outs.append(np.asarray(got["w"]))
+        np.testing.assert_array_equal(outs[0], outs[1], err_msg=builder)
