@@ -56,6 +56,11 @@ __all__ = ["EllLayout", "ell_layout", "ell_layout_device",
            "ell_scatter_apply", "supported", "ELL_WIDTH"]
 
 ELL_WIDTH = 128          # slots per table row = one lane tile
+#: Table rows per Mosaic grid step in the fused kernels.  8 measured
+#: best in the r4 block sweep; the per-row one-hot transients are
+#: block-size-independent, so this only trades grid overhead against
+#: scheduling granularity.
+_FUSED_BLOCK_ROWS = 8
 _LANES = 128             # table view (d // 128, 128)
 
 
@@ -639,11 +644,11 @@ def ell_scatter_apply_fused(w: jnp.ndarray, r_ext: jnp.ndarray,
             f"fused kernel needs len(r_ext) % 128 == 0, got "
             f"{r_ext.shape[0]}; pad with sgd._extended_r")
     r_rows = r_ext.shape[0] // 128
-    if rows % 8:
+    br = _FUSED_BLOCK_ROWS
+    if rows % br:
         raise ValueError(
-            f"fused kernel needs rows % 8 == 0, got {rows}; use "
+            f"fused kernel needs rows % {br} == 0, got {rows}; use "
             "ell_scatter_apply")
-    br = 8
     # lane-major view of the scaled residuals, transposed ONCE here so
     # the kernel's per-row contraction consumes it without relayout
     r2dt = ((-lr) * r_ext).reshape(r_rows, 128).T
@@ -798,15 +803,15 @@ def ell_margin_fused(w: jnp.ndarray, src: jnp.ndarray, pos: jnp.ndarray,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    if rows % 8:
+    br = _FUSED_BLOCK_ROWS
+    if rows % br:
         raise ValueError(
-            f"fused margin kernel needs rows % 8 == 0, got {rows}; use "
-            "ell_margin_xla")
+            f"fused margin kernel needs rows % {br} == 0, got {rows}; "
+            "use ell_margin_xla")
     if m_len % 128:
         raise ValueError(
             f"m_len must be a multiple of 128, got {m_len}; use the "
             "sgd._extended_r length")
-    br = 8
     m_rows = m_len // 128
     m_rows += (-m_rows) % 8          # whole sublane tiles for the MXU
     w2 = w.reshape(rows, _LANES)

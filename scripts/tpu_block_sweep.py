@@ -1,0 +1,97 @@
+"""Sweep the fused kernels' block size (grid rows per Mosaic step) on
+real TPU: fewer grid steps amortize per-block overhead; VMEM transients
+((r_rows, 128) one-hot per row-iteration) are block-size-independent.
+
+Run: timeout 1800 python -u scripts/tpu_block_sweep.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import flink_ml_tpu.ops.ell_scatter as E
+from flink_ml_tpu.models.common.losses import logistic_loss
+from flink_ml_tpu.models.common.sgd import SGDConfig, _mixed_update_ell
+
+D = 1 << 20
+BATCH = 1 << 15
+NNZ = 26
+STEPS = 8
+LR = 0.5
+cfg = SGDConfig(learning_rate=LR, tol=0)
+print("backend:", jax.default_backend(), flush=True)
+
+
+@jax.jit
+def gen(key):
+    kc, kd, ky = jax.random.split(key, 3)
+    y = jax.random.bernoulli(ky, 0.5, (STEPS, BATCH)).astype(jnp.float32)
+    cat = jax.random.randint(kc, (STEPS, BATCH, NNZ), 32, D, jnp.int32)
+    cat = cat.at[:, :, 0].set(jnp.where(y == 1, 16, 17))
+    dense = jax.random.normal(kd, (STEPS, BATCH, 13), jnp.float32)
+    return dense, cat, y
+
+
+dense, cat, y = gen(jax.random.PRNGKey(0))
+lay = E.ell_layout_device(cat, D, ovf_cap=1 << 13) \
+    .assert_capacities().trim_overflow()
+np.asarray(lay.ovf_idx[0, :1])
+extra = (lay.src, lay.pos, lay.mask, lay.ovf_idx, lay.ovf_src,
+         lay.heavy_idx, lay.heavy_cnt)
+
+
+def fresh():
+    return {"w": jnp.zeros((D,), jnp.float32),
+            "b": jnp.zeros((), jnp.float32)}
+
+
+def make_loop(update):
+    def maker(n_epochs):
+        @jax.jit
+        def run(params, dense, y, *ex):
+            ones = jnp.ones(y.shape, jnp.float32)
+
+            def epoch(params, _):
+                def step(params, i):
+                    e = tuple(a[i] for a in ex)
+                    return update(params, dense[i], *e, y[i], ones[i])
+                p, losses = jax.lax.scan(step, params, jnp.arange(STEPS))
+                return p, jnp.mean(losses)
+            return jax.lax.scan(epoch, params, None, length=n_epochs)
+        return run
+    return maker
+
+
+def fit_cost(loop_maker, args, reps=(2, 10)):
+    ts = []
+    for n in reps:
+        run = loop_maker(n)
+        out = run(*args)
+        np.asarray(out[0]["w"]).ravel()[:1]
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = run(*args)
+            np.asarray(out[0]["w"]).ravel()[:1]
+            best = min(best, time.perf_counter() - t0)
+        ts.append(best)
+    return (ts[1] - ts[0]) / ((reps[1] - reps[0]) * STEPS)
+
+
+args = (fresh(), dense, y) + extra
+base = None
+for br in (8, 16, 32):
+    E._FUSED_BLOCK_ROWS = br
+    # fresh jit caches per block size: the kernels key on their closure
+    E.ell_scatter_apply_fused.clear_cache()
+    E.ell_margin_fused.clear_cache()
+    t = fit_cost(make_loop(_mixed_update_ell(logistic_loss, cfg)), args)
+    base = base or t
+    print(f"block_rows={br:3d}  {t*1e3:6.2f} ms/step  "
+          f"({t/base:.2f}x of br=8)", flush=True)
