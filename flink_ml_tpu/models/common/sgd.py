@@ -1119,7 +1119,13 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     batch's static routing builds in the prefetch decode workers
     (overlapping the device step) with fixed capacities
     (``ell_ovf_cap``/``ell_heavy_cap`` — one compiled program for every
-    batch; an over-cap batch raises with sizing guidance).
+    batch; an over-cap batch raises with sizing guidance).  The default
+    ``ell_ovf_cap`` is deliberately generous (``max(1024, batch)``)
+    because the cap cannot change mid-stream; the XLA overflow
+    scatter's cost scales with the STATIC cap (~0.2 us per cap slot per
+    step, r4 TPU_STEP_BREAKDOWN), so deployments whose collision rate
+    is known should pass a tight ``ell_ovf_cap`` — in-memory fits size
+    it from the measured need automatically.
 
     Unlike :func:`sgd_fit`, the READER owns the data layout:
     ``config.global_batch_size`` and ``config.seed`` are inert here — batch
