@@ -389,7 +389,10 @@ def bench_logreg_outofcore(results: dict) -> None:
         "cache_write_mb_per_sec": round(cache_bytes / write_s / 1e6, 1),
         "cache_write_workers": min(4, workers),
         "host_cores": os.cpu_count() or 1,
-        "outofcore_metric_version": 2,   # r3: mixed layout (was sparse)
+        # v3 (r4): 3 epochs with the decoded replay cache engaged — the
+        # per-epoch average now mixes one record epoch with two replay
+        # epochs (v2 averaged two identical decode-every-epoch passes)
+        "outofcore_metric_version": 3,
     }
 
     # raw-TSV leg of the north-star ingest: Criteo parser MB/s (host-only
@@ -424,25 +427,41 @@ def bench_logreg_outofcore(results: dict) -> None:
             "measurement would time the tunnel, not the ingest design")
         return
 
-    cfg = SGDConfig(learning_rate=0.5, max_epochs=2, tol=0)
+    cfg = SGDConfig(learning_rate=0.5, max_epochs=3, tol=0)
     stats = PrefetchStats()
+    stream_info: dict = {}
     t0 = time.perf_counter()
     sgd_fit_outofcore(
         logistic_loss, lambda: DataCacheReader(cache, batch_rows=batch),
         num_features=LR_DIM, config=cfg,
         dense_key="features_dense", indices_key="features_indices",
-        prefetch_workers=workers, prefetch_stats=stats)
+        prefetch_workers=workers, prefetch_stats=stats,
+        stream_info=stream_info)
     ooc_epoch_s = (time.perf_counter() - t0) / cfg.max_epochs
 
     fused_epoch_s = (rows / results["rows_per_sec"]
                      if "rows_per_sec" in results else float("nan"))
     per_epoch = {k: round(v / cfg.max_epochs * 1000, 1)
                  for k, v in stats.as_dict().items() if k != "batches"}
+    # r4 decoded replay cache: epoch 0 decodes + records, epochs 1+ replay
+    # from RAM — the steady-state multi-epoch rate is the REPLAY rate
+    ep_s = stream_info.get("epoch_seconds", [])
+    replay_s = (sum(ep_s[1:]) / (len(ep_s) - 1)) if len(ep_s) > 1 else None
     notes.update({
         "lr_fused_epoch_ms_at_this_size": round(1000 * fused_epoch_s, 1),
         "lr_outofcore_epoch_ms": round(1000 * ooc_epoch_s, 1),
         "infeed_overhead_ms": round(1000 * (ooc_epoch_s - fused_epoch_s), 1),
         "outofcore_rows_per_sec": round(rows / ooc_epoch_s, 1),
+        "outofcore_decoded_replay": {
+            "cached_batches": stream_info.get("decoded_cache_batches", 0),
+            "cached_mb": round(
+                stream_info.get("decoded_cache_bytes", 0) / 1e6, 1),
+            "record_epoch_ms": (round(1000 * ep_s[0], 1) if ep_s else None),
+            "replay_epoch_ms": (round(1000 * replay_s, 1)
+                                if replay_s is not None else None),
+        },
+        "outofcore_replay_rows_per_sec": (
+            round(rows / replay_s, 1) if replay_s else None),
         # per-epoch attribution: host read / decode / device_put / the
         # time the CONSUMER waited on the queue (infeed gap).  On the
         # tunnel, put_ms dominating proves the residual is transport, not
@@ -551,6 +570,7 @@ def bench_criteo_e2e(results: dict) -> None:
 
     cfg = SGDConfig(learning_rate=0.5, max_epochs=train_epochs, tol=0)
     stats = PrefetchStats()
+    si: dict = {}
 
     def make_reader():
         r = DataCacheReader(cache, batch_rows=1 << 14)
@@ -570,11 +590,20 @@ def bench_criteo_e2e(results: dict) -> None:
     sgd_fit_outofcore(
         logistic_loss, make_reader, num_features=LR_DIM, config=cfg,
         dense_key="features_dense", indices_key="features_indices",
-        prefetch_workers=workers, prefetch_stats=stats)
+        prefetch_workers=workers, prefetch_stats=stats,
+        # caching OFF here: the e2e metric's train leg is defined (r2/r3)
+        # as decode-every-epoch so the series stays comparable, and the
+        # second epoch exists precisely to exercise the per-epoch cache
+        # re-read path.  The decoded-replay win is measured by the
+        # dedicated out-of-core leg (outofcore_metric_version 3).
+        cache_decoded=False, stream_info=si)
     train_s = time.perf_counter() - t0
     notes["train_rows_per_sec"] = round(
         train_rows * train_epochs / train_s, 1)   # per epoch-row
     notes["train_stage_s"] = stats.as_dict()
+    notes["train_epoch_s"] = si.get("epoch_seconds")
+    notes["train_decoded_replay_batches"] = si.get(
+        "decoded_cache_batches", 0)
 
     # the e2e metric: full-pipeline rows/sec over the stages all run at
     # the same size; when the train leg was truncated, scale its cost to

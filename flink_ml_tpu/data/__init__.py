@@ -1,4 +1,5 @@
 from .prefetch import PrefetchStats, prefetch_to_device  # noqa: F401
+from .replay_cache import DecodedReplayCache, default_ram_budget  # noqa: F401
 from .stream import CountWindows, EventTimeWindows, windows_of  # noqa: F401
 from .table import Table  # noqa: F401
 from .wal import WindowLog  # noqa: F401

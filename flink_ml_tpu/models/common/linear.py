@@ -289,7 +289,8 @@ class LinearEstimatorBase(LinearEstimatorParams, Estimator):
     def fit_outofcore(self, make_reader, *, num_features: int, mesh=None,
                       sparse: bool = False, mixed: bool = False,
                       checkpoint=None,
-                      checkpoint_every_steps: int = 0, resume: bool = False):
+                      checkpoint_every_steps: int = 0, resume: bool = False,
+                      **stream_kwargs):
         """Out-of-core ``fit``: the dataset streams from ``make_reader()``
         (a fresh per-epoch iterator of host batch dicts, e.g. a re-seeked
         ``DataCacheReader``) instead of living in RAM/HBM — the
@@ -301,7 +302,11 @@ class LinearEstimatorBase(LinearEstimatorParams, Estimator):
         ``{featuresCol}_indices`` pair (implicit categorical value 1.0).
         globalBatchSize and seed are inert here: the reader owns batch size
         and ordering (shuffle when writing the cache or vary segment order
-        per epoch)."""
+        per epoch).  Extra keyword arguments (``cache_decoded``,
+        ``decoded_ram_budget``, ``stream_info``, ``prefetch_*``,
+        ``ell_*``) forward to :func:`sgd_fit_outofcore` — in particular
+        ``cache_decoded=False`` opts out of the decoded replay cache for
+        readers that intentionally vary their stream per epoch."""
         feat = self.get_features_col()
         state, loss_log = sgd_fit_outofcore(
             LOSSES[self.loss_name], make_reader,
@@ -313,7 +318,8 @@ class LinearEstimatorBase(LinearEstimatorParams, Estimator):
             values_key=f"{feat}_values" if sparse else None,
             dense_key=f"{feat}_dense" if mixed else None,
             checkpoint=checkpoint,
-            checkpoint_every_steps=checkpoint_every_steps, resume=resume)
+            checkpoint_every_steps=checkpoint_every_steps, resume=resume,
+            **stream_kwargs)
         model = self.model_cls()
         model.copy_params_from(self)
         model._state = state

@@ -318,10 +318,14 @@ def test_outofcore_midepoch_kill_and_resume_exact(tmp_path):
     # run 2: checkpoint every 2 steps, die mid-epoch-2 (batch 15 overall)
     ckpt = CheckpointConfig(str(tmp_path / "ck"), max_to_keep=3)
     _FailingReader.fail_counter = 0
+    # cache_decoded=False: the injection models a process crash via a
+    # reader failure, but the decoded replay cache (r4) legitimately stops
+    # re-reading the reader after epoch 0 — a real crash would take the
+    # RAM cache down with it, so the injected run disables caching
     with pytest.raises(RuntimeError, match="injected"):
         sgd_fit_outofcore(
             logistic_loss, lambda: _FailingReader(reader(), 15),
-            num_features=8, config=cfg,
+            num_features=8, config=cfg, cache_decoded=False,
             checkpoint=ckpt, checkpoint_every_steps=2)
     _FailingReader.fail_counter = None
 
@@ -410,9 +414,12 @@ def test_outofcore_midepoch_resume_exact_sharded_ell(tmp_path, monkeypatch):
 
     ckpt = CheckpointConfig(str(tmp_path / "ck"), max_to_keep=3)
     _FailingReader.fail_counter = 0
+    # cache_decoded=False for the injected run: see
+    # test_outofcore_midepoch_kill_and_resume_exact
     with pytest.raises(RuntimeError, match="injected"):
         sgd_fit_outofcore(
             logistic_loss, lambda: _FailingReader(reader(), 9), **kw,
+            cache_decoded=False,
             checkpoint=ckpt, checkpoint_every_steps=2)
     _FailingReader.fail_counter = None
 
