@@ -181,6 +181,21 @@ def test_cache_decoded_validated(tmp_path):
             cache_decoded="yes")
 
 
+def test_recording_under_multiworker_decode_is_exact(tmp_path):
+    """prefetch_workers=2: decode (and the cache tee) runs on two
+    threads, so offers arrive out of order — replayed epochs must still
+    be exact and in source order."""
+    cache = _write_cache(tmp_path)
+    calls_off, calls_on = [], []
+    s_off, log_off, _ = _fit(cache, calls_off, cache_decoded=False,
+                             prefetch_workers=2)
+    s_on, log_on, info = _fit(cache, calls_on, cache_decoded="auto",
+                              prefetch_workers=2)
+    np.testing.assert_array_equal(s_on.coefficients, s_off.coefficients)
+    assert log_on == log_off
+    assert info["decoded_cache_batches"] == 8
+
+
 class _EpochVaryingReader:
     """Cursor-protocol reader over a PRE-PERMUTED copy of the data —
     models readers that legitimately re-shuffle per epoch (the documented
