@@ -757,6 +757,88 @@ def _probe_tpu_backend(timeout_s: int = 240) -> bool:
         return False
 
 
+def bench_widedeep(results: dict) -> None:
+    """Wide&Deep two-tower training-step rate (BASELINE.md "configs to
+    support", stretch config) at a Criteo-shaped size on one chip:
+    13 dense + 26 categorical fields hashed into a 2^20 stacked vocab,
+    64-dim embeddings, (1024, 512, 256) MLP — the compute-bound
+    counterpart to the memory-bound LR headline (the MLP is MXU matmul
+    work, so this leg reports an MFU worth reading).  Times EXACTLY the
+    product train step (``build_reference_train_step``: same forward,
+    Adam, loss as ``WideDeep.fit``'s epoch body) over a
+    ``lax.scan`` of HBM-resident batches — one dispatch per trial,
+    device_get fence, min of 3.  FLOP accounting is the analytic MLP +
+    wide matmul count (3x forward for fwd+bwd); embedding
+    gathers/scatters are excluded, so the reported TFLOP/s is
+    conservative."""
+    import jax
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.models.recommendation.widedeep import (
+        _field_offsets, build_reference_train_step)
+
+    smoke = _smoke()
+    n_fields, d_dense = 26, 13
+    vocab_each = (1 << 20) // n_fields if not smoke else 64
+    vocab_sizes = (vocab_each,) * n_fields
+    emb_dim = 64 if not smoke else 8
+    hidden = (1024, 512, 256) if not smoke else (32, 16)
+    batch = (1 << 13) if not smoke else (1 << 8)
+    steps = 16 if not smoke else 2
+
+    train_step, params, opt_state = build_reference_train_step(
+        d_dense, vocab_sizes, emb_dim, hidden)
+
+    rng = np.random.default_rng(17)
+    offs = _field_offsets(vocab_sizes)
+    dense = jnp.asarray(
+        rng.normal(size=(steps, batch, d_dense)).astype(np.float32))
+    cat = jnp.asarray(
+        (rng.integers(0, vocab_each,
+                      size=(steps, batch, n_fields)).astype(np.int32)
+         + offs[None, None, :].astype(np.int32)))
+    y = jnp.asarray(
+        rng.integers(0, 2, size=(steps, batch)).astype(np.float32))
+    mask = jnp.ones((steps, batch), jnp.float32)
+
+    @jax.jit
+    def run(params, opt_state):
+        def step(carry, i):
+            p, o = carry
+            p, o, loss = train_step(p, o, dense[i], cat[i], y[i], mask[i])
+            return (p, o), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            step, (params, opt_state), jnp.arange(steps, dtype=jnp.int32))
+        return params, opt_state, losses
+
+    p, o, losses = run(params, opt_state)     # compile + warm
+    assert np.all(np.isfinite(np.asarray(losses)))
+    trials = []
+    for _ in range(3):
+        start = time.perf_counter()
+        p, o, losses = run(p, o)
+        np.asarray(losses)                    # completion fence
+        trials.append(time.perf_counter() - start)
+    step_s = min(trials) / steps
+
+    # analytic matmul FLOPs: wide tower + MLP chain, 3x forward for the
+    # backward pass (standard dense-layer accounting)
+    dims = [d_dense + n_fields * emb_dim] + list(hidden) + [1]
+    mlp_flops = sum(2 * a * b for a, b in zip(dims, dims[1:])) * batch
+    fwd = mlp_flops + 2 * d_dense * batch     # + wide dense matvec
+    train_flops = 3 * fwd
+    results["widedeep_steps_per_sec"] = round(1.0 / step_s, 1)
+    results["notes"]["widedeep"] = {
+        "config": (f"{n_fields}x{vocab_each} vocab, emb {emb_dim}, "
+                   f"mlp {hidden}, batch {batch}"),
+        "step_ms": round(1000 * step_s, 3),
+        "rows_per_sec": round(batch / step_s, 1),
+        "tflops": round(train_flops / step_s / 1e12, 2),
+        "mfu": round(train_flops / step_s / V5E_PEAK_FLOPS, 4),
+    }
+
+
 def bench_wal(results: dict) -> None:
     """Write-ahead window log durability cost (VERDICT r3 weak #7): live
     windows/s through the full per-window fsync pair, host-side only
@@ -806,7 +888,7 @@ def main() -> None:
     # error note instead of costing the round its whole bench line
     bench_logreg(results)
     for leg in (bench_logreg_outofcore, bench_criteo_e2e, bench_kmeans,
-                bench_wal):
+                bench_widedeep, bench_wal):
         try:
             leg(results)
         except Exception as exc:   # noqa: BLE001

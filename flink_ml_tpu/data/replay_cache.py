@@ -56,6 +56,16 @@ def default_ram_budget(fraction: float = 0.25,
     return min(1 << 30, cap_bytes)
 
 
+def _is_disk_backed(a) -> bool:
+    """True when the array's ultimate base is an ``np.memmap`` — its
+    bytes live in the page cache, not anonymous RAM."""
+    while isinstance(a, np.ndarray):
+        if isinstance(a, np.memmap):
+            return True
+        a = a.base
+    return False
+
+
 def batch_fingerprint(batch) -> bytes:
     """Order-stable digest of a raw host batch (a dict of arrays, or any
     sequence of arrays).  Used by the replay guard in
@@ -101,10 +111,20 @@ class DecodedReplayCache:
         """Tee decoded batch ``index``.  Drops (permanently disables
         further storing) once the cumulative size would exceed the
         budget — transient overshoot is bounded by the number of
-        concurrent decode workers, never by the stream length."""
+        concurrent decode workers, never by the stream length.
+
+        Decode-fresh arrays (and views of them) are retained zero-copy;
+        disk-backed views (``np.memmap`` slices that passed through the
+        decode uncopied — dense columns already in their target dtype)
+        are materialized into RAM here, otherwise the budget would count
+        pages that occupy no RAM and "replay" would still fault batches
+        in from disk."""
         if self._full or self._prefix is not None:
             return
-        size = sum(int(np.asarray(a).nbytes) for a in arrays)
+        stored = tuple(
+            np.array(a) if _is_disk_backed(a) else np.asarray(a)
+            for a in arrays)
+        size = sum(int(a.nbytes) for a in stored)
         with self._lock:
             if self._full:
                 return
@@ -112,7 +132,7 @@ class DecodedReplayCache:
                 self._full = True
                 return
             self._bytes += size
-            self._entries[index] = tuple(arrays)
+            self._entries[index] = stored
 
     def finish(self, n_batches: int) -> None:
         """End of the recording epoch: keep the longest contiguous prefix

@@ -1203,9 +1203,12 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     deep: a reader that keeps batch 0 identical while reordering the
     rest defeats it — pass ``False`` for such readers.  ``True`` forces
     caching for any reader with no probe (the caller owns the
-    determinism guarantee), ``False`` disables.  Zero-copy: recording
-    retains the already-materialized decode outputs, it never copies
-    them.  ``stream_info`` (a dict, filled in place) reports the planned
+    determinism guarantee), ``False`` disables.  A tripped guard latches
+    recording off for the rest of the fit (a varying reader would just
+    be dropped again every epoch).  Recording retains the decode
+    outputs zero-copy; disk-backed views (memmap slices that pass
+    through the decode uncopied) are materialized into RAM at tee time
+    so the budget counts real RAM and replay never faults to disk.  ``stream_info`` (a dict, filled in place) reports the planned
     impl, cached batch count/bytes, and per-epoch wall seconds so callers
     can attribute record vs replay epochs.
 
@@ -1386,6 +1389,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         raise ValueError('cache_decoded must be True, False, or "auto", '
                          f"got {cache_decoded!r}")
     replay_cache: Optional[DecodedReplayCache] = None
+    guard_tripped = False       # replay guard found an epoch-varying reader
+    recorded_epochs = 0
     _rec_cache: list = [None]   # this epoch's recording target (closure slot)
 
     def route(item):
@@ -1480,8 +1485,13 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
             if (probe_first is None or replay_cache.fingerprint is None
                     or batch_fingerprint(probe_first)
                     != replay_cache.fingerprint):
+                # one-way latch: this reader varies per epoch, so a
+                # re-recorded cache would just be dropped again next
+                # epoch — stop paying the tee (RAM + hash) for the
+                # rest of the fit
                 replay_cache = None
                 replay_ok = False
+                guard_tripped = True
         if replay_ok and replay_cache.prefix_batches == replay_cache.n_batches:
             # the decoded cache holds the WHOLE epoch: the reader's disk
             # is not consulted (beyond the guard's one-batch probe)
@@ -1503,6 +1513,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                     (("raw", b) for b in tail))
             else:
                 record = (config.max_epochs - epoch > 1
+                          and not guard_tripped
                           and not (epoch == start_epoch and skip_steps)
                           and (cache_decoded is True
                                or (cache_decoded == "auto"
@@ -1540,6 +1551,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         if rec_cache is not None:
             rec_cache.finish(step_in_epoch)
             replay_cache = rec_cache
+            recorded_epochs += 1
             _rec_cache[0] = None
         epoch_secs.append(time.perf_counter() - t_epoch)
         epoch_loss = float(
@@ -1558,6 +1570,9 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         cached = (replay_cache.prefix_batches
                   if replay_cache is not None and replay_cache.ready else 0)
         stream_info["decoded_cache_batches"] = cached
+        stream_info["decoded_cache_recorded_epochs"] = recorded_epochs
+        if guard_tripped:
+            stream_info["decoded_cache_guard_tripped"] = True
         if cached:
             stream_info["decoded_cache_bytes"] = replay_cache.cached_bytes
             stream_info["decoded_cache_total_batches"] = replay_cache.n_batches

@@ -62,6 +62,30 @@ def test_cache_gap_truncates_prefix():
     assert cache.cached_bytes == 2 * one.nbytes
 
 
+def test_offer_materializes_disk_backed_views(tmp_path):
+    """A memmap slice teed into the cache must be copied into RAM: the
+    budget counts anonymous RAM, and replay must not fault to disk."""
+    path = str(tmp_path / "col.bin")
+    np.arange(32, dtype=np.float32).tofile(path)
+    mm = np.memmap(path, dtype=np.float32, mode="r", shape=(32,))
+    fresh = np.ones((4,), np.float32)
+    view_of_fresh = fresh[:2]
+
+    cache = DecodedReplayCache(1 << 20)
+    cache.offer(0, (mm[4:8], np.asarray(mm[8:12]), fresh, view_of_fresh))
+    cache.finish(1)
+    a, b, c, d = next(iter(cache.replay()))
+    for arr in (a, b):
+        base = arr
+        while isinstance(base, np.ndarray):
+            assert not isinstance(base, np.memmap)
+            base = base.base
+    np.testing.assert_array_equal(a, [4, 5, 6, 7])
+    np.testing.assert_array_equal(b, [8, 9, 10, 11])
+    assert c is fresh                      # decode-fresh stays zero-copy
+    assert d.base is fresh                 # RAM views stay views
+
+
 def test_cache_guards():
     with pytest.raises(ValueError, match="ram_budget"):
         DecodedReplayCache(-1)
@@ -245,7 +269,11 @@ def test_guard_drops_cache_for_epoch_varying_reader():
     s_auto, log_auto, info = run("auto")
     np.testing.assert_array_equal(s_auto.coefficients, s_off.coefficients)
     assert log_auto == log_off
-    assert info["decoded_cache_batches"] == 0   # every replay got dropped
+    assert info["decoded_cache_batches"] == 0   # the replay got dropped
+    assert info["decoded_cache_guard_tripped"] is True
+    # one-way latch: after the first drop, recording stops (a varying
+    # reader would be dropped again every epoch)
+    assert info["decoded_cache_recorded_epochs"] == 1
 
 
 def test_estimator_forwards_stream_kwargs(tmp_path):
