@@ -440,6 +440,87 @@ class DataCacheReader:
         self.seek(int(snap["cursor"]))
 
 
+class ShuffledCacheReader:
+    """Per-epoch block-shuffled view over a data cache — the documented
+    "vary segment order per epoch" posture for out-of-core SGD, packaged
+    with exact resume.
+
+    Full fixed-size row blocks of ``batch_rows`` are visited in a seeded
+    permutation of ``(seed, epoch)``; the trailing partial block (if any)
+    is always visited last so batch shapes stay static for the one
+    compiled step program.  Construct one per epoch — pass an
+    epoch-aware ``make_reader(epoch=...)`` to ``sgd_fit_outofcore`` and
+    it supplies the epoch, which keeps the permutation reconstructible
+    on checkpoint resume (the cursor protocol's ``seek`` jumps to a
+    VISIT position, ``cursor // batch_rows``, not a file offset — the
+    permutation plus the visit index IS the stream position).
+
+    ``epoch_varying = True`` declares the per-epoch variance to
+    ``sgd_fit_outofcore``'s decoded replay cache, which then skips
+    recording entirely under ``cache_decoded="auto"`` — a one-batch
+    digest guard cannot prove a permutation identical (two epochs can
+    lead with the same block yet differ after it), so declaring beats
+    detecting here.
+
+    Shuffling defeats the sequential fadvise readahead, so each read
+    prefetches the NEXT visit's block instead."""
+
+    epoch_varying = True
+
+    def __init__(self, source, batch_rows: int, *, seed: int = 0,
+                 epoch: int = 0, prefetch: bool = True):
+        self._inner = DataCacheReader(source, batch_rows=batch_rows,
+                                      prefetch=False)
+        self.batch_rows = batch_rows
+        self.total_rows = self._inner.total_rows
+        self._do_prefetch = prefetch
+        full = self.total_rows // batch_rows
+        order = np.random.default_rng(
+            np.random.SeedSequence([int(seed), int(epoch)])
+        ).permutation(full)
+        if self.total_rows % batch_rows:
+            order = np.concatenate([order, [full]])
+        self._order = order.astype(np.int64)
+        self._visit = 0
+
+    @property
+    def cursor(self) -> int:
+        """Rows handed out so far (visit position x batch_rows, capped)."""
+        return min(self._visit * self.batch_rows, self.total_rows)
+
+    def seek(self, cursor: int) -> None:
+        if not 0 <= cursor <= self.total_rows:
+            raise ValueError(f"cursor {cursor} out of range")
+        self._visit = (len(self._order) if cursor >= self.total_rows
+                       else cursor // self.batch_rows)
+
+    def read_batch(self) -> Optional[Dict[str, np.ndarray]]:
+        if self._visit >= len(self._order):
+            return None
+        block = int(self._order[self._visit])
+        self._inner.seek(block * self.batch_rows)
+        batch = self._inner.read_batch()
+        self._visit += 1
+        if self._do_prefetch and self._visit < len(self._order):
+            nxt = int(self._order[self._visit])
+            self._inner._prefetch_range(nxt * self.batch_rows,
+                                        self.batch_rows)
+        return batch
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            batch = self.read_batch()
+            if batch is None:
+                return
+            yield batch
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"cursor": self.cursor}
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        self.seek(int(snap["cursor"]))
+
+
 class DataCacheSnapshot:
     """Persist/recover a cache into a checkpoint directory
     (``DataCacheSnapshot.java:50-224``): path-only references when the cache

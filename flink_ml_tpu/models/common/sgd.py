@@ -15,6 +15,7 @@ recompiles across epochs or batch positions.
 
 from __future__ import annotations
 
+import inspect
 import itertools
 import time
 
@@ -1085,6 +1086,29 @@ def sgd_fit_mixed(loss_fn: LossFn, dense_features: np.ndarray,
                        float(params["b"]), planned_impl=impl), loss_log
 
 
+def _reader_for_epoch(make_reader: Callable, epoch: int):
+    """Call the per-epoch reader factory, passing ``epoch=`` when the
+    factory accepts it.  Per-epoch shuffled readers
+    (``data.datacache.ShuffledCacheReader``) need the ACTUAL epoch number
+    — a call-counting closure would desynchronize on checkpoint resume,
+    which restarts mid-training at an arbitrary epoch.  Zero-arg
+    factories keep working unchanged."""
+    try:
+        sig = inspect.signature(make_reader)
+    except (TypeError, ValueError):
+        return make_reader()
+    for p in sig.parameters.values():
+        # only an explicitly named, keyword-passable `epoch` opts in:
+        # a bare **kwargs factory must NOT be force-fed an argument it
+        # merely forwards, and a positional-only `epoch` cannot take
+        # the keyword call
+        if p.name == "epoch" and p.kind in (
+                inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                inspect.Parameter.KEYWORD_ONLY):
+            return make_reader(epoch=epoch)
+    return make_reader()
+
+
 def _has_cursor(reader) -> bool:
     """The DataCacheReader cursor protocol: seekable, fixed batch size,
     known length — the contract ``sgd_fit_outofcore`` relies on for
@@ -1167,7 +1191,12 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     ``config.global_batch_size`` and ``config.seed`` are inert here — batch
     size is the reader's ``batch_rows`` and any shuffling must happen in the
     reader (e.g. shuffle when writing the cache, or shuffle segment order
-    per epoch).
+    per epoch).  A factory that accepts an ``epoch`` keyword is called
+    with the actual epoch number — pair it with
+    :class:`~...data.datacache.ShuffledCacheReader` for per-epoch
+    reshuffling that stays exact across checkpoint resume (a
+    call-counting closure would desynchronize, since resume restarts at
+    an arbitrary epoch).
 
     **Multi-host** (r4): pass a process-spanning mesh and call from EVERY
     process with a reader over THAT process's data shard (the reference's
@@ -1201,7 +1230,10 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     per-epoch sampling) drops the cache and decodes normally instead of
     silently training on frozen epoch-0 data.  The guard is one batch
     deep: a reader that keeps batch 0 identical while reordering the
-    rest defeats it — pass ``False`` for such readers.  ``True`` forces
+    rest defeats it — such readers should either declare
+    ``epoch_varying = True`` (the :class:`ShuffledCacheReader` protocol:
+    "auto" then never records for them) or be run with ``False``.
+    ``True`` forces
     caching for any reader with no probe (the caller owns the
     determinism guarantee), ``False`` disables.  A tripped guard latches
     recording off for the rest of the fit (a varying reader would just
@@ -1472,7 +1504,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
             # recorded epoch's; on mismatch drop the cache and decode
             # normally.  (``cache_decoded=True`` skips the probe — the
             # caller owns the determinism guarantee.)
-            reader = make_reader()
+            reader = _reader_for_epoch(make_reader, epoch)
             probe_it = iter(reader)
             probe_first = next(probe_it, None)
             # re-position the probed reader at batch 0 either way
@@ -1498,7 +1530,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
             source = (("dec", t) for t in replay_cache.replay())
         else:
             if reader is None:
-                reader = make_reader()
+                reader = _reader_for_epoch(make_reader, epoch)
             if epoch == start_epoch and skip_steps:
                 # fast-forward to the checkpointed cursor
                 reader = _seek_or_skip(reader, skip_steps)
@@ -1512,12 +1544,21 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                     (("dec", t) for t in replay_cache.replay()),
                     (("raw", b) for b in tail))
             else:
+                # readers that DECLARE per-epoch variance (e.g.
+                # ShuffledCacheReader.epoch_varying) are never recorded
+                # under "auto": a one-batch digest guard cannot prove a
+                # permutation identical (same first block != same
+                # order), so recording would be either wasted (guard
+                # trips) or silently wrong (1-in-n-blocks collision
+                # replays a frozen epoch and breaks resume exactness)
                 record = (config.max_epochs - epoch > 1
                           and not guard_tripped
                           and not (epoch == start_epoch and skip_steps)
                           and (cache_decoded is True
                                or (cache_decoded == "auto"
-                                   and _has_cursor(reader))))
+                                   and _has_cursor(reader)
+                                   and not getattr(reader, "epoch_varying",
+                                                   False))))
                 if record:
                     rec_cache = DecodedReplayCache(
                         decoded_ram_budget if decoded_ram_budget is not None

@@ -429,3 +429,43 @@ def test_outofcore_midepoch_resume_exact_sharded_ell(tmp_path, monkeypatch):
     np.testing.assert_array_equal(resumed_state.coefficients,
                                   ref_state.coefficients)
     np.testing.assert_array_equal(resumed_log, ref_log)
+
+
+def test_outofcore_midepoch_resume_exact_shuffled_stream(tmp_path):
+    """Kill-and-resume exactness with PER-EPOCH SHUFFLED streaming: the
+    epoch-aware factory reconstructs epoch N's permutation on resume, so
+    the resumed run replays the exact visit order the crashed run was
+    mid-way through (the reason sgd passes the real epoch number instead
+    of letting factories count calls)."""
+    from flink_ml_tpu.data.datacache import ShuffledCacheReader
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    cache = _lr_cache(tmp_path, "cshuf")
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=4, tol=0.0)
+
+    def reader(epoch):
+        return ShuffledCacheReader(cache, batch_rows=256, seed=13,
+                                   epoch=epoch)
+
+    ref_state, ref_log = sgd_fit_outofcore(
+        logistic_loss, reader, num_features=8, config=cfg)
+
+    ckpt = CheckpointConfig(str(tmp_path / "ckshuf"), max_to_keep=3)
+    _FailingReader.fail_counter = 0
+    with pytest.raises(RuntimeError, match="injected"):
+        sgd_fit_outofcore(
+            logistic_loss,
+            lambda epoch: _FailingReader(reader(epoch), 15),
+            num_features=8, config=cfg, cache_decoded=False,
+            checkpoint=ckpt, checkpoint_every_steps=2)
+    _FailingReader.fail_counter = None
+
+    resumed_state, resumed_log = sgd_fit_outofcore(
+        logistic_loss, reader, num_features=8, config=cfg,
+        checkpoint=ckpt, checkpoint_every_steps=2, resume=True)
+
+    np.testing.assert_array_equal(resumed_state.coefficients,
+                                  ref_state.coefficients)
+    assert resumed_state.intercept == ref_state.intercept
+    np.testing.assert_array_equal(resumed_log, ref_log)

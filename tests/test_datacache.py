@@ -253,3 +253,68 @@ def test_parallel_writer_borrow_batches(tmp_path):
         got = list(DataCacheReader(d, batch_rows=64))
         outs.append(np.concatenate([b["x"] for b in got]))
     np.testing.assert_array_equal(outs[0], outs[1])
+
+
+# ------------------------------------------------- ShuffledCacheReader
+
+
+def _shuffle_cache(tmp_path, rows=300):
+    from flink_ml_tpu.data.datacache import DataCacheWriter
+
+    d = str(tmp_path / "shufcache")
+    w = DataCacheWriter(d, segment_rows=128)
+    w.append({"x": np.arange(rows, dtype=np.float32).reshape(rows, 1)})
+    w.finish()
+    return d
+
+
+def test_shuffled_reader_permutes_blocks_partial_last(tmp_path):
+    from flink_ml_tpu.data.datacache import ShuffledCacheReader
+
+    d = _shuffle_cache(tmp_path, rows=300)     # 4 full 64-blocks + 44 tail
+    r = ShuffledCacheReader(d, batch_rows=64, seed=3, epoch=1)
+    batches = list(r)
+    assert [len(b["x"]) for b in batches[:-1]] == [64] * 4
+    assert len(batches[-1]["x"]) == 44         # partial block always last
+    np.testing.assert_array_equal(batches[-1]["x"][:, 0],
+                                  np.arange(256, 300, dtype=np.float32))
+    # same multiset of rows, not the sequential order
+    got = np.sort(np.concatenate([b["x"][:, 0] for b in batches]))
+    np.testing.assert_array_equal(got, np.arange(300, dtype=np.float32))
+
+
+def test_shuffled_reader_deterministic_per_seed_epoch(tmp_path):
+    from flink_ml_tpu.data.datacache import ShuffledCacheReader
+
+    d = _shuffle_cache(tmp_path)
+
+    def stream(seed, epoch):
+        return np.concatenate(
+            [b["x"][:, 0]
+             for b in ShuffledCacheReader(d, batch_rows=64,
+                                          seed=seed, epoch=epoch)])
+
+    np.testing.assert_array_equal(stream(3, 0), stream(3, 0))
+    assert not np.array_equal(stream(3, 0), stream(3, 1))
+    assert not np.array_equal(stream(3, 0), stream(4, 0))
+
+
+def test_shuffled_reader_seek_cursor_roundtrip(tmp_path):
+    from flink_ml_tpu.data.datacache import ShuffledCacheReader
+
+    d = _shuffle_cache(tmp_path)
+    full = ShuffledCacheReader(d, batch_rows=64, seed=5, epoch=2)
+    want = [b["x"] for b in full]
+
+    r = ShuffledCacheReader(d, batch_rows=64, seed=5, epoch=2)
+    r.read_batch()
+    r.read_batch()
+    assert r.cursor == 128
+    r2 = ShuffledCacheReader(d, batch_rows=64, seed=5, epoch=2)
+    r2.seek(128)                                # resume at visit 2
+    rest = [b["x"] for b in r2]
+    assert len(rest) == len(want) - 2
+    for a, b in zip(rest, want[2:]):
+        np.testing.assert_array_equal(a, b)
+    r2.seek(r2.total_rows)
+    assert r2.read_batch() is None

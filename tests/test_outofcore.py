@@ -330,3 +330,90 @@ def test_streaming_ell_cap_exceeded_raises(tmp_path, monkeypatch):
             logistic_loss, lambda: DataCacheReader(cache, batch_rows=600),
             num_features=d, config=sgd.SGDConfig(max_epochs=1, tol=0),
             dense_key="d", indices_key="c", ell_ovf_cap=4)
+
+
+# --------------------------------------- per-epoch shuffled streaming
+
+
+def test_epoch_aware_make_reader_receives_epoch(tmp_path):
+    """A factory accepting ``epoch=`` is called with the actual epoch
+    number each epoch."""
+    cache, _ = _write_lr_cache(tmp_path, n=1024)
+    seen = []
+
+    def make_reader(epoch):
+        seen.append(epoch)
+        return DataCacheReader(cache, batch_rows=256)
+
+    sgd_fit_outofcore(
+        logistic_loss, make_reader, num_features=16,
+        config=SGDConfig(learning_rate=0.5, max_epochs=3, tol=0.0),
+        cache_decoded=False)
+    assert seen == [0, 1, 2]
+
+
+def test_shuffled_stream_trains_and_differs_from_sequential(tmp_path):
+    """Per-epoch shuffled streaming: converges, and the visit order
+    actually differs from the sequential reader (different SGD path =>
+    different parameters)."""
+    from flink_ml_tpu.data.datacache import ShuffledCacheReader
+
+    cache, true_w = _write_lr_cache(tmp_path)
+    cfg = SGDConfig(learning_rate=0.5, max_epochs=4, tol=0.0)
+
+    state_seq, _ = sgd_fit_outofcore(
+        logistic_loss, lambda: DataCacheReader(cache, batch_rows=256),
+        num_features=16, config=cfg)
+    state_shuf, log = sgd_fit_outofcore(
+        logistic_loss,
+        lambda epoch: ShuffledCacheReader(cache, batch_rows=256,
+                                          seed=11, epoch=epoch),
+        num_features=16, config=cfg)
+
+    assert log[-1] < log[0] * 0.5
+    cos = (state_shuf.coefficients @ true_w) / (
+        np.linalg.norm(state_shuf.coefficients) * np.linalg.norm(true_w))
+    assert cos > 0.97
+    assert not np.array_equal(state_shuf.coefficients,
+                              state_seq.coefficients)
+
+
+def test_shuffled_stream_epochs_vary_and_never_recorded(tmp_path):
+    """Each epoch visits a different permutation, and the epoch_varying
+    declaration keeps the decoded replay cache out entirely — a
+    one-batch digest cannot prove a permutation identical, so recording
+    for such readers would risk a frozen epoch on a first-block
+    collision."""
+    from flink_ml_tpu.data.datacache import ShuffledCacheReader
+
+    cache, _ = _write_lr_cache(tmp_path)
+    orders = []
+
+    def make_reader(epoch):
+        r = ShuffledCacheReader(cache, batch_rows=256, seed=2, epoch=epoch)
+        orders.append(tuple(r._order.tolist()))
+        return r
+
+    info = {}
+    sgd_fit_outofcore(
+        logistic_loss, make_reader, num_features=16,
+        config=SGDConfig(learning_rate=0.5, max_epochs=3, tol=0.0),
+        stream_info=info)
+    assert len(set(orders)) == 3          # one distinct permutation/epoch
+    assert info["decoded_cache_batches"] == 0
+    assert info["decoded_cache_recorded_epochs"] == 0
+
+
+def test_kwargs_factory_not_force_fed_epoch(tmp_path):
+    """A **kwargs factory that merely forwards its kwargs must be called
+    with no arguments — feeding it epoch= would crash readers that do
+    not take one."""
+    cache, _ = _write_lr_cache(tmp_path, n=1024)
+
+    def make_reader(**kw):
+        return DataCacheReader(cache, batch_rows=256, **kw)
+
+    state, _ = sgd_fit_outofcore(
+        logistic_loss, make_reader, num_features=16,
+        config=SGDConfig(learning_rate=0.5, max_epochs=2, tol=0.0))
+    assert np.all(np.isfinite(state.coefficients))
