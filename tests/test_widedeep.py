@@ -155,3 +155,140 @@ def test_transform_validates_vocab_range():
                  "catFeatures": np.array([[10, 0]], np.int32)})  # id 10 >= 10
     with pytest.raises(ValueError):
         model.transform(bad)
+
+
+# ------------------------------------------------------ LazyAdam tables
+
+
+def _lazy_fixture(vocab_sizes=(6, 5), emb_dim=4, hidden=(8,), batch=16,
+                  seed=3):
+    from flink_ml_tpu.models.recommendation.widedeep import (
+        _field_offsets, build_reference_train_step)
+
+    rng = np.random.default_rng(seed)
+    n_fields = len(vocab_sizes)
+    offs = _field_offsets(vocab_sizes)
+
+    def make_batch(low, high):
+        """cat ids restricted to [low, high) within each field."""
+        cat = (np.stack([rng.integers(low, min(high, v), size=batch)
+                         for v in vocab_sizes], 1).astype(np.int32)
+               + offs[None, :])
+        return (rng.normal(size=(batch, 3)).astype(np.float32), cat,
+                rng.integers(0, 2, size=batch).astype(np.float32),
+                np.ones((batch,), np.float32))
+
+    dense_step, p0, s0 = build_reference_train_step(
+        3, vocab_sizes, emb_dim, hidden)
+    lazy_step, p1, s1 = build_reference_train_step(
+        3, vocab_sizes, emb_dim, hidden, lazy_embeddings=True)
+    np.testing.assert_array_equal(np.asarray(p0["emb"]),
+                                  np.asarray(p1["emb"]))  # same init
+    return make_batch, (dense_step, p0, s0), (lazy_step, p1, s1)
+
+
+def test_lazy_adam_untouched_rows_frozen():
+    """Never-touched rows keep init exactly under BOTH optimizers (zero
+    grad => zero momentum), but rows touched ONCE then idle expose the
+    semantic difference: dense Adam keeps moving them on later steps
+    (momentum tail), LazyAdam freezes them at their post-touch value."""
+    make_batch, (dense_step, p0, s0), (lazy_step, p1, s1) = _lazy_fixture()
+
+    # step 1 touches ALL ids; steps 2-3 touch only ids < 3 per field
+    first = make_batch(0, 100)
+    p0, s0, _ = dense_step(p0, s0, *first)
+    p1, s1, _ = lazy_step(p1, s1, *first)
+
+    from flink_ml_tpu.models.recommendation.widedeep import _field_offsets
+    offs = _field_offsets((6, 5))
+    idle = np.concatenate(
+        [np.arange(3, 6) + offs[0], np.arange(3, 5) + offs[1]])
+    touched_once = np.asarray(first[1]).reshape(-1)
+    idle = np.intersect1d(idle, touched_once)   # touched in step 1 only
+    assert idle.size > 0, "fixture must touch some high ids in step 1"
+    lazy_after_touch = np.asarray(p1["emb"])[idle].copy()
+    dense_after_touch = np.asarray(p0["emb"])[idle].copy()
+
+    for _ in range(3):
+        b = make_batch(0, 3)
+        p0, s0, _ = dense_step(p0, s0, *b)
+        p1, s1, _ = lazy_step(p1, s1, *b)
+
+    # LazyAdam: idle rows bit-frozen at their post-touch value
+    np.testing.assert_array_equal(np.asarray(p1["emb"])[idle],
+                                  lazy_after_touch)
+    # dense Adam: nonzero momentum keeps moving them
+    assert not np.array_equal(np.asarray(p0["emb"])[idle],
+                              dense_after_touch)
+
+
+def test_lazy_adam_matches_dense_when_all_rows_touched():
+    """A row touched by EVERY step has a dense-Adam-identical history, so
+    with every id in every batch the two optimizers agree allclose."""
+    import jax.numpy as jnp
+
+    make_batch, (dense_step, p0, s0), (lazy_step, p1, s1) = _lazy_fixture(
+        vocab_sizes=(4, 3), batch=2)
+    from flink_ml_tpu.models.recommendation.widedeep import _field_offsets
+
+    # construct batches covering EVERY id of every field each step:
+    # field A ids 0..3 and field B ids 0..2 over 12 (batch-2) rows
+    rng = np.random.default_rng(9)
+    offs = _field_offsets((4, 3))
+    a = np.repeat(np.arange(4, dtype=np.int32), 3)
+    b = np.tile(np.arange(3, dtype=np.int32), 4)
+    cat_all = np.stack([a + offs[0], b + offs[1]], 1)  # (12, 2)
+
+    for step in range(4):
+        dense = rng.normal(size=(12, 3)).astype(np.float32)
+        y = rng.integers(0, 2, size=12).astype(np.float32)
+        w = np.ones((12,), np.float32)
+        p0, s0, l0 = dense_step(p0, s0, dense, cat_all, y, w)
+        p1, s1, l1 = lazy_step(p1, s1, dense, cat_all, y, w)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+
+    for k in ("emb", "wide_cat"):
+        np.testing.assert_allclose(np.asarray(p0[k]), np.asarray(p1[k]),
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(p0["wide_dense"]),
+                               np.asarray(p1["wide_dense"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lazy_fit_converges_and_predicts():
+    t = _ctr_table()
+    model = (WideDeep().set_vocab_sizes([10, 7]).set_max_iter(8)
+             .set(WideDeep.LAZY_EMB_OPT, True).fit(t))
+    out = model.transform(t)[0]
+    acc = (np.asarray(out["prediction"]) ==
+           np.asarray(t["label"])).mean()
+    assert acc > 0.8
+    losses = model._loss_log
+    assert losses[-1] < losses[0]
+
+
+def test_lazy_adam_ignores_padding_rows():
+    """Epoch padding rows carry cat id 0 with weight 0 — they must not
+    count as 'touched': global row 0 stays bit-frozen unless a REAL row
+    references it (regression: phantom momentum-tail updates at id 0)."""
+    make_batch, _, (lazy_step, p1, s1) = _lazy_fixture(batch=8)
+
+    dense, cat, y, w = make_batch(1, 100)     # real rows avoid id 0/off
+    assert not np.any(cat == 0)
+    # append "padding": weight-0 rows with cat id 0 (what
+    # prepare_epoch_tensor produces for a ragged final batch)
+    pad = 3
+    dense = np.concatenate([dense, np.zeros((pad, 3), np.float32)])
+    cat = np.concatenate([cat, np.zeros((pad, 2), np.int32)])
+    y = np.concatenate([y, np.zeros((pad,), np.float32)])
+    w = np.concatenate([w, np.zeros((pad,), np.float32)])
+
+    from flink_ml_tpu.models.recommendation.widedeep import init_params
+    init = init_params(np.random.default_rng(0), 3, (6, 5), 4, (8,))
+    for _ in range(3):
+        p1, s1, _ = lazy_step(p1, s1, dense, cat, y, w)
+
+    np.testing.assert_array_equal(np.asarray(p1["emb"])[0],
+                                  init["emb"][0])
+    np.testing.assert_array_equal(np.asarray(s1["m"]["emb"])[0],
+                                  np.zeros(4, np.float32))
