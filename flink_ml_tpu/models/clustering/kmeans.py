@@ -341,26 +341,18 @@ def kmeans_fit_outofcore(make_reader, k: int, *,
             "and use KMeans.fit with per-process shards for multi-host")
     measure = DistanceMeasure.get_instance(measure_name)
 
+    from ...utils.padding import FixedRowBatcher
+
     multiple = local_axis_multiple(mesh)
     sharding = NamedSharding(mesh, P("data"))
-    batch_rows: list = []   # fixed after the first batch (static shapes)
+    # shared fixed-row protocol (first padded batch pins; ragged tail
+    # zero-pads with mask 0)
+    batcher = FixedRowBatcher(1)
 
     def to_host_batch(batch):
         pts = np.asarray(batch[features_key], np.float32)
         padded, mask = pad_rows_with_mask(pts, multiple, fill="zero")
-        if not batch_rows:
-            batch_rows.append(padded.shape[0])
-        rows = batch_rows[0]
-        if padded.shape[0] > rows:
-            raise ValueError(
-                f"reader produced a growing batch ({padded.shape[0]} rows "
-                f"after {rows}); fixed-size batches are required")
-        if padded.shape[0] < rows:   # final partial batch: zero rows
-            pad = rows - padded.shape[0]
-            padded = np.concatenate(
-                [padded, np.zeros((pad,) + padded.shape[1:], padded.dtype)])
-            mask = np.concatenate([mask, np.zeros((pad,), mask.dtype)])
-        return padded, mask
+        return batcher.pad((padded, mask), have=padded.shape[0])
 
     batch_stats = jax.jit(lambda c, pts, mask:
                           _assign_stats(measure, k, pts, mask, c))
@@ -398,7 +390,7 @@ def kmeans_fit_outofcore(make_reader, k: int, *,
                 centroids = jnp.asarray(
                     select_random_centroids(first, k, seed))
             if window is None:
-                window = max(1, (1 << 23) // batch_rows[0])
+                window = max(1, (1 << 23) // batcher.rows)
             s, c = batch_stats(centroids, pts, mask)
             if sums is None:
                 sums, counts = s, c

@@ -1337,22 +1337,9 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     else:
         put_fn = None
 
-    batch_rows: list = []   # fixed after first batch
-    import threading as _threading
-    _rows_lock = _threading.Lock()
+    from ...utils.padding import FixedRowBatcher
 
-    def _pad_rows(arrs, rows):
-        have = arrs[0].shape[0]
-        if have > rows:
-            raise ValueError(
-                f"reader produced a growing batch ({have} rows after "
-                f"{rows}); fixed-size batches are required")
-        if have == rows:
-            return arrs
-        return tuple(
-            np.concatenate(
-                [a, np.zeros((rows - have,) + a.shape[1:], a.dtype)])
-            for a in arrs)
+    batcher = FixedRowBatcher(n_local_dev)   # shared fixed-row protocol
 
     def to_host_batch(batch):
         if sparse or mixed:
@@ -1369,23 +1356,14 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         y = np.asarray(batch[label_key], np.float32)
         w = (np.asarray(batch[weight_key], np.float32) if weight_key
              else np.ones((y.shape[0],), np.float32))
-        with _rows_lock:
-            # under prefetch_workers > 1 two first batches can race; the
-            # lock makes exactly one win (a mis-sized winner — possible
-            # only for cursorless readers whose final partial batch is
-            # transformed first — still fails loudly in _pad_rows)
-            if not batch_rows:
-                rows = y.shape[0]
-                rows += (-rows) % n_local_dev   # data-axis divisibility
-                batch_rows.append(rows)
-        # final partial batch: pad, weight 0
-        padded = _pad_rows(feats + (y, w), batch_rows[0])
+        # final partial batch: pad, weight 0 (batcher pins thread-safely)
+        padded = batcher.pad(feats + (y, w), have=y.shape[0])
         if stream_ell:
             from ...ops.ell_scatter import ell_layout
 
             dense_p, cat_p = padded[0], padded[1]
             n_valid = y.shape[0]
-            if n_valid < batch_rows[0]:
+            if n_valid < batcher.rows:
                 # padding rows' indices become sentinels the layout
                 # drops (zero-pads would fabricate a heavy index 0);
                 # their margins are dense-part-only and carry weight 0
@@ -1395,7 +1373,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                 # per-device shard layouts: slot sources numbered inside
                 # each device's contiguous local row block (P("data")
                 # shards dim 0 the same way)
-                local = batch_rows[0] // n_local_dev
+                local = batcher.rows // n_local_dev
                 cap = (ell_ovf_cap if ell_ovf_cap is not None
                        else max(1024, local))
                 lay = ell_layout(
@@ -1407,7 +1385,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                         lay.ovf_src, lay.heavy_idx,
                         lay.heavy_cnt) + padded[2:]
             cap = (ell_ovf_cap if ell_ovf_cap is not None
-                   else max(1024, batch_rows[0]))
+                   else max(1024, batcher.rows))
             lay = ell_layout(cat_p[None], num_features,
                              pad_ovf_cap=cap,
                              pad_heavy_cap=ell_heavy_cap, device=False)
@@ -1534,9 +1512,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
             if epoch == start_epoch and skip_steps:
                 # fast-forward to the checkpointed cursor
                 reader = _seek_or_skip(reader, skip_steps)
-            if not batch_rows and hasattr(reader, "batch_rows"):
-                rows = int(reader.batch_rows)
-                batch_rows.append(rows + (-rows) % n_local_dev)
+            if batcher.rows is None and hasattr(reader, "batch_rows"):
+                batcher.pin(int(reader.batch_rows))
             if replay_ok:
                 # partial prefix: replay what fit, re-decode the tail
                 tail = _seek_or_skip(reader, replay_cache.prefix_batches)

@@ -237,6 +237,98 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
         model._loss_log = list(np.asarray(jax.device_get(loss_buf)))
         return model
 
+    def fit_outofcore(self, make_reader, *, mesh=None,
+                      prefetch_depth: int = 2, prefetch_workers: int = 1,
+                      prefetch_stats=None) -> "WideDeepModel":
+        """Out-of-core ``fit``: epochs stream from ``make_reader()`` (the
+        ``sgd_fit_outofcore`` reader protocol — a fresh per-epoch
+        iterator of host batch dicts with this estimator's column names;
+        epoch-aware factories receive ``epoch=``) instead of holding the
+        (rows, fields) epoch tensors in HBM — the Criteo-scale shape for
+        the stretch config.  Batches pad to the first batch's row count
+        (padding rows carry mask 0 and are inert in BOTH optimizers: the
+        loss is mask-weighted and the lazy table update drops weight-0
+        ids), transfer via :func:`prefetch_to_device` overlapping the
+        jitted Adam step, and the model/optimizer state never leaves
+        device memory between epochs.  Single-host (like
+        ``kmeans_fit_outofcore``); the mesh's ``data`` axis shards each
+        batch."""
+        from ...data.prefetch import prefetch_to_device
+        from ...parallel.mesh import local_axis_multiple, mesh_process_count
+        from ...utils.padding import FixedRowBatcher
+        from ..common.sgd import _reader_for_epoch
+
+        vocab_sizes = self.get_vocab_sizes()
+        if vocab_sizes is None:
+            raise ValueError("WideDeep requires vocabSizes to be set")
+        mesh = mesh or default_mesh()
+        if mesh_process_count(mesh) > 1:
+            raise ValueError(
+                "WideDeep.fit_outofcore is single-host (the prefetch "
+                "transfer is per-process); run per-process shards through "
+                "sgd-style multi-host assembly or use fit() with a "
+                "process-spanning mesh")
+        batcher = FixedRowBatcher(local_axis_multiple(mesh))
+        dense_col, cat_col = self.DENSE_FEATURES_COL, self.CAT_FEATURES_COL
+        label_col = self.get_label_col()
+
+        rng = np.random.default_rng(self.get_seed() + 1)
+        # params/step build lazily at the first batch (d_dense comes
+        # from the stream, matching fit()'s init-draw RNG sequence)
+        params = step_fn = opt_state = None
+
+        def to_host_batch(b):
+            dense = np.asarray(b[dense_col], np.float32)
+            cat = _validate_cat_ids(np.asarray(b[cat_col], np.int32),
+                                    vocab_sizes)
+            y = np.asarray(b[label_col], np.float32)
+            mask = np.ones((y.shape[0],), np.float32)
+            # padding rows: mask 0 + cat id 0 — inert in both optimizers
+            # (mask-weighted loss; lazy update drops weight-0 ids)
+            return batcher.pad((dense, cat, y, mask), have=y.shape[0])
+
+        bsh = NamedSharding(mesh, P("data"))
+        sharding = (NamedSharding(mesh, P("data", None)),
+                    NamedSharding(mesh, P("data", None)), bsh, bsh)
+
+        epoch_sums: List = []   # per-epoch (device scalar, n_batches):
+        max_epochs = self.get_max_iter()  # fetched ONCE after the loop so
+        add = jax.jit(jnp.add)            # epoch boundaries never sync
+        for epoch in range(max_epochs):
+            reader = _reader_for_epoch(make_reader, epoch)
+            loss_sum = None
+            n_batches = 0
+            for dev_batch in prefetch_to_device(
+                    reader, depth=prefetch_depth, transform=to_host_batch,
+                    sharding=sharding, workers=prefetch_workers,
+                    stats=prefetch_stats):
+                if step_fn is None:
+                    d_dense = int(dev_batch[0].shape[1])
+                    params = replicate(
+                        init_params(rng, d_dense, vocab_sizes,
+                                    self.EMBEDDING_DIM, self.HIDDEN_UNITS),
+                        mesh)
+                    raw_step, opt_state = _make_train_ops(
+                        params, self.LEARNING_RATE, bool(self.LAZY_EMB_OPT))
+                    opt_state = replicate(opt_state, mesh)
+                    step_fn = jax.jit(raw_step, donate_argnums=(0, 1))
+                params, opt_state, loss = step_fn(params, opt_state,
+                                                  *dev_batch)
+                loss_sum = loss if loss_sum is None else add(loss_sum, loss)
+                n_batches += 1
+            if loss_sum is None:
+                raise ValueError("make_reader() returned an empty epoch")
+            epoch_sums.append((loss_sum, n_batches))
+        loss_log = [float(np.asarray(jax.device_get(s))) / n
+                    for s, n in epoch_sums]
+
+        model = WideDeepModel()
+        model.copy_params_from(self)
+        model._params = jax.device_get(params)
+        model._vocab_sizes = tuple(int(v) for v in vocab_sizes)
+        model._loss_log = loss_log
+        return model
+
     def save(self, path: str) -> None:
         persist.save_metadata(self, path)
 

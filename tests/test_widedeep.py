@@ -292,3 +292,65 @@ def test_lazy_adam_ignores_padding_rows():
                                   init["emb"][0])
     np.testing.assert_array_equal(np.asarray(s1["m"]["emb"])[0],
                                   np.zeros(4, np.float32))
+
+
+# --------------------------------------------------------- out-of-core
+
+
+def test_fit_outofcore_matches_inmemory_quality(tmp_path):
+    """Streaming WDL fit from the data cache reaches in-memory fit
+    quality on the same rows; epoch-aware factories get the epoch."""
+    from flink_ml_tpu.data.datacache import DataCacheReader, DataCacheWriter
+
+    t = _ctr_table(n=512)
+    cache = str(tmp_path / "wdcache")
+    w = DataCacheWriter(cache, segment_rows=256)
+    w.append({"denseFeatures": np.asarray(t["denseFeatures"]),
+              "catFeatures": np.asarray(t["catFeatures"]),
+              "label": np.asarray(t["label"], np.float32)})
+    w.finish()
+
+    epochs_seen = []
+
+    def make_reader(epoch):
+        epochs_seen.append(epoch)
+        return DataCacheReader(cache, batch_rows=128)
+
+    est = WideDeep().set_vocab_sizes([10, 7]).set_max_iter(12).set_seed(0)
+    model_stream = est.fit_outofcore(make_reader)
+    model_mem = est.fit(t)
+
+    assert epochs_seen == list(range(12))
+    out_s = model_stream.transform(t)[0]
+    out_m = model_mem.transform(t)[0]
+    acc_s = np.mean(out_s["prediction"] == t["label"])
+    acc_m = np.mean(out_m["prediction"] == t["label"])
+    assert acc_s > 0.85 and acc_s >= acc_m - 0.05
+    assert model_stream._loss_log[-1] < model_stream._loss_log[0]
+
+
+def test_fit_outofcore_partial_batch_and_lazy(tmp_path):
+    """Ragged final batch (padding rows) + lazyEmbeddingOptimizer: the
+    padded rows are inert and training still converges."""
+    from flink_ml_tpu.data.datacache import DataCacheReader, DataCacheWriter
+
+    t = _ctr_table(n=500)       # 500 % 128 != 0 -> padded final batch
+    cache = str(tmp_path / "wdlazy")
+    w = DataCacheWriter(cache, segment_rows=256)
+    w.append({"denseFeatures": np.asarray(t["denseFeatures"]),
+              "catFeatures": np.asarray(t["catFeatures"]),
+              "label": np.asarray(t["label"], np.float32)})
+    w.finish()
+
+    model = (WideDeep().set_vocab_sizes([10, 7]).set_max_iter(10)
+             .set(WideDeep.LAZY_EMB_OPT, True)
+             .fit_outofcore(
+                 lambda: DataCacheReader(cache, batch_rows=128)))
+    out = model.transform(t)[0]
+    assert np.mean(out["prediction"] == t["label"]) > 0.8
+
+
+def test_fit_outofcore_empty_reader_rejected():
+    with pytest.raises(ValueError, match="empty epoch"):
+        (WideDeep().set_vocab_sizes([4]).set_max_iter(2)
+         .fit_outofcore(lambda: iter([])))
