@@ -349,6 +349,11 @@ class WideDeepModel(WideDeepParams, Model):
         self._vocab_sizes: Optional[Tuple[int, ...]] = None
         self._loss_log: List[float] = []
 
+    @property
+    def loss_log(self) -> List[float]:
+        """Per-epoch mean training loss (the linear family's accessor)."""
+        return list(self._loss_log)
+
     def _require_model(self):
         if self._params is None:
             raise RuntimeError("WideDeepModel has no model data")

@@ -33,6 +33,7 @@ _SLOW = [
     "iteration_example.py",
     "model_selection_example.py",
     "recommender_example.py",
+    "widedeep_ctr_example.py",     # ~20s: 12 streamed epochs
 ]
 
 _RUN_SLOW = os.environ.get("FLINK_ML_TPU_RUN_SLOW_EXAMPLES") == "1"
