@@ -79,3 +79,56 @@ def test_step_timer_fences_device_work():
     elapsed = t.stop(probe=x)
     assert elapsed > 0
     assert t.laps == [elapsed]
+
+
+def test_fixed_row_batcher_pin_pad_grow():
+    import numpy as np
+
+    from flink_ml_tpu.utils.padding import FixedRowBatcher
+
+    b = FixedRowBatcher(4)
+    assert b.rows is None
+    out = b.pad((np.ones((6, 2), np.float32), np.ones((6,), np.int32)))
+    assert b.rows == 8                      # 6 rounded up to multiple 4
+    assert out[0].shape == (8, 2) and out[1].shape == (8,)
+    assert out[0][6:].sum() == 0            # zero padding
+    # later short batch pads to the pinned rows
+    out2 = b.pad((np.ones((3, 2), np.float32), np.ones((3,), np.int32)))
+    assert out2[0].shape == (8, 2)
+    # growing batch fails loudly
+    import pytest
+
+    with pytest.raises(ValueError, match="growing batch"):
+        b.pad((np.ones((9, 2), np.float32), np.ones((9,), np.int32)))
+    # explicit pin is a no-op once pinned
+    b.pin(100)
+    assert b.rows == 8
+    with pytest.raises(ValueError, match="multiple"):
+        FixedRowBatcher(0)
+
+
+def test_fixed_row_batcher_concurrent_first_batch():
+    """Two decode workers racing the first batch: exactly one pin wins
+    and every thread pads to the same row count."""
+    import threading
+
+    import numpy as np
+
+    from flink_ml_tpu.utils.padding import FixedRowBatcher
+
+    for _ in range(20):
+        b = FixedRowBatcher(1)
+        results = []
+        barrier = threading.Barrier(2)
+
+        def worker(rows):
+            barrier.wait()
+            out = b.pad((np.ones((rows, 1), np.float32),))
+            results.append(out[0].shape[0])
+
+        ts = [threading.Thread(target=worker, args=(64,)) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert results == [64, 64]
