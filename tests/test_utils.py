@@ -82,7 +82,7 @@ def test_step_timer_fences_device_work():
 
 
 def test_fixed_row_batcher_pin_pad_grow():
-    import numpy as np
+    import pytest
 
     from flink_ml_tpu.utils.padding import FixedRowBatcher
 
@@ -96,8 +96,6 @@ def test_fixed_row_batcher_pin_pad_grow():
     out2 = b.pad((np.ones((3, 2), np.float32), np.ones((3,), np.int32)))
     assert out2[0].shape == (8, 2)
     # growing batch fails loudly
-    import pytest
-
     with pytest.raises(ValueError, match="growing batch"):
         b.pad((np.ones((9, 2), np.float32), np.ones((9,), np.int32)))
     # explicit pin is a no-op once pinned
@@ -108,11 +106,9 @@ def test_fixed_row_batcher_pin_pad_grow():
 
 
 def test_fixed_row_batcher_concurrent_first_batch():
-    """Two decode workers racing the first batch: exactly one pin wins
-    and every thread pads to the same row count."""
+    """Two decode workers racing the first batch: exactly ONE pin wins
+    (a lost pin would append twice — observable in _rows)."""
     import threading
-
-    import numpy as np
 
     from flink_ml_tpu.utils.padding import FixedRowBatcher
 
@@ -132,3 +128,4 @@ def test_fixed_row_batcher_concurrent_first_batch():
         for t in ts:
             t.join()
         assert results == [64, 64]
+        assert len(b._rows) == 1            # a raced pin appends twice
