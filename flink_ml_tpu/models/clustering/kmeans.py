@@ -358,8 +358,10 @@ def kmeans_fit_outofcore(make_reader, k: int, *,
                           _assign_stats(measure, k, pts, mask, c))
     add2 = jax.jit(lambda a, b, c, d: (a + c, b + d))
 
+    from ..common.sgd import _reader_for_epoch
+
     centroids = None
-    for _ in range(max_iter):
+    for iteration in range(max_iter):
         # Two-level accumulation: f32 on device within a window sized so
         # counts stay in f32's exact-integer range (2^24), folded into a
         # host float64 total — billions of rows per epoch cannot silently
@@ -380,8 +382,14 @@ def kmeans_fit_outofcore(make_reader, k: int, *,
             sums = counts = None
             window_used = 0
 
+        # epoch-aware factories (the sgd_fit_outofcore protocol) receive
+        # the Lloyd iteration number; Lloyd statistics are order-invariant
+        # so per-epoch reshuffled readers change IO pattern only.  NOTE:
+        # init below samples the FIRST batch — epoch-varying readers
+        # change which rows that is, deterministically in (seed, epoch=0)
         for pts, mask in prefetch_to_device(
-                make_reader(), depth=prefetch_depth,
+                _reader_for_epoch(make_reader, iteration),
+                depth=prefetch_depth,
                 transform=to_host_batch,
                 sharding=(sharding, sharding)):
             if centroids is None:

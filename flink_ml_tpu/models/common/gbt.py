@@ -332,9 +332,16 @@ def train_forest_outofcore(make_reader, grad_hess, base_score,
                            batch_device_rows: int = 1 << 16) -> Forest:
     """Out-of-core :func:`train_forest`: the dataset streams from
     ``make_reader()`` (a fresh iterator of host batch dicts per call —
-    the ``sgd_fit_outofcore`` protocol) instead of living in RAM/HBM,
-    removing the one estimator family with a host-memory ceiling
-    (VERDICT r2 task 9).
+    the ``sgd_fit_outofcore`` protocol, but STRICTLY zero-arg and
+    order-stable: unlike the sgd/kmeans streamers, epoch-aware or
+    reshuffling factories are deliberately unsupported because the
+    margin memmap is aligned to ROW ORDER across passes — every call
+    must yield the same rows in the same order, or margins silently
+    desynchronize.  A ``lambda epoch:`` factory fails loudly with a
+    TypeError; a zero-arg factory that varies order per call is the
+    caller's contract violation and cannot be detected here)
+    instead of living in RAM/HBM, removing the one estimator family
+    with a host-memory ceiling (VERDICT r2 task 9).
 
     Design: histogram building is ADDITIVE over row batches, so each tree
     level is one streamed pass accumulating ``_level_histograms`` on
