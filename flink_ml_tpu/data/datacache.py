@@ -456,11 +456,15 @@ class ShuffledCacheReader:
     permutation plus the visit index IS the stream position).
 
     ``epoch_varying = True`` declares the per-epoch variance to
-    ``sgd_fit_outofcore``'s decoded replay cache, which then skips
-    recording entirely under ``cache_decoded="auto"`` — a one-batch
-    digest guard cannot prove a permutation identical (two epochs can
-    lead with the same block yet differ after it), so declaring beats
-    detecting here.
+    ``sgd_fit_outofcore``'s decoded replay cache — a one-batch digest
+    guard cannot prove a permutation identical (two epochs can lead
+    with the same block yet differ after it), so declaring beats
+    detecting.  ``block_order`` additionally makes the stream
+    BLOCK-ADDRESSABLE: the i-th yielded batch is block
+    ``block_order[i]``, and a given block's rows (hence its decoded
+    form) are identical in every epoch — the contract the streamer's
+    block-keyed decode cache relies on to give per-epoch reshuffling
+    AND decode-once together.
 
     Shuffling defeats the sequential fadvise readahead, so each read
     prefetches the NEXT visit's block instead."""
@@ -482,6 +486,13 @@ class ShuffledCacheReader:
             order = np.concatenate([order, [full]])
         self._order = order.astype(np.int64)
         self._visit = 0
+
+    @property
+    def block_order(self) -> Tuple[int, ...]:
+        """This epoch's visit order: the i-th yielded batch is block
+        ``block_order[i]`` (block b = rows ``[b*batch_rows,
+        (b+1)*batch_rows)`` of the cache, ragged block last)."""
+        return tuple(int(b) for b in self._order)
 
     @property
     def cursor(self) -> int:

@@ -104,6 +104,10 @@ class DecodedReplayCache:
         # digest of the recording epoch's first RAW batch (pre-decode),
         # set by the recording caller; replay guards compare against it
         self.fingerprint: Optional[bytes] = None
+        # block-keyed mode: the first cached block's id — later epochs
+        # re-digest that block's raw bytes to catch readers that violate
+        # the per-block-determinism contract
+        self.anchor_key: Optional[int] = None
 
     # ------------------------------------------------------------ record
 
@@ -148,6 +152,28 @@ class DecodedReplayCache:
                     del self._entries[i]
             self._prefix = prefix
             self.n_batches = int(n_batches)
+
+    def set_anchor(self, key: int, fingerprint: bytes) -> None:
+        """Record the contract-check anchor (first offered block) once;
+        atomic so concurrent decode workers cannot pair one worker's key
+        with another's digest."""
+        with self._lock:
+            if self.anchor_key is None:
+                self.anchor_key = key
+                self.fingerprint = fingerprint
+
+    # ------------------------------------------------------ keyed lookup
+
+    def get(self, key: int) -> Optional[Tuple[np.ndarray, ...]]:
+        """Keyed access, usable WITHOUT :meth:`finish` — the block-keyed
+        mode (``sgd_fit_outofcore`` over block-addressable shuffled
+        readers) keys entries by BLOCK id rather than stream position:
+        every epoch serves cached blocks and decodes+offers the rest, so
+        there is no record/replay phase boundary and no prefix."""
+        return self._entries.get(key)
+
+    def __len__(self) -> int:
+        return len(self._entries)
 
     # ------------------------------------------------------------ replay
 

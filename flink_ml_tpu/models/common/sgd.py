@@ -1231,9 +1231,15 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     silently training on frozen epoch-0 data.  The guard is one batch
     deep: a reader that keeps batch 0 identical while reordering the
     rest defeats it — such readers should either declare
-    ``epoch_varying = True`` (the :class:`ShuffledCacheReader` protocol:
-    "auto" then never records for them) or be run with ``False``.
-    ``True`` forces
+    ``epoch_varying = True`` or be run with ``False``.  Epoch-varying
+    readers that are also BLOCK-ADDRESSABLE (``block_order`` — the
+    :class:`ShuffledCacheReader` protocol) get the best of both:
+    entries are keyed by block id, every epoch serves cached blocks in
+    that epoch's fresh permutation and decodes+offers the misses, so
+    reshuffling and decode-once compose (one raw-digest contract check
+    per epoch on an anchor block catches readers whose block content
+    drifts).  Epoch-varying readers WITHOUT ``block_order`` are simply
+    never cached under "auto".  ``True`` forces
     caching for any reader with no probe (the caller owns the
     determinism guarantee), ``False`` disables.  A tripped guard latches
     recording off for the rest of the fit (a varying reader would just
@@ -1402,6 +1408,13 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     guard_tripped = False       # replay guard found an epoch-varying reader
     recorded_epochs = 0
     _rec_cache: list = [None]   # this epoch's recording target (closure slot)
+    # block-keyed mode (epoch-varying + block-addressable readers, e.g.
+    # ShuffledCacheReader): reshuffle every epoch AND amortize decode —
+    # the cache keys entries by BLOCK id, serving hits and
+    # decoding+offering misses, with no record/replay phase boundary.
+    # `block_mode` is decided once, at the fit's first reader.
+    block_mode: Optional[bool] = None
+    block_cache: Optional[DecodedReplayCache] = None
 
     def route(item):
         """Prefetch transform over tagged source items: ``("dec", t)`` is
@@ -1410,6 +1423,29 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         tag = item[0]
         if tag == "dec":
             return item[1]
+        if tag == "blk":
+            bid, raw = item[1], item[2]
+            cached = block_cache.get(bid)
+            if cached is not None:
+                if bid == block_cache.anchor_key:
+                    # per-block-determinism contract check, one block
+                    # per epoch: a reader whose block content drifts
+                    # between epochs must fail loudly, not train on
+                    # stale decode outputs
+                    if batch_fingerprint(raw) != block_cache.fingerprint:
+                        raise ValueError(
+                            f"block-addressable reader violated the "
+                            f"block_order contract: block {bid}'s "
+                            f"content changed between epochs; pass "
+                            f"cache_decoded=False for such readers")
+                return cached
+            host = to_host_batch(raw)
+            if block_cache.anchor_key is None:
+                # digest only until an anchor exists — hashing every
+                # miss would tax the decode path the cache shrinks
+                block_cache.set_anchor(bid, batch_fingerprint(raw))
+            block_cache.offer(bid, host)
+            return host
         if tag == "rec":
             if item[1] == 0:
                 # digest the raw (pre-decode) batch: the replay guard
@@ -1473,77 +1509,124 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         t_epoch = time.perf_counter()
         rec_cache = None
         reader = None
-        replay_ok = replay_cache is not None and replay_cache.ready
-        if replay_ok and cache_decoded == "auto":
-            # Replay guard: "auto" engaged on the cursor protocol, but the
-            # protocol does not promise epoch-determinism (a reader may
-            # legitimately re-shuffle segment order per epoch).  Re-read
-            # the first raw batch and compare its digest against the
-            # recorded epoch's; on mismatch drop the cache and decode
-            # normally.  (``cache_decoded=True`` skips the probe — the
-            # caller owns the determinism guarantee.)
+        if block_mode is None and cache_decoded in (True, "auto") \
+                and config.max_epochs > 1:
             reader = _reader_for_epoch(make_reader, epoch)
-            probe_it = iter(reader)
-            probe_first = next(probe_it, None)
-            # re-position the probed reader at batch 0 either way
-            if hasattr(reader, "seek") and hasattr(reader, "batch_rows"):
-                reader.seek(0)
-            else:
-                # generator-shaped reader: re-chain the consumed batch
-                reader = itertools.chain(
-                    [] if probe_first is None else [probe_first], probe_it)
-            if (probe_first is None or replay_cache.fingerprint is None
-                    or batch_fingerprint(probe_first)
-                    != replay_cache.fingerprint):
-                # one-way latch: this reader varies per epoch, so a
-                # re-recorded cache would just be dropped again next
-                # epoch — stop paying the tee (RAM + hash) for the
-                # rest of the fit
-                replay_cache = None
-                replay_ok = False
-                guard_tripped = True
-        if replay_ok and replay_cache.prefix_batches == replay_cache.n_batches:
-            # the decoded cache holds the WHOLE epoch: the reader's disk
-            # is not consulted (beyond the guard's one-batch probe)
-            source = (("dec", t) for t in replay_cache.replay())
-        else:
+            block_mode = (getattr(reader, "epoch_varying", False)
+                          and hasattr(reader, "block_order")
+                          and hasattr(reader, "batch_rows"))
+        if block_mode and cache_decoded in (True, "auto"):
             if reader is None:
                 reader = _reader_for_epoch(make_reader, epoch)
-            if epoch == start_epoch and skip_steps:
-                # fast-forward to the checkpointed cursor
-                reader = _seek_or_skip(reader, skip_steps)
-            if batcher.rows is None and hasattr(reader, "batch_rows"):
+            if block_cache is None:
+                block_cache = DecodedReplayCache(
+                    decoded_ram_budget if decoded_ram_budget is not None
+                    else default_ram_budget())
+            order = list(reader.block_order)
+            skip = skip_steps if epoch == start_epoch else 0
+            # resume mid-epoch: the reader's own (seed, epoch)
+            # permutation is reconstructed by the factory; trim the
+            # visit order to match the skipped position
+            trimmed = order[skip:] if skip else order
+            if batcher.rows is None:
                 batcher.pin(int(reader.batch_rows))
-            if replay_ok:
-                # partial prefix: replay what fit, re-decode the tail
-                tail = _seek_or_skip(reader, replay_cache.prefix_batches)
-                source = itertools.chain(
-                    (("dec", t) for t in replay_cache.replay()),
-                    (("raw", b) for b in tail))
+            if hasattr(reader, "seek") and hasattr(reader, "read_batch"):
+                # seekable: cache hits consult NO disk — only misses
+                # and the once-per-epoch anchor contract check read raw
+                def block_source(reader=reader, trimmed=trimmed,
+                                 skip=skip):
+                    anchor_checked = False
+                    for i, bid in enumerate(trimmed):
+                        cached = block_cache.get(bid)
+                        if cached is not None:
+                            if (bid == block_cache.anchor_key
+                                    and not anchor_checked):
+                                anchor_checked = True
+                            else:
+                                yield ("dec", cached)
+                                continue
+                        reader.seek((skip + i) * reader.batch_rows)
+                        yield ("blk", bid, reader.read_batch())
+
+                source = block_source()
             else:
-                # readers that DECLARE per-epoch variance (e.g.
-                # ShuffledCacheReader.epoch_varying) are never recorded
-                # under "auto": a one-batch digest guard cannot prove a
-                # permutation identical (same first block != same
-                # order), so recording would be either wasted (guard
-                # trips) or silently wrong (1-in-n-blocks collision
-                # replays a frozen epoch and breaks resume exactness)
-                record = (config.max_epochs - epoch > 1
-                          and not guard_tripped
-                          and not (epoch == start_epoch and skip_steps)
-                          and (cache_decoded is True
-                               or (cache_decoded == "auto"
-                                   and _has_cursor(reader)
-                                   and not getattr(reader, "epoch_varying",
-                                                   False))))
-                if record:
-                    rec_cache = DecodedReplayCache(
-                        decoded_ram_budget if decoded_ram_budget is not None
-                        else default_ram_budget())
-                    _rec_cache[0] = rec_cache
-                    source = (("rec", i, b) for i, b in enumerate(reader))
+                # seekless block reader: sequential read + discard for
+                # hits (the protocol does not require seek)
+                source = (("blk", bid, b)
+                          for bid, b in zip(trimmed,
+                                            _seek_or_skip(reader, skip)))
+        else:
+            replay_ok = replay_cache is not None and replay_cache.ready
+            if replay_ok and cache_decoded == "auto":
+                # Replay guard: "auto" engaged on the cursor protocol, but the
+                # protocol does not promise epoch-determinism (a reader may
+                # legitimately re-shuffle segment order per epoch).  Re-read
+                # the first raw batch and compare its digest against the
+                # recorded epoch's; on mismatch drop the cache and decode
+                # normally.  (``cache_decoded=True`` skips the probe — the
+                # caller owns the determinism guarantee.)
+                reader = _reader_for_epoch(make_reader, epoch)
+                probe_it = iter(reader)
+                probe_first = next(probe_it, None)
+                # re-position the probed reader at batch 0 either way
+                if hasattr(reader, "seek") and hasattr(reader, "batch_rows"):
+                    reader.seek(0)
                 else:
-                    source = (("raw", b) for b in reader)
+                    # generator-shaped reader: re-chain the consumed batch
+                    reader = itertools.chain(
+                        [] if probe_first is None else [probe_first], probe_it)
+                if (probe_first is None or replay_cache.fingerprint is None
+                        or batch_fingerprint(probe_first)
+                        != replay_cache.fingerprint):
+                    # one-way latch: this reader varies per epoch, so a
+                    # re-recorded cache would just be dropped again next
+                    # epoch — stop paying the tee (RAM + hash) for the
+                    # rest of the fit
+                    replay_cache = None
+                    replay_ok = False
+                    guard_tripped = True
+            if replay_ok and replay_cache.prefix_batches == replay_cache.n_batches:
+                # the decoded cache holds the WHOLE epoch: the reader's disk
+                # is not consulted (beyond the guard's one-batch probe)
+                source = (("dec", t) for t in replay_cache.replay())
+            else:
+                if reader is None:
+                    reader = _reader_for_epoch(make_reader, epoch)
+                if epoch == start_epoch and skip_steps:
+                    # fast-forward to the checkpointed cursor
+                    reader = _seek_or_skip(reader, skip_steps)
+                if batcher.rows is None and hasattr(reader, "batch_rows"):
+                    batcher.pin(int(reader.batch_rows))
+                if replay_ok:
+                    # partial prefix: replay what fit, re-decode the tail
+                    tail = _seek_or_skip(reader, replay_cache.prefix_batches)
+                    source = itertools.chain(
+                        (("dec", t) for t in replay_cache.replay()),
+                        (("raw", b) for b in tail))
+                else:
+                    # readers that DECLARE per-epoch variance (e.g.
+                    # ShuffledCacheReader.epoch_varying) are never recorded
+                    # under "auto": a one-batch digest guard cannot prove a
+                    # permutation identical (same first block != same
+                    # order), so recording would be either wasted (guard
+                    # trips) or silently wrong (1-in-n-blocks collision
+                    # replays a frozen epoch and breaks resume exactness)
+                    record = (config.max_epochs - epoch > 1
+                              and not guard_tripped
+                              and not (epoch == start_epoch and skip_steps)
+                              and (cache_decoded is True
+                                   or (cache_decoded == "auto"
+                                       and _has_cursor(reader)
+                                       and not getattr(reader, "epoch_varying",
+                                                       False))))
+                    if record:
+                        rec_cache = DecodedReplayCache(
+                            decoded_ram_budget if decoded_ram_budget is not None
+                            else default_ram_budget())
+                        _rec_cache[0] = rec_cache
+                        source = (("rec", i, b) for i, b in enumerate(reader))
+                    else:
+                        source = (("raw", b) for b in reader)
 
         # Running on-device sum: memory stays flat over millions of batches
         # (a list of live per-batch scalars would grow O(n_batches)).
@@ -1585,15 +1668,23 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     params = _fetch_replicated(params)
     if stream_info is not None:
         stream_info["impl"] = stream_impl
-        cached = (replay_cache.prefix_batches
-                  if replay_cache is not None and replay_cache.ready else 0)
-        stream_info["decoded_cache_batches"] = cached
-        stream_info["decoded_cache_recorded_epochs"] = recorded_epochs
-        if guard_tripped:
-            stream_info["decoded_cache_guard_tripped"] = True
-        if cached:
-            stream_info["decoded_cache_bytes"] = replay_cache.cached_bytes
-            stream_info["decoded_cache_total_batches"] = replay_cache.n_batches
+        if block_cache is not None:
+            stream_info["decoded_cache_mode"] = "block"
+            stream_info["decoded_cache_batches"] = len(block_cache)
+            stream_info["decoded_cache_bytes"] = block_cache.cached_bytes
+        else:
+            cached = (replay_cache.prefix_batches
+                      if replay_cache is not None and replay_cache.ready
+                      else 0)
+            stream_info["decoded_cache_batches"] = cached
+            stream_info["decoded_cache_recorded_epochs"] = recorded_epochs
+            if guard_tripped:
+                stream_info["decoded_cache_guard_tripped"] = True
+            if cached:
+                stream_info["decoded_cache_bytes"] = \
+                    replay_cache.cached_bytes
+                stream_info["decoded_cache_total_batches"] = \
+                    replay_cache.n_batches
         stream_info["epoch_seconds"] = [round(s, 4) for s in epoch_secs]
     return LinearState(np.asarray(params["w"], np.float64),
                        float(params["b"]),

@@ -378,12 +378,11 @@ def test_shuffled_stream_trains_and_differs_from_sequential(tmp_path):
                               state_seq.coefficients)
 
 
-def test_shuffled_stream_epochs_vary_and_never_recorded(tmp_path):
-    """Each epoch visits a different permutation, and the epoch_varying
-    declaration keeps the decoded replay cache out entirely — a
-    one-batch digest cannot prove a permutation identical, so recording
-    for such readers would risk a frozen epoch on a first-block
-    collision."""
+def test_shuffled_stream_epochs_vary_and_use_block_cache(tmp_path):
+    """Each epoch visits a different permutation; because the reader is
+    block-addressable the decode cache engages in BLOCK-keyed mode (the
+    positional record/replay machinery — whose one-batch guard cannot
+    prove a permutation identical — stays out)."""
     from flink_ml_tpu.data.datacache import ShuffledCacheReader
 
     cache, _ = _write_lr_cache(tmp_path)
@@ -400,8 +399,8 @@ def test_shuffled_stream_epochs_vary_and_never_recorded(tmp_path):
         config=SGDConfig(learning_rate=0.5, max_epochs=3, tol=0.0),
         stream_info=info)
     assert len(set(orders)) == 3          # one distinct permutation/epoch
-    assert info["decoded_cache_batches"] == 0
-    assert info["decoded_cache_recorded_epochs"] == 0
+    assert info["decoded_cache_mode"] == "block"
+    assert info["decoded_cache_batches"] == 16   # 4096 rows / 256
 
 
 def test_kwargs_factory_not_force_fed_epoch(tmp_path):

@@ -296,6 +296,99 @@ def test_estimator_forwards_stream_kwargs(tmp_path):
     assert info["decoded_cache_batches"] == 4   # auto engaged
 
 
+def test_block_cache_shuffled_reader_exact_and_decode_once(tmp_path):
+    """Block-keyed mode: a ShuffledCacheReader stream under "auto" is
+    bit-identical to the uncached fit (cached decode outputs ARE the
+    decode outputs), every block lands in the cache, and each epoch
+    still sees its own permutation."""
+    from flink_ml_tpu.data.datacache import ShuffledCacheReader
+
+    cache = _write_cache(tmp_path)
+    orders = []
+
+    def make_reader(epoch):
+        r = ShuffledCacheReader(cache, batch_rows=256, seed=3, epoch=epoch)
+        orders.append(r.block_order)
+        return r
+
+    def run(mode):
+        info = {}
+        state, log = sgd_fit_outofcore(
+            logistic_loss, make_reader, num_features=16,
+            config=SGDConfig(learning_rate=0.5, max_epochs=4, tol=0.0),
+            cache_decoded=mode, stream_info=info)
+        return state, log, info
+
+    s_off, log_off, _ = run(False)
+    orders_off = list(orders)
+    orders.clear()
+    s_on, log_on, info = run("auto")
+    np.testing.assert_array_equal(s_on.coefficients, s_off.coefficients)
+    assert log_on == log_off
+    assert orders == orders_off                 # same permutations seen
+    assert len(set(orders)) == 4                # ...and they differ/epoch
+    assert info["decoded_cache_mode"] == "block"
+    assert info["decoded_cache_batches"] == 8   # every block cached
+    assert info["decoded_cache_bytes"] > 0
+
+
+def test_block_cache_respects_budget_and_stays_exact(tmp_path):
+    from flink_ml_tpu.data.datacache import ShuffledCacheReader
+
+    cache = _write_cache(tmp_path)
+    batch_bytes = 256 * 18 * 4
+
+    def run(**kw):
+        info = {}
+        state, _ = sgd_fit_outofcore(
+            logistic_loss,
+            lambda epoch: ShuffledCacheReader(cache, batch_rows=256,
+                                              seed=3, epoch=epoch),
+            num_features=16,
+            config=SGDConfig(learning_rate=0.5, max_epochs=3, tol=0.0),
+            stream_info=info, **kw)
+        return state, info
+
+    s_on, info = run(decoded_ram_budget=3 * batch_bytes)
+    s_off, _ = run(cache_decoded=False)
+    assert 0 < info["decoded_cache_batches"] <= 3
+    # budget-limited block cache is still bit-exact
+    np.testing.assert_array_equal(s_on.coefficients, s_off.coefficients)
+
+
+def test_block_cache_contract_violation_raises(tmp_path):
+    """A reader that claims block-addressability but changes a block's
+    content between epochs must fail loudly at the anchor check."""
+    from flink_ml_tpu.data.datacache import DataCacheReader
+
+    cache = _write_cache(tmp_path, n=1024)
+
+    class LyingReader:
+        epoch_varying = True
+
+        def __init__(self, epoch):
+            self._inner = DataCacheReader(cache, batch_rows=256)
+            self._epoch = epoch
+            self.batch_rows = 256
+            self.total_rows = self._inner.total_rows
+            self.block_order = tuple(range(4))
+
+        def seek(self, c):
+            self._inner.seek(c)
+
+        def __iter__(self):
+            for b in self._inner:
+                # content drifts with the epoch — violates the contract
+                yield {"features": b["features"] + self._epoch,
+                       "label": b["label"]}
+
+    with pytest.raises(ValueError, match="block_order contract"):
+        sgd_fit_outofcore(
+            logistic_loss, lambda epoch: LyingReader(epoch),
+            num_features=16,
+            config=SGDConfig(learning_rate=0.5, max_epochs=3, tol=0.0))
+
+
 def test_mixed_ell_stream_cached_matches_uncached(tmp_path):
     """The ELL streaming decode (layout build) is the expensive path the
     cache exists for — exactness across cache on/off on the mixed
