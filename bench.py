@@ -439,6 +439,29 @@ def bench_logreg_outofcore(results: dict) -> None:
         stream_info=stream_info)
     ooc_epoch_s = (time.perf_counter() - t0) / cfg.max_epochs
 
+    # shuffled + block-keyed decode cache (r4): per-epoch reshuffle with
+    # decode amortization — epoch 2 serves every block's decoded layout
+    # from RAM in a fresh permutation
+    from flink_ml_tpu.data.datacache import ShuffledCacheReader
+
+    si2: dict = {}
+    t0 = time.perf_counter()
+    sgd_fit_outofcore(
+        logistic_loss,
+        lambda epoch: ShuffledCacheReader(cache, batch_rows=batch,
+                                          seed=11, epoch=epoch),
+        num_features=LR_DIM,
+        config=SGDConfig(learning_rate=0.5, max_epochs=2, tol=0),
+        dense_key="features_dense", indices_key="features_indices",
+        prefetch_workers=workers, stream_info=si2)
+    shuffled_s = time.perf_counter() - t0
+    notes["outofcore_shuffled_block_cache"] = {
+        "mode": si2.get("decoded_cache_mode"),
+        "cached_batches": si2.get("decoded_cache_batches"),
+        "epoch_s": si2.get("epoch_seconds"),
+        "wall_s": round(shuffled_s, 2),
+    }
+
     fused_epoch_s = (rows / results["rows_per_sec"]
                      if "rows_per_sec" in results else float("nan"))
     per_epoch = {k: round(v / cfg.max_epochs * 1000, 1)
