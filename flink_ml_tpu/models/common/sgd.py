@@ -37,6 +37,7 @@ from ...iteration import IterationBodyResult, IterationConfig, iterate
 from ...iteration.checkpoint import CheckpointConfig, CheckpointManager
 from ...parallel.mesh import (
     default_mesh,
+    assemble_process_local as _assemble_process_local,
     fetch_replicated as _fetch_replicated,
     mesh_process_count as _mesh_process_count,
     put_sharded as _put_epoch_tensor,
@@ -1333,15 +1334,9 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     else:
         sharding = ((x_sh, x_sh, v_sh, v_sh) if (sparse or mixed)
                     else (x_sh, v_sh, v_sh))
-    if procs > 1:
-        # process-spanning mesh: each process's decoded batch is its LOCAL
-        # slice; assemble the global (non-fully-addressable) batch arrays
-        def put_fn(batch, shardings):
-            return tuple(
-                jax.make_array_from_process_local_data(sh, np.asarray(a))
-                for a, sh in zip(batch, shardings))
-    else:
-        put_fn = None
+    # process-spanning mesh: each process's decoded batch is its LOCAL
+    # slice; assemble the global (non-fully-addressable) batch arrays
+    put_fn = _assemble_process_local if procs > 1 else None
 
     from ...utils.padding import FixedRowBatcher
 

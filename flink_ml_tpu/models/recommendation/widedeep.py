@@ -250,11 +250,23 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
         loss is mask-weighted and the lazy table update drops weight-0
         ids), transfer via :func:`prefetch_to_device` overlapping the
         jitted Adam step, and the model/optimizer state never leaves
-        device memory between epochs.  Single-host (like
-        ``kmeans_fit_outofcore``); the mesh's ``data`` axis shards each
-        batch."""
+        device memory between epochs.  The mesh's ``data`` axis shards
+        each batch.
+
+        **Multi-host**: pass a process-spanning mesh and call from EVERY
+        process with a reader over THAT process's data shard (the
+        ``sgd_fit_outofcore`` posture): the global batch is the per-step
+        concatenation over processes, assembled inside the prefetch
+        pipeline, and every process must deliver the SAME number of
+        equal-sized batches per epoch (mismatches deadlock in the
+        collectives)."""
         from ...data.prefetch import prefetch_to_device
-        from ...parallel.mesh import local_axis_multiple, mesh_process_count
+        from ...parallel.mesh import (
+            assemble_process_local,
+            fetch_replicated,
+            local_axis_multiple,
+            mesh_process_count,
+        )
         from ...utils.padding import FixedRowBatcher
         from ..common.sgd import _reader_for_epoch
 
@@ -262,12 +274,8 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
         if vocab_sizes is None:
             raise ValueError("WideDeep requires vocabSizes to be set")
         mesh = mesh or default_mesh()
-        if mesh_process_count(mesh) > 1:
-            raise ValueError(
-                "WideDeep.fit_outofcore is single-host (the prefetch "
-                "transfer is per-process); run per-process shards through "
-                "sgd-style multi-host assembly or use fit() with a "
-                "process-spanning mesh")
+        put_fn = (assemble_process_local
+                  if mesh_process_count(mesh) > 1 else None)
         batcher = FixedRowBatcher(local_axis_multiple(mesh))
         dense_col, cat_col = self.DENSE_FEATURES_COL, self.CAT_FEATURES_COL
         label_col = self.get_label_col()
@@ -301,16 +309,21 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
             for dev_batch in prefetch_to_device(
                     reader, depth=prefetch_depth, transform=to_host_batch,
                     sharding=sharding, workers=prefetch_workers,
-                    stats=prefetch_stats):
+                    stats=prefetch_stats, put_fn=put_fn):
                 if step_fn is None:
                     d_dense = int(dev_batch[0].shape[1])
-                    params = replicate(
-                        init_params(rng, d_dense, vocab_sizes,
-                                    self.EMBEDDING_DIM, self.HIDDEN_UNITS),
-                        mesh)
-                    raw_step, opt_state = _make_train_ops(
-                        params, self.LEARNING_RATE, bool(self.LAZY_EMB_OPT))
-                    opt_state = replicate(opt_state, mesh)
+                    # init + optax state build on HOST values, then
+                    # replicate both: optax.init on a non-addressable
+                    # process-spanning array would create mismatched
+                    # local state (every process seeds identically)
+                    host_params = init_params(
+                        rng, d_dense, vocab_sizes,
+                        self.EMBEDDING_DIM, self.HIDDEN_UNITS)
+                    raw_step, host_opt = _make_train_ops(
+                        host_params, self.LEARNING_RATE,
+                        bool(self.LAZY_EMB_OPT))
+                    params = replicate(host_params, mesh)
+                    opt_state = replicate(host_opt, mesh)
                     step_fn = jax.jit(raw_step, donate_argnums=(0, 1))
                 params, opt_state, loss = step_fn(params, opt_state,
                                                   *dev_batch)
@@ -319,12 +332,12 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
             if loss_sum is None:
                 raise ValueError("make_reader() returned an empty epoch")
             epoch_sums.append((loss_sum, n_batches))
-        loss_log = [float(np.asarray(jax.device_get(s))) / n
+        loss_log = [float(np.asarray(fetch_replicated(s))) / n
                     for s, n in epoch_sums]
 
         model = WideDeepModel()
         model.copy_params_from(self)
-        model._params = jax.device_get(params)
+        model._params = fetch_replicated(params)
         model._vocab_sizes = tuple(int(v) for v in vocab_sizes)
         model._loss_log = loss_log
         return model

@@ -216,6 +216,16 @@ def put_sharded(arr: np.ndarray, mesh: Mesh, spec: P):
     return jax.device_put(arr, sharding)
 
 
+def assemble_process_local(batch: Any, shardings: Any) -> tuple:
+    """Multi-host prefetch transfer: assemble each process's LOCAL batch
+    arrays into the global (non-fully-addressable) arrays in process
+    order — the ``put_fn`` the streaming trainers hand to
+    ``prefetch_to_device`` on process-spanning meshes."""
+    return tuple(
+        jax.make_array_from_process_local_data(sh, np.asarray(a))
+        for a, sh in zip(batch, shardings))
+
+
 def fetch_replicated(tree: Any) -> Any:
     """device_get that also handles non-fully-addressable arrays
     (multi-host).  A replicated array's local replica IS the global

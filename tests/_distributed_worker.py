@@ -248,6 +248,59 @@ def main() -> None:
                                atol=1e-5)
     np.testing.assert_allclose(log_mp, log, atol=1e-5)
 
+    # multi-host STREAMING Wide&Deep (r4): each process streams its own
+    # shard through fit_outofcore over the process-spanning mesh; the
+    # fitted params must equal a manual single-program Adam loop over
+    # the concatenated per-step batches with the same init.
+    from flink_ml_tpu.models.recommendation.widedeep import (
+        WideDeep,
+        _make_train_ops,
+        _validate_cat_ids,
+        init_params,
+    )
+
+    wd_vocab = [9, 5]
+
+    def wd_shard(p):
+        srng = np.random.default_rng(500 + p)
+        nloc = 64
+        return (srng.normal(size=(nloc, 3)).astype(np.float32),
+                np.stack([srng.integers(0, v, size=nloc)
+                          for v in wd_vocab], 1).astype(np.int32),
+                srng.integers(0, 2, size=nloc).astype(np.float32))
+
+    def wd_reader():
+        wdn, wcn, wyn = wd_shard(pid)
+        return iter([{"denseFeatures": wdn[i:i + 16],
+                      "catFeatures": wcn[i:i + 16],
+                      "label": wyn[i:i + 16]} for i in range(0, 64, 16)])
+
+    wd_est = (WideDeep().set_vocab_sizes(wd_vocab).set_max_iter(2)
+              .set_seed(0))
+    wd_model = wd_est.fit_outofcore(wd_reader, mesh=mesh)
+
+    wd_oracle = init_params(np.random.default_rng(1), 3, wd_vocab, 8,
+                            (64, 32))
+    wd_step, wd_opt = _make_train_ops(wd_oracle, 1e-2, False)
+    wd_step = jax.jit(wd_step)
+    wd_shards = [wd_shard(p) for p in range(nprocs)]
+    import jax.numpy as _jnp
+    wd_oracle = jax.tree_util.tree_map(_jnp.asarray, wd_oracle)
+    for _ in range(2):
+        for i in range(0, 64, 16):
+            gdn = np.concatenate([s[0][i:i + 16] for s in wd_shards])
+            gcn = np.concatenate(
+                [_validate_cat_ids(s[1][i:i + 16], wd_vocab)
+                 for s in wd_shards])
+            gyn = np.concatenate([s[2][i:i + 16] for s in wd_shards])
+            wd_oracle, wd_opt, _ = wd_step(
+                wd_oracle, wd_opt, gdn, gcn, gyn,
+                np.ones(len(gyn), np.float32))
+    for a, b in zip(jax.tree_util.tree_leaves(wd_model._params),
+                    jax.tree_util.tree_leaves(jax.device_get(wd_oracle))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
     # multi-host KMeans: each host holds a different half of 4 separated
     # clusters; the replicated centroids must recover all 4 means on BOTH
     # hosts (host 0's local selection seeds the global init).

@@ -44,8 +44,17 @@ def _run(name: str) -> None:
     if "xla_force_host_platform_device_count" not in flags:
         flags = (flags + " --xla_force_host_platform_device_count=8").strip()
     env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS=flags)
+    # Launch through a live-config CPU override, not the env var alone:
+    # this environment's sitecustomize consumes JAX_PLATFORMS at
+    # interpreter startup, and with the TPU relay down the axon
+    # backend's first device use blocks for minutes (the r3 outage
+    # failure mode) — the config update runs before any device use, so
+    # the example tier stays green in any relay weather.
+    boot = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import runpy, sys; sys.argv = sys.argv[1:]; "
+            "runpy.run_path(sys.argv[0], run_name='__main__')")
     proc = subprocess.run(
-        [sys.executable, os.path.join(_EXAMPLES_DIR, name)],
+        [sys.executable, "-c", boot, os.path.join(_EXAMPLES_DIR, name)],
         env=env, capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, (
         f"{name} failed (rc={proc.returncode}):\n"
