@@ -11,19 +11,29 @@ shape the decode costs ~4 s/epoch while the device step costs ~25 ms —
 the out-of-core epoch rate is decode-bound, not math-bound.
 
 :class:`DecodedReplayCache` is the TPU-native analog, one level higher
-than the reference's: the *first* epoch tees each decoded batch (a tuple
-of fixed-shape numpy arrays) into host RAM up to a byte budget; later
-epochs replay the cached prefix directly into the device-put stage and
-only re-decode the tail that did not fit.  Because the out-of-core
-trainers require fixed batch shapes anyway (one compiled step program for
-the whole stream), every cached batch has identical nbytes and the budget
-maps 1:1 to a batch-count prefix.
+than the reference's, and serves two access patterns:
+
+- **Positional (record/replay)** — epoch-stable streams: the *first*
+  epoch tees each decoded batch (a tuple of fixed-shape numpy arrays)
+  into host RAM up to a byte budget; later epochs replay the cached
+  prefix directly into the device-put stage and only re-decode the tail
+  that did not fit.  Because the out-of-core trainers require fixed
+  batch shapes anyway (one compiled step program for the whole stream),
+  every cached batch has identical nbytes and the budget maps 1:1 to a
+  batch-count prefix.  ``offer`` + ``finish`` + ``replay``.
+- **Block-keyed** — epoch-VARYING but block-addressable streams
+  (``ShuffledCacheReader``): entries key by BLOCK id instead of stream
+  position, ``get`` works without any ``finish`` phase, and every epoch
+  serves cached blocks in that epoch's fresh permutation while
+  decoding+offering the misses — reshuffling and decode-once compose.
+  ``offer`` + ``get`` + ``set_anchor`` (the per-epoch contract-check
+  digest).
 
 Thread-safety: ``offer`` may be called from multiple decode workers in
 any order (the prefetch pool reassembles source order downstream, but the
 tee happens inside the transform).  ``finish`` computes the longest
 contiguous prefix from batch 0 that landed under the budget and drops any
-stragglers, so replay order is always exactly source order.
+stragglers, so positional replay order is always exactly source order.
 """
 
 from __future__ import annotations
@@ -88,7 +98,8 @@ def batch_fingerprint(batch) -> bytes:
 
 
 class DecodedReplayCache:
-    """Cache-what-fits prefix of a decoded batch stream (see module doc)."""
+    """Cache-what-fits store of decoded batches, addressed positionally
+    (record/replay prefix) or by block id (see module doc)."""
 
     def __init__(self, ram_budget_bytes: int):
         if ram_budget_bytes < 0:
