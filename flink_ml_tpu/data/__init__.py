@@ -1,3 +1,9 @@
+from .datacache import (  # noqa: F401
+    DataCacheReader,
+    DataCacheSnapshot,
+    DataCacheWriter,
+    ShuffledCacheReader,
+)
 from .prefetch import PrefetchStats, prefetch_to_device  # noqa: F401
 from .replay_cache import DecodedReplayCache, default_ram_budget  # noqa: F401
 from .stream import CountWindows, EventTimeWindows, windows_of  # noqa: F401
