@@ -1,0 +1,42 @@
+"""MIGRATION.md is the reference user's entry point — every
+`flink_ml_tpu...` path it cites must keep resolving, or the doc rots
+exactly where newcomers land first."""
+
+import importlib
+import os
+import re
+
+_DOC = os.path.join(os.path.dirname(__file__), "..", "MIGRATION.md")
+
+# dotted paths inside backticks, e.g. `flink_ml_tpu.api.stage.Stage` or
+# `flink_ml_tpu.api.pipeline.Pipeline/PipelineModel`
+_PATTERN = re.compile(r"`(flink_ml_tpu(?:\.\w+)+(?:/[\w.]+)*)`")
+
+
+def _resolve(path: str) -> None:
+    parts = path.split(".")
+    # walk the longest importable module prefix, then getattr the rest
+    for split in range(len(parts), 0, -1):
+        mod_name = ".".join(parts[:split])
+        try:
+            obj = importlib.import_module(mod_name)
+        except ImportError:
+            continue
+        for attr in parts[split:]:
+            obj = getattr(obj, attr)   # AttributeError = broken citation
+        return
+    raise ImportError(f"no importable prefix for {path}")
+
+
+def test_every_cited_path_resolves():
+    text = open(_DOC).read()
+    cites = sorted(set(_PATTERN.findall(text)))
+    assert len(cites) >= 15, "MIGRATION.md lost its citations?"
+    for cite in cites:
+        # `a.b.C/D` cites several names under one module
+        base, *alts = cite.split("/")
+        _resolve(base)
+        prefix = base.rsplit(".", 1)[0]
+        for alt in alts:
+            _resolve(f"{prefix}.{alt}" if "." not in alt else
+                     f"{base.rsplit('.', 1)[0]}.{alt}")
