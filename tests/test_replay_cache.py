@@ -356,6 +356,31 @@ def test_block_cache_respects_budget_and_stays_exact(tmp_path):
     np.testing.assert_array_equal(s_on.coefficients, s_off.coefficients)
 
 
+def test_block_cache_multiworker_decode_exact(tmp_path):
+    """Block mode under prefetch_workers=2: concurrent offer/get on the
+    keyed cache stays bit-exact vs the uncached fit."""
+    from flink_ml_tpu.data.datacache import ShuffledCacheReader
+
+    cache = _write_cache(tmp_path)
+
+    def run(mode):
+        info = {}
+        state, log = sgd_fit_outofcore(
+            logistic_loss,
+            lambda epoch: ShuffledCacheReader(cache, batch_rows=256,
+                                              seed=6, epoch=epoch),
+            num_features=16,
+            config=SGDConfig(learning_rate=0.5, max_epochs=4, tol=0.0),
+            cache_decoded=mode, stream_info=info, prefetch_workers=2)
+        return state, log, info
+
+    s_off, log_off, _ = run(False)
+    s_on, log_on, info = run("auto")
+    np.testing.assert_array_equal(s_on.coefficients, s_off.coefficients)
+    assert log_on == log_off
+    assert info["decoded_cache_mode"] == "block"
+
+
 def test_block_cache_contract_violation_raises(tmp_path):
     """A reader that claims block-addressability but changes a block's
     content between epochs must fail loudly at the anchor check."""
