@@ -88,6 +88,23 @@ class WideDeepParams(HasLabelCol, HasPredictionCol, HasRawPredictionCol,
         "large enough that full-table m/v/param streams dominate or "
         "cannot fit.",
         default=False)
+    ROUTED_EMB_GRAD = StringParam(
+        "routedEmbeddingGrad",
+        "Statically-routed table gradients (ops/emb_grad.py) for the "
+        "dense-Adam fit: the bounded fit replays a fixed epoch tensor, "
+        "so the per-step slot->row sort is computed once on the host "
+        "and every training step's embedding/wide-table scatter becomes "
+        "conflict-free streaming work (sorted permutation gather + "
+        "segmented fold + unique sorted scatter-set) instead of XLA's "
+        "per-slot random read-modify-write — the same static-routing "
+        "insight as the LR family's ELL kernels.  Results equal the "
+        "scatter-add up to f32 summation order.  'auto' (default) = on "
+        "for the in-memory dense-Adam fit(), off for streaming fits "
+        "(their batches are not replayed) and under "
+        "lazyEmbeddingOptimizer; 'on' forces it (error if lazy); "
+        "'off' keeps the autodiff scatter.",
+        default="auto",
+        validator=ParamValidators.in_array(("auto", "on", "off")))
 
     def get_vocab_sizes(self):
         return self.get(WideDeepParams.VOCAB_SIZES)
@@ -124,21 +141,32 @@ def init_params(rng: np.random.Generator, d_dense: int, vocab_sizes,
     }
 
 
-def forward(params: Dict[str, Any], dense: jnp.ndarray,
-            cat_ids: jnp.ndarray) -> jnp.ndarray:
-    """Logits for a batch.  ``cat_ids`` are already offset into the stacked
-    vocab (shape (batch, n_fields))."""
+def forward_from_rows(params: Dict[str, Any], dense: jnp.ndarray,
+                      wide_rows: jnp.ndarray, emb_rows: jnp.ndarray
+                      ) -> jnp.ndarray:
+    """Logits from already-gathered table rows (``wide_rows (b, fields)``,
+    ``emb_rows (b, fields, emb)``).  The routed-gradient step
+    differentiates THROUGH the rows (treating the gathers as inputs) so
+    it can route the table gradients itself; ``params`` needs only the
+    non-table leaves here."""
     wide = (dense @ params["wide_dense"]
-            + jnp.sum(params["wide_cat"][cat_ids], axis=1)
+            + jnp.sum(wide_rows, axis=1)
             + params["wide_b"])
-    emb = params["emb"][cat_ids]                      # (b, fields, emb)
     deep = jnp.concatenate(
-        [dense, emb.reshape(emb.shape[0], -1)], axis=1)
+        [dense, emb_rows.reshape(emb_rows.shape[0], -1)], axis=1)
     for i, layer in enumerate(params["mlp"]):
         deep = deep @ layer["w"] + layer["b"]
         if i + 1 < len(params["mlp"]):
             deep = jax.nn.relu(deep)
     return wide + deep[:, 0]
+
+
+def forward(params: Dict[str, Any], dense: jnp.ndarray,
+            cat_ids: jnp.ndarray) -> jnp.ndarray:
+    """Logits for a batch.  ``cat_ids`` are already offset into the stacked
+    vocab (shape (batch, n_fields))."""
+    return forward_from_rows(params, dense, params["wide_cat"][cat_ids],
+                             params["emb"][cat_ids])
 
 
 def bce_loss(params, dense, cat_ids, labels, mask):
@@ -192,10 +220,27 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
         C = layout(cat)
         y = layout(labels)
 
+        lazy = bool(self.LAZY_EMB_OPT)
+        routed_mode = self.get(WideDeepParams.ROUTED_EMB_GRAD)
+        route = None
+        if routed_mode == "on" or (routed_mode == "auto" and not lazy):
+            from ...ops.emb_grad import emb_grad_route
+
+            # the epoch tensor C is replayed every epoch, so the
+            # slot->row sort is static — built once here, host-side
+            # (device=False: replicate() below does the one device_put)
+            route = emb_grad_route(C, int(np.sum(vocab_sizes)),
+                                   device=False)
+
         bsh = NamedSharding(mesh, P(None, "data"))
         X = jax.device_put(X, NamedSharding(mesh, P(None, "data", None)))
         C = jax.device_put(C, NamedSharding(mesh, P(None, "data", None)))
         y, mask = jax.device_put(y, bsh), jax.device_put(mask, bsh)
+        route_data = ()
+        if route is not None:
+            route_data = tuple(
+                replicate(a, mesh) for a in (route.order, route.sorted_ids,
+                                             route.out_pos, route.out_ids))
 
         rng = np.random.default_rng(self.get_seed() + 1)  # init-draw stream
         params = replicate(
@@ -203,17 +248,19 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
                         self.EMBEDDING_DIM,
                         self.HIDDEN_UNITS), mesh)
         step_fn, opt_state = _make_train_ops(
-            params, self.LEARNING_RATE, bool(self.LAZY_EMB_OPT))
+            params, self.LEARNING_RATE, lazy, route=route)
         opt_state = replicate(opt_state, mesh)
 
         def epoch_body(state, epoch, data):
-            Xd, Cd, yd, md = data
+            Xd, Cd, yd, md = data[:4]
+            rt = data[4:]
             params, opt_state, loss_log = state
 
             def batch_step(carry, i):
                 params, opt_state = carry
                 params, opt_state, loss = step_fn(
-                    params, opt_state, Xd[i], Cd[i], yd[i], md[i])
+                    params, opt_state, Xd[i], Cd[i], yd[i], md[i],
+                    *(a[i] for a in rt))
                 return (params, opt_state), loss
 
             (params, opt_state), losses = jax.lax.scan(
@@ -225,7 +272,7 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
         max_epochs = self.get_max_iter()
         init_state = (params, opt_state,
                       jnp.full((max_epochs,), jnp.nan, jnp.float32))
-        result = iterate(epoch_body, init_state, (X, C, y, mask),
+        result = iterate(epoch_body, init_state, (X, C, y, mask) + route_data,
                          max_epochs=max_epochs,
                          config=IterationConfig(mode="fused"))
         fitted, _, loss_buf = result.state
@@ -273,6 +320,12 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
         vocab_sizes = self.get_vocab_sizes()
         if vocab_sizes is None:
             raise ValueError("WideDeep requires vocabSizes to be set")
+        if self.get(WideDeepParams.ROUTED_EMB_GRAD) == "on":
+            raise ValueError(
+                "routedEmbeddingGrad='on' cannot apply to the streaming "
+                "fit: its batches are not replayed, so no static route "
+                "exists — use 'auto' (streams on the autodiff scatter) "
+                "or the in-memory fit()")
         mesh = mesh or default_mesh()
         put_fn = (assemble_process_local
                   if mesh_process_count(mesh) > 1 else None)
@@ -421,12 +474,20 @@ class WideDeepModel(WideDeepParams, Model):
 _LAZY_TABLE_KEYS = ("emb", "wide_cat")
 
 
-def _make_train_ops(params, lr: float, lazy: bool,
+def _make_train_ops(params, lr: float, lazy: bool, route=None,
                     b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
     """Build ``(batch_step, opt_state0)`` for the Wide&Deep training loop.
 
     ``lazy=False``: dense ``optax.adam`` over every parameter (the
     reference oracle semantics).
+
+    ``route`` (an ``ops.emb_grad.EmbGradRoute``, dense-Adam only): the
+    returned step takes four extra per-step route arrays
+    (``order, sorted_ids, out_pos, out_ids`` — one step's slice) and
+    computes the embedding/wide-table gradients with the statically-
+    routed scatter instead of autodiff's random-RMW scatter-add; all
+    other gradients and the Adam update are identical.  See the
+    ``routedEmbeddingGrad`` param doc.
 
     ``lazy=True`` (LazyAdam, ``lazyEmbeddingOptimizer``): dense Adam
     touches every row of the ``(total_vocab, emb_dim)`` embedding and
@@ -464,6 +525,48 @@ def _make_train_ops(params, lr: float, lazy: bool,
     visible HBM to measure the crossover)."""
     opt = optax.adam(lr)
     grad_fn = jax.value_and_grad(bce_loss)
+
+    def split(tree):
+        tables = {k: tree[k] for k in _LAZY_TABLE_KEYS}
+        rest = {k: v for k, v in tree.items() if k not in _LAZY_TABLE_KEYS}
+        return tables, rest
+
+    if route is not None:
+        if lazy:
+            raise ValueError(
+                "routed table gradients are a dense-Adam path; disable "
+                "lazyEmbeddingOptimizer or set routedEmbeddingGrad='off'")
+        from ...ops.emb_grad import routed_table_grad
+
+        num_rows, fold_passes = route.num_rows, route.fold_passes
+
+        def batch_step(params, opt_state, dense, cat_ids, labels, mask,
+                       r_order, r_sid, r_pos, r_ids):
+            _, rest = split(params)
+            emb_rows = params["emb"][cat_ids]
+            wide_rows = params["wide_cat"][cat_ids]
+
+            def loss_rows(rest, emb_rows, wide_rows):
+                return logistic_loss(
+                    forward_from_rows(rest, dense, wide_rows, emb_rows),
+                    labels, mask)
+
+            loss, (g_rest, g_emb, g_wide) = jax.value_and_grad(
+                loss_rows, argnums=(0, 1, 2))(rest, emb_rows, wide_rows)
+            emb_dim = emb_rows.shape[-1]
+            grads = {
+                **g_rest,
+                "emb": routed_table_grad(
+                    g_emb.reshape(-1, emb_dim), r_order, r_sid, r_pos,
+                    r_ids, num_rows=num_rows, fold_passes=fold_passes),
+                "wide_cat": routed_table_grad(
+                    g_wide.reshape(-1), r_order, r_sid, r_pos, r_ids,
+                    num_rows=num_rows, fold_passes=fold_passes),
+            }
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return batch_step, opt.init(params)
     if not lazy:
         def batch_step(params, opt_state, dense, cat_ids, labels, mask):
             loss, grads = grad_fn(params, dense, cat_ids, labels, mask)
@@ -471,11 +574,6 @@ def _make_train_ops(params, lr: float, lazy: bool,
             return optax.apply_updates(params, updates), opt_state, loss
 
         return batch_step, opt.init(params)
-
-    def split(tree):
-        tables = {k: tree[k] for k in _LAZY_TABLE_KEYS}
-        rest = {k: v for k, v in tree.items() if k not in _LAZY_TABLE_KEYS}
-        return tables, rest
 
     tables0, rest0 = split(params)
     opt_state0 = {
@@ -523,20 +621,24 @@ def _make_train_ops(params, lr: float, lazy: bool,
 
 def build_reference_train_step(d_dense: int, vocab_sizes, emb_dim: int,
                                hidden, lr: float = 1e-2,
-                               lazy_embeddings: bool = False):
+                               lazy_embeddings: bool = False,
+                               route=None):
     """The unsharded single-device oracle for :func:`build_sharded_train_step`
     — SAME init seed (0), optimizer, and loss, no shardings anywhere.
     Returns (train_step, params, opt_state).  The dp x tp step must
     reproduce this one allclose on loss AND updated params (a wrong
     psum/axis placement still converges, so only exact equivalence catches
     it); asserted by tests/test_widedeep.py and __graft_entry__'s multichip
-    dryrun.  ``lazy_embeddings`` swaps in the LazyAdam table update
-    (see :func:`_make_train_ops`)."""
+    dryrun.  ``lazy_embeddings`` swaps in the LazyAdam table update;
+    ``route`` swaps in the statically-routed table gradients (see
+    :func:`_make_train_ops` — the step then takes four extra per-step
+    route arrays)."""
     params = jax.tree_util.tree_map(
         jnp.asarray,
         init_params(np.random.default_rng(0), d_dense, vocab_sizes, emb_dim,
                     hidden))
-    batch_step, opt_state = _make_train_ops(params, lr, lazy_embeddings)
+    batch_step, opt_state = _make_train_ops(params, lr, lazy_embeddings,
+                                            route=route)
     return jax.jit(batch_step), params, opt_state
 
 

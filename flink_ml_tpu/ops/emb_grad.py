@@ -1,0 +1,172 @@
+"""Statically-routed embedding-gradient scatter — the Wide&Deep backward
+hot path.
+
+Problem: the Wide&Deep backward must form the dense gradient of the
+stacked ``(total_vocab, emb_dim)`` embedding table from per-slot gradient
+rows: ``g_table[cat[b, f]] += g_rows[b, f]`` for ~213k slots per batch at
+the bench shape.  Autodiff lowers this to XLA's general scatter-add —
+one random HBM read-modify-write per slot with conflict handling, which
+the r4 TPU measurement put at ~9.4 of the 18.8 ms step (the backward's
+dominant cost, `R4_TPU_STATUS.md`).
+
+But bounded fits replay the SAME epoch tensor every epoch
+(``models/common/sgd.py`` builds it once), so — exactly as with the LR
+family's ELL kernels (``ops/ell_scatter.py``) — the slot routing is
+**static**: we pay one host sort per fit and turn the per-step scatter
+into four conflict-free streaming stages:
+
+1. ``g_sorted = g_flat[order]`` — a static PERMUTATION gather
+   (``unique_indices=True``: every source row read exactly once),
+2. a segmented suffix-fold (Hillis–Steele) over runs of equal ids:
+   after ``ceil(log2(max_run))`` masked shift-adds, the slot at each
+   run's START holds the full run sum — ``fold_passes`` is static per
+   fit (0 passes when every id in a step is unique),
+3. a compaction pick of the run-start rows at static positions
+   (padded entries read a zero row appended at position ``S``),
+4. ``zeros.at[out_ids].set(run_sums, indices_are_sorted=True,
+   unique_indices=True, mode="drop")`` — with unique ascending indices
+   XLA needs no conflict handling and no read-modify-write; padded
+   entries carry ascending OUT-OF-RANGE sentinels (``num_rows + rank``)
+   so they stay unique and are dropped, never silently aliased.
+
+The result equals the XLA scatter-add up to f32 summation order (runs
+fold pairwise instead of sequentially).  The same route applies to any
+per-slot payload width: the wide tower's ``(total_vocab,)`` scalar
+table reuses it with ``E == 1``.
+
+The reference has no analog — its one DNN-shaped config never existed
+(`/root/reference/flink-ml-lib` ships KMeans only); this is the
+TPU-native replacement for what its keyed-shuffle reduction
+(``flink-ml-lib/.../clustering/kmeans/KMeans.java:172-196``) would have
+had to become at embedding-gradient scale.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["EmbGradRoute", "emb_grad_route", "routed_table_grad"]
+
+
+@dataclass
+class EmbGradRoute:
+    """Static per-step routing for :func:`routed_table_grad`.
+
+    All arrays are per-step stacks (leading dim = steps) so a
+    ``lax.scan`` over steps slices them with one dynamic index.
+    """
+    order: jnp.ndarray       # (steps, S) i32: sort permutation of the
+                             #   flattened (batch*fields) slot ids
+    sorted_ids: jnp.ndarray  # (steps, S) i32: ids in sorted order
+    out_pos: jnp.ndarray     # (steps, U) i32: run-start positions into
+                             #   the sorted axis; pad = S (reads the
+                             #   appended zero row)
+    out_ids: jnp.ndarray     # (steps, U) i32: unique ids per run,
+                             #   ascending; pad = num_rows + rank
+                             #   (unique, out of range -> dropped)
+    fold_passes: int         # static: ceil(log2(max run length)) over
+                             #   every step (0 when all ids unique)
+    num_rows: int            # destination table rows (total vocab)
+
+    @property
+    def steps(self) -> int:
+        return self.order.shape[0]
+
+    def step_slice(self, i):
+        """The per-step arrays for scan bodies: ``(order, sorted_ids,
+        out_pos, out_ids)`` at step ``i`` (dynamic index OK)."""
+        return (self.order[i], self.sorted_ids[i],
+                self.out_pos[i], self.out_ids[i])
+
+
+def emb_grad_route(cat_steps: np.ndarray, num_rows: int,
+                   u_cap: Optional[int] = None,
+                   device: bool = True) -> EmbGradRoute:
+    """Build the static routing from a ``(steps, batch, fields)`` int
+    epoch tensor of (already offset) categorical ids — host numpy, one
+    time per fit.
+
+    ``u_cap`` forces the unique-run capacity (streaming callers whose
+    batches must share one compiled shape); a step with more unique ids
+    raises rather than dropping gradient rows.  ``device=False`` keeps
+    the arrays host numpy for callers that manage their own placement.
+    """
+    cat_steps = np.asarray(cat_steps)
+    steps = cat_steps.shape[0]
+    S = int(np.prod(cat_steps.shape[1:]))
+    orders = np.empty((steps, S), np.int32)
+    sids = np.empty((steps, S), np.int32)
+    starts_list = []
+    max_run = 1
+    for s in range(steps):
+        flat = cat_steps[s].reshape(-1)
+        order = np.argsort(flat, kind="stable").astype(np.int32)
+        sid = flat[order].astype(np.int32)
+        orders[s] = order
+        sids[s] = sid
+        start = np.empty(S, bool)
+        start[0] = True
+        np.not_equal(sid[1:], sid[:-1], out=start[1:])
+        pos = np.flatnonzero(start).astype(np.int32)
+        starts_list.append((pos, sid[pos]))
+        runs = np.diff(np.append(pos, S))
+        max_run = max(max_run, int(runs.max(initial=1)))
+    need_u = max(p.size for p, _ in starts_list)
+    if u_cap is not None and need_u > u_cap:
+        raise ValueError(
+            f"route needs {need_u} unique ids in some step > forced "
+            f"u_cap {u_cap}; gradient rows would silently drop — raise "
+            "the cap")
+    U = u_cap if u_cap is not None else need_u
+    out_pos = np.full((steps, U), S, np.int32)
+    # pad ids: ascending out-of-range sentinels — unique (the scatter's
+    # unique_indices claim stays true) and dropped by mode="drop"
+    out_ids = (num_rows
+               + np.arange(U, dtype=np.int32)[None, :].repeat(steps, 0))
+    for s, (pos, uids) in enumerate(starts_list):
+        out_pos[s, :pos.size] = pos
+        out_ids[s, :uids.size] = uids
+    wrap = jnp.asarray if device else np.asarray
+    return EmbGradRoute(
+        order=wrap(orders), sorted_ids=wrap(sids),
+        out_pos=wrap(out_pos), out_ids=wrap(out_ids),
+        fold_passes=max(0, int(np.ceil(np.log2(max_run)))) if max_run > 1
+        else 0,
+        num_rows=num_rows)
+
+
+def routed_table_grad(g_flat: jnp.ndarray, order: jnp.ndarray,
+                      sorted_ids: jnp.ndarray, out_pos: jnp.ndarray,
+                      out_ids: jnp.ndarray, *, num_rows: int,
+                      fold_passes: int) -> jnp.ndarray:
+    """The dense ``(num_rows, E)`` table gradient from per-slot rows
+    ``g_flat (S, E)`` via one step's route slice (see module doc for the
+    four stages).  Equals ``zeros.at[ids].add(g_flat)`` up to f32
+    summation order.  ``num_rows``/``fold_passes`` are static."""
+    squeeze = g_flat.ndim == 1
+    if squeeze:
+        g_flat = g_flat[:, None]
+    S, E = g_flat.shape
+    g = jnp.take(g_flat, order, axis=0, unique_indices=True)
+    # segmented suffix-fold: after pass k (offset 2^k), g[i] holds the
+    # sum of the sorted rows i .. min(run_end, i + 2^(k+1) - 1)
+    offs = 1
+    for _ in range(fold_passes):
+        same = jnp.concatenate(
+            [sorted_ids[offs:] == sorted_ids[:-offs],
+             jnp.zeros((offs,), bool)])
+        shifted = jnp.concatenate(
+            [g[offs:], jnp.zeros((offs, E), g.dtype)], axis=0)
+        g = g + jnp.where(same[:, None], shifted, 0.0)
+        offs *= 2
+    g_ext = jnp.concatenate([g, jnp.zeros((1, E), g.dtype)], axis=0)
+    run_sums = jnp.take(g_ext, out_pos, axis=0, unique_indices=True)
+    out = jnp.zeros((num_rows, E), g.dtype).at[out_ids].set(
+        run_sums, indices_are_sorted=True, unique_indices=True,
+        mode="drop")
+    return out[:, 0] if squeeze else out

@@ -1,0 +1,106 @@
+"""Statically-routed table-gradient scatter (ops/emb_grad.py) vs the
+XLA scatter-add oracle."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from flink_ml_tpu.ops.emb_grad import emb_grad_route, routed_table_grad
+
+
+def _oracle(ids, g, num_rows):
+    out = np.zeros((num_rows, g.shape[-1]), np.float64)
+    np.add.at(out, ids.reshape(-1), g.reshape(-1, g.shape[-1]))
+    return out.astype(np.float32)
+
+
+def _routed(route, s, g_flat):
+    o, sid, op, oi = (np.asarray(a) for a in route.step_slice(s))
+    return np.asarray(routed_table_grad(
+        jnp.asarray(g_flat), jnp.asarray(o), jnp.asarray(sid),
+        jnp.asarray(op), jnp.asarray(oi), num_rows=route.num_rows,
+        fold_passes=route.fold_passes))
+
+
+@pytest.mark.parametrize("emb_dim", [1, 8])
+def test_matches_scatter_add_oracle(emb_dim):
+    rng = np.random.default_rng(0)
+    steps, batch, fields, vocab = 3, 64, 5, 200
+    cat = rng.integers(0, vocab, size=(steps, batch, fields), dtype=np.int64)
+    route = emb_grad_route(cat, vocab)
+    for s in range(steps):
+        g = rng.normal(size=(batch * fields, emb_dim)).astype(np.float32)
+        got = _routed(route, s, g)
+        np.testing.assert_allclose(got, _oracle(cat[s], g, vocab),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_scalar_payload_squeezes():
+    rng = np.random.default_rng(1)
+    cat = rng.integers(0, 50, size=(1, 32, 4), dtype=np.int64)
+    route = emb_grad_route(cat, 50)
+    g = rng.normal(size=(32 * 4,)).astype(np.float32)
+    got = _routed(route, 0, g)
+    assert got.shape == (50,)
+    np.testing.assert_allclose(got, _oracle(cat[0], g[:, None], 50)[:, 0],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_heavy_run_and_all_unique_edges():
+    rng = np.random.default_rng(2)
+    batch, fields, vocab = 128, 4, 4096
+    # step 0: one id floods half the slots (deep fold); step 1: all
+    # distinct ids (the fold must still pass with runs of length 1)
+    heavy = rng.integers(0, vocab, size=(batch, fields), dtype=np.int64)
+    heavy.reshape(-1)[: batch * fields // 2] = 7
+    uniq = np.arange(batch * fields, dtype=np.int64).reshape(batch, fields)
+    cat = np.stack([heavy, uniq])
+    route = emb_grad_route(cat, vocab)
+    assert route.fold_passes >= 8
+    for s in range(2):
+        g = rng.normal(size=(batch * fields, 3)).astype(np.float32)
+        np.testing.assert_allclose(_routed(route, s, g),
+                                   _oracle(cat[s], g, vocab),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_all_same_id():
+    cat = np.zeros((1, 16, 2), np.int64)
+    route = emb_grad_route(cat, 10)
+    g = np.ones((32, 2), np.float32)
+    got = _routed(route, 0, g)
+    expected = np.zeros((10, 2), np.float32)
+    expected[0] = 32.0
+    np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+def test_u_cap_pads_and_rejects():
+    rng = np.random.default_rng(3)
+    cat = rng.integers(0, 30, size=(2, 16, 2), dtype=np.int64)
+    need = max(len(np.unique(cat[s])) for s in range(2))
+    route = emb_grad_route(cat, 30, u_cap=need + 5)
+    assert route.out_ids.shape[1] == need + 5
+    # padded sentinel ids are unique and ascending (the scatter's
+    # indices_are_sorted + unique_indices claims must stay true)
+    oi = np.asarray(route.out_ids)
+    assert all(np.all(np.diff(oi[s]) > 0) for s in range(2))
+    g = rng.normal(size=(32, 4)).astype(np.float32)
+    np.testing.assert_allclose(_routed(route, 0, g),
+                               _oracle(cat[0], g, 30), rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError, match="u_cap"):
+        emb_grad_route(cat, 30, u_cap=need - 1)
+
+
+def test_route_shapes_shared_across_steps():
+    rng = np.random.default_rng(4)
+    # step 0 has many fewer unique ids than step 1 — shapes must match
+    cat0 = rng.integers(0, 4, size=(16, 3), dtype=np.int64)
+    cat1 = rng.integers(0, 1000, size=(16, 3), dtype=np.int64)
+    route = emb_grad_route(np.stack([cat0, cat1]), 1000)
+    assert route.out_pos.shape == route.out_ids.shape
+    for s, c in enumerate([cat0, cat1]):
+        g = rng.normal(size=(48, 2)).astype(np.float32)
+        np.testing.assert_allclose(_routed(route, s, g),
+                                   _oracle(c, g, 1000),
+                                   rtol=1e-5, atol=1e-5)

@@ -354,3 +354,53 @@ def test_fit_outofcore_empty_reader_rejected():
     with pytest.raises(ValueError, match="empty epoch"):
         (WideDeep().set_vocab_sizes([4]).set_max_iter(2)
          .fit_outofcore(lambda: iter([])))
+
+
+# ------------------------------------------------- routed table gradients
+
+
+def test_routed_fit_matches_dense_scatter_fit():
+    """routedEmbeddingGrad='auto' (the fit() default) must reproduce the
+    autodiff-scatter fit up to f32 summation order: same loss log, same
+    final params."""
+    t = _ctr_table()
+    base = (WideDeep().set_vocab_sizes([10, 7]).set_max_iter(8)
+            .set_seed(0))
+    m_routed = base.fit(t)                       # default: auto -> routed
+    m_dense = (WideDeep().set_vocab_sizes([10, 7]).set_max_iter(8)
+               .set_seed(0).set(WideDeep.ROUTED_EMB_GRAD, "off").fit(t))
+    np.testing.assert_allclose(m_routed._loss_log, m_dense._loss_log,
+                               rtol=1e-5, atol=1e-6)
+    for k in ("emb", "wide_cat", "wide_dense", "wide_b"):
+        np.testing.assert_allclose(np.asarray(m_routed._params[k]),
+                                   np.asarray(m_dense._params[k]),
+                                   rtol=1e-4, atol=1e-5)
+    for lr, ld in zip(m_routed._params["mlp"], m_dense._params["mlp"]):
+        np.testing.assert_allclose(np.asarray(lr["w"]), np.asarray(ld["w"]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_routed_on_rejects_lazy():
+    t = _ctr_table(n=64)
+    est = (WideDeep().set_vocab_sizes([10, 7]).set_max_iter(2)
+           .set(WideDeep.LAZY_EMB_OPT, True)
+           .set(WideDeep.ROUTED_EMB_GRAD, "on"))
+    with pytest.raises(ValueError, match="dense-Adam"):
+        est.fit(t)
+
+
+def test_routed_auto_defers_to_lazy():
+    """'auto' + lazyEmbeddingOptimizer trains on the lazy path (no
+    conflict), and still converges."""
+    t = _ctr_table()
+    model = (WideDeep().set_vocab_sizes([10, 7]).set_max_iter(8)
+             .set_seed(0).set(WideDeep.LAZY_EMB_OPT, True).fit(t))
+    out = model.transform(t)[0]
+    assert np.mean(out["prediction"] == t["label"]) > 0.85
+
+
+def test_routed_on_rejected_by_streaming_fit(tmp_path):
+    est = (WideDeep().set_vocab_sizes([10, 7]).set_max_iter(2)
+           .set(WideDeep.ROUTED_EMB_GRAD, "on"))
+    with pytest.raises(ValueError, match="streaming"):
+        est.fit_outofcore(lambda: iter(()))
