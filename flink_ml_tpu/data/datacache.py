@@ -502,6 +502,14 @@ class ShuffledCacheReader:
     def seek(self, cursor: int) -> None:
         if not 0 <= cursor <= self.total_rows:
             raise ValueError(f"cursor {cursor} out of range")
+        if cursor < self.total_rows and cursor % self.batch_rows:
+            # this class's cursor protocol only ever produces visit
+            # boundaries (or total_rows); silently flooring an arbitrary
+            # row position would drop up to batch_rows-1 rows (ADVICE r4)
+            raise ValueError(
+                f"cursor {cursor} is not a visit boundary (multiple of "
+                f"batch_rows={self.batch_rows}) or total_rows; "
+                "ShuffledCacheReader seeks by whole visits")
         self._visit = (len(self._order) if cursor >= self.total_rows
                        else cursor // self.batch_rows)
 

@@ -76,6 +76,28 @@ def _is_disk_backed(a) -> bool:
     return False
 
 
+def _retained(a: np.ndarray) -> np.ndarray:
+    """The array as the cache should hold it.  Disk-backed views are
+    materialized (the budget must count real RAM and replay must not
+    fault pages back in).  RAM views whose ultimate base is more than
+    2x the view's bytes are COPIED: zero-copy retention would keep the
+    whole base alive while the budget counts only the view (ADVICE r4).
+    Exact-sized views and decode-fresh arrays stay zero-copy."""
+    if _is_disk_backed(a):
+        return np.array(a)
+    a = np.asarray(a)
+    # walk to the OUTERMOST ndarray in the base chain: for frombuffer
+    # arrays the chain ends in a non-ndarray buffer (bytes, mmap), and
+    # that outermost ndarray spans it — comparing its nbytes still
+    # detects the small-view-of-big-buffer case
+    base = a
+    while isinstance(base.base, np.ndarray):
+        base = base.base
+    if base is not a and base.nbytes > 2 * a.nbytes:
+        return np.array(a)
+    return a
+
+
 def batch_fingerprint(batch) -> bytes:
     """Order-stable digest of a raw host batch (a dict of arrays, or any
     sequence of arrays).  Used by the replay guard in
@@ -115,6 +137,13 @@ class DecodedReplayCache:
         # digest of the recording epoch's first RAW batch (pre-decode),
         # set by the recording caller; replay guards compare against it
         self.fingerprint: Optional[bytes] = None
+        # additional raw digests at power-of-two stream indices (set by
+        # the recording caller): replay guards on SEEKABLE readers probe
+        # the largest recorded index <= n_batches-1 as a second,
+        # mid-stream determinism check — a one-batch digest cannot catch
+        # a reader that shuffles everything after its first batch
+        # (ADVICE r4).  Distinct keys per writer; dict ops are atomic.
+        self.probe_fingerprints: Dict[int, bytes] = {}
         # block-keyed mode: the first cached block's id — later epochs
         # re-digest that block's raw bytes to catch readers that violate
         # the per-block-determinism contract
@@ -136,9 +165,7 @@ class DecodedReplayCache:
         in from disk."""
         if self._full or self._prefix is not None:
             return
-        stored = tuple(
-            np.array(a) if _is_disk_backed(a) else np.asarray(a)
-            for a in arrays)
+        stored = tuple(_retained(a) for a in arrays)
         size = sum(int(a.nbytes) for a in stored)
         with self._lock:
             if self._full:

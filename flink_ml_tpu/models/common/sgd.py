@@ -1446,6 +1446,11 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                 # digest the raw (pre-decode) batch: the replay guard
                 # re-reads batch 0 on later epochs and compares
                 _rec_cache[0].fingerprint = batch_fingerprint(item[2])
+            elif item[1] & (item[1] - 1) == 0:
+                # power-of-two indices: cheap (log n hashes) mid-stream
+                # anchors for the seekable replay guard's second probe
+                _rec_cache[0].probe_fingerprints[item[1]] = \
+                    batch_fingerprint(item[2])
             host = to_host_batch(item[2])
             _rec_cache[0].offer(item[1], host)
             return host
@@ -1546,10 +1551,24 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                 source = block_source()
             else:
                 # seekless block reader: sequential read + discard for
-                # hits (the protocol does not require seek)
-                source = (("blk", bid, b)
-                          for bid, b in zip(trimmed,
-                                            _seek_or_skip(reader, skip)))
+                # hits (the protocol does not require seek).  The count
+                # check makes a short epoch loud (ADVICE r4): zip would
+                # silently truncate if the reader yields fewer batches
+                # than block_order promises.
+                def counted_blocks(reader=reader, trimmed=trimmed,
+                                   skip=skip):
+                    n = 0
+                    for bid, b in zip(trimmed, _seek_or_skip(reader, skip)):
+                        n += 1
+                        yield ("blk", bid, b)
+                    if n < len(trimmed):
+                        raise ValueError(
+                            f"block-addressable reader yielded {n} "
+                            f"batches but block_order promises "
+                            f"{len(trimmed)}; the epoch would silently "
+                            "train on fewer blocks")
+
+                source = counted_blocks()
         else:
             replay_ok = replay_cache is not None and replay_cache.ready
             if replay_ok and cache_decoded == "auto":
@@ -1563,14 +1582,33 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                 reader = _reader_for_epoch(make_reader, epoch)
                 probe_it = iter(reader)
                 probe_first = next(probe_it, None)
+                probe_mismatch = False
                 # re-position the probed reader at batch 0 either way
                 if hasattr(reader, "seek") and hasattr(reader, "batch_rows"):
+                    # seekable: also probe a deterministic MID-STREAM
+                    # batch (ADVICE r4) — the largest power-of-two index
+                    # the recorder digested.  A one-batch guard misses a
+                    # reader that keeps batch 0 stable but shuffles the
+                    # rest; seek makes the second probe nearly free.
+                    mid_candidates = [
+                        i for i in replay_cache.probe_fingerprints
+                        if replay_cache.n_batches is None
+                        or i < replay_cache.n_batches]
+                    if mid_candidates:
+                        mid = max(mid_candidates)
+                        reader.seek(mid * int(reader.batch_rows))
+                        probe_mid = next(iter(reader), None)
+                        probe_mismatch = (
+                            probe_mid is None
+                            or batch_fingerprint(probe_mid)
+                            != replay_cache.probe_fingerprints[mid])
                     reader.seek(0)
                 else:
                     # generator-shaped reader: re-chain the consumed batch
                     reader = itertools.chain(
                         [] if probe_first is None else [probe_first], probe_it)
-                if (probe_first is None or replay_cache.fingerprint is None
+                if (probe_mismatch or probe_first is None
+                        or replay_cache.fingerprint is None
                         or batch_fingerprint(probe_first)
                         != replay_cache.fingerprint):
                     # one-way latch: this reader varies per epoch, so a

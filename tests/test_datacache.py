@@ -318,3 +318,22 @@ def test_shuffled_reader_seek_cursor_roundtrip(tmp_path):
         np.testing.assert_array_equal(a, b)
     r2.seek(r2.total_rows)
     assert r2.read_batch() is None
+
+
+def test_shuffled_reader_seek_rejects_non_boundary(tmp_path):
+    """ShuffledCacheReader's cursor protocol only produces visit
+    boundaries (or total_rows); an arbitrary row cursor used to be
+    silently floored, losing up to batch_rows-1 rows (ADVICE r4)."""
+    from flink_ml_tpu.data.datacache import (
+        DataCacheWriter, ShuffledCacheReader)
+
+    cache = str(tmp_path / "c")
+    w = DataCacheWriter(cache, segment_rows=256)
+    w.append({"x": np.arange(1000, dtype=np.float32)})
+    w.finish()
+    r = ShuffledCacheReader(cache, batch_rows=256, seed=3)
+    r.seek(512)                    # visit boundary: fine
+    assert r.cursor == 512
+    r.seek(1000)                   # total_rows (ragged end): fine
+    with pytest.raises(ValueError, match="visit boundary"):
+        r.seek(300)

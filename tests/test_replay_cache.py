@@ -448,3 +448,98 @@ def test_mixed_ell_stream_cached_matches_uncached(tmp_path):
     assert info_on["decoded_cache_batches"] == 8
     assert info_on["impl"] == info_off["impl"]
     np.testing.assert_array_equal(s_on.coefficients, s_off.coefficients)
+
+
+class _TailShuffleReader(_EpochVaryingReader):
+    """Keeps batch 0 IDENTICAL every epoch but permutes the tail — the
+    adversary the one-batch guard cannot see (ADVICE r4).  Seekable, so
+    the guard's second mid-stream probe must catch it."""
+
+    def __init__(self, X, y, batch_rows, perm):
+        keep = np.arange(batch_rows)
+        tail = batch_rows + perm
+        super().__init__(X, y, batch_rows,
+                         np.concatenate([keep, tail]))
+
+
+def test_guard_mid_probe_catches_tail_shuffle():
+    """A seekable reader whose first batch is epoch-stable but whose tail
+    reshuffles: the second (mid-stream) probe drops the cache, so the fit
+    equals the uncached fit instead of training on frozen epoch-0
+    batches."""
+    rng = np.random.default_rng(13)
+    true_w = rng.normal(size=8)
+    X = rng.normal(size=(1024, 8)).astype(np.float32)
+    y = (X @ true_w > 0).astype(np.float32)
+
+    def run(cache_mode):
+        perms = iter(np.random.default_rng(37).permuted(
+            np.tile(np.arange(1024 - 256), (4, 1)), axis=1))
+        info = {}
+        state, log = sgd_fit_outofcore(
+            logistic_loss,
+            lambda: _TailShuffleReader(X, y, 256, next(perms)),
+            num_features=8,
+            config=SGDConfig(learning_rate=0.5, max_epochs=4, tol=0.0),
+            cache_decoded=cache_mode, stream_info=info)
+        return state, log, info
+
+    s_off, log_off, _ = run(False)
+    s_auto, log_auto, info = run("auto")
+    np.testing.assert_array_equal(s_auto.coefficients, s_off.coefficients)
+    assert log_auto == log_off
+    assert info["decoded_cache_guard_tripped"] is True
+
+
+def test_offer_copies_small_views_of_large_bases():
+    """A cached entry that is a small view of a big RAM buffer must not
+    retain the base (the budget would count the view's bytes while real
+    RAM held the whole base, ADVICE r4); exact-sized arrays stay
+    zero-copy."""
+    cache = DecodedReplayCache(4 << 20)
+    big = np.arange(1 << 18, dtype=np.float32)     # 1 MB base
+    view = big[:16]                                 # 64 B view
+    fresh = np.arange(64, dtype=np.float32)         # no base
+    cache.offer(0, (view, fresh))
+    stored_view, stored_fresh = cache._entries[0]
+    assert stored_view.base is None                 # copied off the base
+    np.testing.assert_array_equal(stored_view, view)
+    assert stored_fresh is fresh                    # zero-copy kept
+    # a view that IS most of its base stays zero-copy (no silent 2x RAM)
+    most = big[: (1 << 18) - 8]
+    cache.offer(1, (most,))
+    assert cache._entries[1][0].base is big
+
+
+class _ShortBlockReader:
+    """Declares a block_order it does not honor: yields one batch fewer —
+    the silent-truncation adversary (ADVICE r4).  Seekless on purpose."""
+
+    epoch_varying = True
+
+    def __init__(self, X, y, batch_rows, epoch):
+        self.batch_rows = batch_rows
+        self.total_rows = len(y)
+        order = np.random.default_rng(epoch).permutation(
+            len(y) // batch_rows)
+        self.block_order = tuple(int(b) for b in order)
+        self.X, self.y = X, y
+
+    def __iter__(self):
+        for b in self.block_order[:-1]:             # one short
+            s = b * self.batch_rows
+            yield {"features": self.X[s:s + self.batch_rows],
+                   "label": self.y[s:s + self.batch_rows]}
+
+
+def test_block_mode_short_epoch_raises():
+    rng = np.random.default_rng(17)
+    X = rng.normal(size=(1024, 8)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    with pytest.raises(ValueError, match="block_order promises"):
+        sgd_fit_outofcore(
+            logistic_loss,
+            lambda epoch: _ShortBlockReader(X, y, 256, epoch),
+            num_features=8,
+            config=SGDConfig(learning_rate=0.5, max_epochs=2, tol=0.0),
+            cache_decoded="auto")
