@@ -228,9 +228,11 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
 
             # the epoch tensor C is replayed every epoch, so the
             # slot->row sort is static — built once here, host-side
-            # (device=False: replicate() below does the one device_put)
+            # (device=False: replicate() below does the one device_put;
+            # placement="auto": gather until the inverse map outgrows
+            # its budget at large vocab x many steps, then scatter)
             route = emb_grad_route(C, int(np.sum(vocab_sizes)),
-                                   device=False)
+                                   device=False, placement="auto")
 
         bsh = NamedSharding(mesh, P(None, "data"))
         X = jax.device_put(X, NamedSharding(mesh, P(None, "data", None)))
@@ -238,9 +240,8 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
         y, mask = jax.device_put(y, bsh), jax.device_put(mask, bsh)
         route_data = ()
         if route is not None:
-            route_data = tuple(
-                replicate(a, mesh) for a in (route.order, route.sorted_ids,
-                                             route.out_pos, route.out_ids))
+            route_data = tuple(replicate(a, mesh)
+                               for a in route.stacked_arrays())
 
         rng = np.random.default_rng(self.get_seed() + 1)  # init-draw stream
         params = replicate(
@@ -536,12 +537,8 @@ def _make_train_ops(params, lr: float, lazy: bool, route=None,
             raise ValueError(
                 "routed table gradients are a dense-Adam path; disable "
                 "lazyEmbeddingOptimizer or set routedEmbeddingGrad='off'")
-        from ...ops.emb_grad import routed_table_grad
-
-        num_rows, fold_passes = route.num_rows, route.fold_passes
-
         def batch_step(params, opt_state, dense, cat_ids, labels, mask,
-                       r_order, r_sid, r_pos, r_ids):
+                       *route_arrays):
             _, rest = split(params)
             emb_rows = params["emb"][cat_ids]
             wide_rows = params["wide_cat"][cat_ids]
@@ -556,12 +553,10 @@ def _make_train_ops(params, lr: float, lazy: bool, route=None,
             emb_dim = emb_rows.shape[-1]
             grads = {
                 **g_rest,
-                "emb": routed_table_grad(
-                    g_emb.reshape(-1, emb_dim), r_order, r_sid, r_pos,
-                    r_ids, num_rows=num_rows, fold_passes=fold_passes),
-                "wide_cat": routed_table_grad(
-                    g_wide.reshape(-1), r_order, r_sid, r_pos, r_ids,
-                    num_rows=num_rows, fold_passes=fold_passes),
+                "emb": route.apply(g_emb.reshape(-1, emb_dim),
+                                   *route_arrays),
+                "wide_cat": route.apply(g_wide.reshape(-1),
+                                        *route_arrays),
             }
             updates, opt_state = opt.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss

@@ -21,13 +21,25 @@ into four conflict-free streaming stages:
    after ``ceil(log2(max_run))`` masked shift-adds, the slot at each
    run's START holds the full run sum — ``fold_passes`` is static per
    fit (0 passes when every id in a step is unique),
-3. a compaction pick of the run-start rows at static positions
-   (padded entries read a zero row appended at position ``S``),
-4. ``zeros.at[out_ids].set(run_sums, indices_are_sorted=True,
-   unique_indices=True, mode="drop")`` — with unique ascending indices
-   XLA needs no conflict handling and no read-modify-write; padded
-   entries carry ascending OUT-OF-RANGE sentinels (``num_rows + rank``)
-   so they stay unique and are dropped, never silently aliased.
+3. placement of the run sums into the dense table, in one of two forms
+   chosen at route-build time:
+
+   - ``placement="gather"`` (default): ``dense = g_folded_ext[pos_map]``
+     — a per-step static INVERSE map (``pos_map[v]`` = sorted position
+     of vocab row ``v``'s run start, or ``S`` for untouched rows, which
+     reads the appended zero row).  NO scatter exists anywhere in the
+     step: the dense gradient is one streaming row-gather, which XLA
+     lowers far better than any scatter and fuses into the Adam
+     consumer.  Costs ``steps x num_rows`` i32 of route storage.
+   - ``placement="scatter"``: compaction pick of run-start rows at
+     static positions, then ``zeros.at[out_ids].set(run_sums,
+     indices_are_sorted=True, unique_indices=True, mode="drop")`` —
+     with unique ascending indices XLA needs no conflict handling and
+     no read-modify-write; padded entries carry ascending OUT-OF-RANGE
+     sentinels (``num_rows + rank``) so they stay unique and are
+     dropped, never silently aliased.  Route storage stays
+     ``O(slots)``, for vocabularies so large the inverse map would not
+     fit.
 
 The result equals the XLA scatter-add up to f32 summation order (runs
 fold pairwise instead of sequentially).  The same route applies to any
@@ -50,55 +62,104 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["EmbGradRoute", "emb_grad_route", "routed_table_grad"]
+__all__ = ["EmbGradRoute", "emb_grad_route", "routed_table_grad",
+           "routed_table_grad_gather"]
+
+#: placement="auto" picks gather until the inverse map would cost more
+#: than this (steps x num_rows x 4 bytes of route storage), then falls
+#: back to the O(slots) scatter placement.
+_POS_MAP_BUDGET_BYTES = 512 << 20
 
 
 @dataclass
 class EmbGradRoute:
-    """Static per-step routing for :func:`routed_table_grad`.
+    """Static per-step routing for :func:`routed_table_grad` /
+    :func:`routed_table_grad_gather`.
 
     All arrays are per-step stacks (leading dim = steps) so a
     ``lax.scan`` over steps slices them with one dynamic index.
+    Exactly one of the placement array groups is populated — ``pos_map``
+    for ``placement="gather"``, ``out_pos``/``out_ids`` for
+    ``placement="scatter"``.
     """
     order: jnp.ndarray       # (steps, S) i32: sort permutation of the
                              #   flattened (batch*fields) slot ids
     sorted_ids: jnp.ndarray  # (steps, S) i32: ids in sorted order
-    out_pos: jnp.ndarray     # (steps, U) i32: run-start positions into
-                             #   the sorted axis; pad = S (reads the
-                             #   appended zero row)
-    out_ids: jnp.ndarray     # (steps, U) i32: unique ids per run,
-                             #   ascending; pad = num_rows + rank
-                             #   (unique, out of range -> dropped)
     fold_passes: int         # static: ceil(log2(max run length)) over
                              #   every step (0 when all ids unique)
     num_rows: int            # destination table rows (total vocab)
+    placement: str = "gather"
+    # gather placement:
+    pos_map: Optional[jnp.ndarray] = None  # (steps, num_rows) i32:
+                             #   run-start position of each vocab row's
+                             #   run, S for untouched rows (zero row)
+    # scatter placement:
+    out_pos: Optional[jnp.ndarray] = None  # (steps, U) i32: run-start
+                             #   positions into the sorted axis; pad = S
+                             #   (reads the appended zero row)
+    out_ids: Optional[jnp.ndarray] = None  # (steps, U) i32: unique ids
+                             #   per run, ascending; pad = num_rows +
+                             #   rank (unique, out of range -> dropped)
 
     @property
     def steps(self) -> int:
         return self.order.shape[0]
 
+    def stacked_arrays(self):
+        """The per-step array stack a scan body threads through (order
+        matches :meth:`step_slice`)."""
+        if self.placement == "gather":
+            return (self.order, self.sorted_ids, self.pos_map)
+        return (self.order, self.sorted_ids, self.out_pos, self.out_ids)
+
     def step_slice(self, i):
-        """The per-step arrays for scan bodies: ``(order, sorted_ids,
-        out_pos, out_ids)`` at step ``i`` (dynamic index OK)."""
-        return (self.order[i], self.sorted_ids[i],
-                self.out_pos[i], self.out_ids[i])
+        """The per-step arrays for scan bodies at step ``i`` (dynamic
+        index OK)."""
+        return tuple(a[i] for a in self.stacked_arrays())
+
+    def apply(self, g_flat, *step_arrays):
+        """Dense table gradient from one step's slice (either
+        placement)."""
+        if self.placement == "gather":
+            order, sid, pos_map = step_arrays
+            return routed_table_grad_gather(
+                g_flat, order, sid, pos_map,
+                fold_passes=self.fold_passes)
+        order, sid, out_pos, out_ids = step_arrays
+        return routed_table_grad(
+            g_flat, order, sid, out_pos, out_ids,
+            num_rows=self.num_rows, fold_passes=self.fold_passes)
 
 
 def emb_grad_route(cat_steps: np.ndarray, num_rows: int,
                    u_cap: Optional[int] = None,
-                   device: bool = True) -> EmbGradRoute:
+                   device: bool = True,
+                   placement: str = "gather") -> EmbGradRoute:
     """Build the static routing from a ``(steps, batch, fields)`` int
     epoch tensor of (already offset) categorical ids — host numpy, one
     time per fit.
 
-    ``u_cap`` forces the unique-run capacity (streaming callers whose
-    batches must share one compiled shape); a step with more unique ids
-    raises rather than dropping gradient rows.  ``device=False`` keeps
-    the arrays host numpy for callers that manage their own placement.
+    ``placement`` picks how run sums land in the dense table (see module
+    doc): ``"gather"`` (default — scatter-free, ``steps x num_rows``
+    route storage) or ``"scatter"`` (``O(slots)`` storage).  ``u_cap``
+    (scatter placement) forces the unique-run capacity for streaming
+    callers whose batches must share one compiled shape; a step with
+    more unique ids raises rather than dropping gradient rows.
+    ``device=False`` keeps the arrays host numpy for callers that manage
+    their own placement.
     """
+    if placement not in ("auto", "gather", "scatter"):
+        raise ValueError(f"unknown placement {placement!r}")
     cat_steps = np.asarray(cat_steps)
     steps = cat_steps.shape[0]
     S = int(np.prod(cat_steps.shape[1:]))
+    if placement == "auto":
+        # gather's inverse map costs steps x num_rows i32 — the right
+        # trade until it rivals the epoch data itself; past the budget
+        # (large vocab x many steps) fall back to O(slots) scatter
+        placement = ("gather"
+                     if steps * num_rows * 4 <= _POS_MAP_BUDGET_BYTES
+                     else "scatter")
     orders = np.empty((steps, S), np.int32)
     sids = np.empty((steps, S), np.int32)
     starts_list = []
@@ -116,12 +177,26 @@ def emb_grad_route(cat_steps: np.ndarray, num_rows: int,
         starts_list.append((pos, sid[pos]))
         runs = np.diff(np.append(pos, S))
         max_run = max(max_run, int(runs.max(initial=1)))
+    fold_passes = (max(0, int(np.ceil(np.log2(max_run))))
+                   if max_run > 1 else 0)
+    wrap = jnp.asarray if device else np.asarray
+    # the u_cap contract holds for BOTH placements (a caller-forced cap
+    # must never be silently ignored); gather just has no U-shaped
+    # arrays to size with it
     need_u = max(p.size for p, _ in starts_list)
     if u_cap is not None and need_u > u_cap:
         raise ValueError(
             f"route needs {need_u} unique ids in some step > forced "
             f"u_cap {u_cap}; gradient rows would silently drop — raise "
             "the cap")
+    if placement == "gather":
+        pos_map = np.full((steps, num_rows), S, np.int32)
+        for s, (pos, uids) in enumerate(starts_list):
+            pos_map[s][uids] = pos
+        return EmbGradRoute(
+            order=wrap(orders), sorted_ids=wrap(sids),
+            pos_map=wrap(pos_map), fold_passes=fold_passes,
+            num_rows=num_rows, placement="gather")
     U = u_cap if u_cap is not None else need_u
     out_pos = np.full((steps, U), S, np.int32)
     # pad ids: ascending out-of-range sentinels — unique (the scatter's
@@ -131,30 +206,23 @@ def emb_grad_route(cat_steps: np.ndarray, num_rows: int,
     for s, (pos, uids) in enumerate(starts_list):
         out_pos[s, :pos.size] = pos
         out_ids[s, :uids.size] = uids
-    wrap = jnp.asarray if device else np.asarray
     return EmbGradRoute(
         order=wrap(orders), sorted_ids=wrap(sids),
         out_pos=wrap(out_pos), out_ids=wrap(out_ids),
-        fold_passes=max(0, int(np.ceil(np.log2(max_run)))) if max_run > 1
-        else 0,
-        num_rows=num_rows)
+        fold_passes=fold_passes, num_rows=num_rows, placement="scatter")
 
 
-def routed_table_grad(g_flat: jnp.ndarray, order: jnp.ndarray,
-                      sorted_ids: jnp.ndarray, out_pos: jnp.ndarray,
-                      out_ids: jnp.ndarray, *, num_rows: int,
-                      fold_passes: int) -> jnp.ndarray:
-    """The dense ``(num_rows, E)`` table gradient from per-slot rows
-    ``g_flat (S, E)`` via one step's route slice (see module doc for the
-    four stages).  Equals ``zeros.at[ids].add(g_flat)`` up to f32
-    summation order.  ``num_rows``/``fold_passes`` are static."""
+def _folded_ext(g_flat, order, sorted_ids, fold_passes):
+    """Stages 1-2 shared by both placements: static permutation gather,
+    then the segmented suffix-fold — after pass k (offset 2^k), g[i]
+    holds the sum of the sorted rows i .. min(run_end, i + 2^(k+1) - 1).
+    Returns ``(g_ext, squeeze)`` where ``g_ext (S+1, E)`` carries an
+    appended zero row (position ``S`` — what padded picks read)."""
     squeeze = g_flat.ndim == 1
     if squeeze:
         g_flat = g_flat[:, None]
     S, E = g_flat.shape
     g = jnp.take(g_flat, order, axis=0, unique_indices=True)
-    # segmented suffix-fold: after pass k (offset 2^k), g[i] holds the
-    # sum of the sorted rows i .. min(run_end, i + 2^(k+1) - 1)
     offs = 1
     for _ in range(fold_passes):
         same = jnp.concatenate(
@@ -164,9 +232,35 @@ def routed_table_grad(g_flat: jnp.ndarray, order: jnp.ndarray,
             [g[offs:], jnp.zeros((offs, E), g.dtype)], axis=0)
         g = g + jnp.where(same[:, None], shifted, 0.0)
         offs *= 2
-    g_ext = jnp.concatenate([g, jnp.zeros((1, E), g.dtype)], axis=0)
+    return jnp.concatenate([g, jnp.zeros((1, E), g.dtype)], axis=0), \
+        squeeze
+
+
+def routed_table_grad(g_flat: jnp.ndarray, order: jnp.ndarray,
+                      sorted_ids: jnp.ndarray, out_pos: jnp.ndarray,
+                      out_ids: jnp.ndarray, *, num_rows: int,
+                      fold_passes: int) -> jnp.ndarray:
+    """The dense ``(num_rows, E)`` table gradient from per-slot rows
+    ``g_flat (S, E)`` via one step's route slice, SCATTER placement (see
+    module doc).  Equals ``zeros.at[ids].add(g_flat)`` up to f32
+    summation order.  ``num_rows``/``fold_passes`` are static."""
+    g_ext, squeeze = _folded_ext(g_flat, order, sorted_ids, fold_passes)
     run_sums = jnp.take(g_ext, out_pos, axis=0, unique_indices=True)
-    out = jnp.zeros((num_rows, E), g.dtype).at[out_ids].set(
-        run_sums, indices_are_sorted=True, unique_indices=True,
-        mode="drop")
+    out = jnp.zeros((num_rows, g_ext.shape[1]), g_ext.dtype).at[
+        out_ids].set(run_sums, indices_are_sorted=True,
+                     unique_indices=True, mode="drop")
+    return out[:, 0] if squeeze else out
+
+
+def routed_table_grad_gather(g_flat: jnp.ndarray, order: jnp.ndarray,
+                             sorted_ids: jnp.ndarray,
+                             pos_map: jnp.ndarray, *,
+                             fold_passes: int) -> jnp.ndarray:
+    """GATHER placement: the dense gradient is one streaming row-gather
+    of the folded array at the static inverse map — no scatter exists
+    anywhere (see module doc).  ``pos_map (num_rows,)`` holds each vocab
+    row's run-start position in sorted order (``S`` = untouched -> the
+    appended zero row).  Same result as :func:`routed_table_grad`."""
+    g_ext, squeeze = _folded_ext(g_flat, order, sorted_ids, fold_passes)
+    out = jnp.take(g_ext, pos_map, axis=0)
     return out[:, 0] if squeeze else out

@@ -1,5 +1,6 @@
 """Statically-routed table-gradient scatter (ops/emb_grad.py) vs the
-XLA scatter-add oracle."""
+XLA scatter-add oracle, over both placements (gather inverse-map and
+sorted-unique scatter)."""
 
 import numpy as np
 import pytest
@@ -9,6 +10,9 @@ import jax.numpy as jnp
 from flink_ml_tpu.ops.emb_grad import emb_grad_route, routed_table_grad
 
 
+PLACEMENTS = ("gather", "scatter")
+
+
 def _oracle(ids, g, num_rows):
     out = np.zeros((num_rows, g.shape[-1]), np.float64)
     np.add.at(out, ids.reshape(-1), g.reshape(-1, g.shape[-1]))
@@ -16,19 +20,18 @@ def _oracle(ids, g, num_rows):
 
 
 def _routed(route, s, g_flat):
-    o, sid, op, oi = (np.asarray(a) for a in route.step_slice(s))
-    return np.asarray(routed_table_grad(
-        jnp.asarray(g_flat), jnp.asarray(o), jnp.asarray(sid),
-        jnp.asarray(op), jnp.asarray(oi), num_rows=route.num_rows,
-        fold_passes=route.fold_passes))
+    arrays = tuple(jnp.asarray(np.asarray(a))
+                   for a in route.step_slice(s))
+    return np.asarray(route.apply(jnp.asarray(g_flat), *arrays))
 
 
+@pytest.mark.parametrize("placement", PLACEMENTS)
 @pytest.mark.parametrize("emb_dim", [1, 8])
-def test_matches_scatter_add_oracle(emb_dim):
+def test_matches_scatter_add_oracle(placement, emb_dim):
     rng = np.random.default_rng(0)
     steps, batch, fields, vocab = 3, 64, 5, 200
     cat = rng.integers(0, vocab, size=(steps, batch, fields), dtype=np.int64)
-    route = emb_grad_route(cat, vocab)
+    route = emb_grad_route(cat, vocab, placement=placement)
     for s in range(steps):
         g = rng.normal(size=(batch * fields, emb_dim)).astype(np.float32)
         got = _routed(route, s, g)
@@ -36,10 +39,11 @@ def test_matches_scatter_add_oracle(emb_dim):
                                    rtol=1e-5, atol=1e-5)
 
 
-def test_scalar_payload_squeezes():
+@pytest.mark.parametrize("placement", PLACEMENTS)
+def test_scalar_payload_squeezes(placement):
     rng = np.random.default_rng(1)
     cat = rng.integers(0, 50, size=(1, 32, 4), dtype=np.int64)
-    route = emb_grad_route(cat, 50)
+    route = emb_grad_route(cat, 50, placement=placement)
     g = rng.normal(size=(32 * 4,)).astype(np.float32)
     got = _routed(route, 0, g)
     assert got.shape == (50,)
@@ -47,7 +51,8 @@ def test_scalar_payload_squeezes():
                                rtol=1e-5, atol=1e-5)
 
 
-def test_heavy_run_and_all_unique_edges():
+@pytest.mark.parametrize("placement", PLACEMENTS)
+def test_heavy_run_and_all_unique_edges(placement):
     rng = np.random.default_rng(2)
     batch, fields, vocab = 128, 4, 4096
     # step 0: one id floods half the slots (deep fold); step 1: all
@@ -56,7 +61,7 @@ def test_heavy_run_and_all_unique_edges():
     heavy.reshape(-1)[: batch * fields // 2] = 7
     uniq = np.arange(batch * fields, dtype=np.int64).reshape(batch, fields)
     cat = np.stack([heavy, uniq])
-    route = emb_grad_route(cat, vocab)
+    route = emb_grad_route(cat, vocab, placement=placement)
     assert route.fold_passes >= 8
     for s in range(2):
         g = rng.normal(size=(batch * fields, 3)).astype(np.float32)
@@ -65,9 +70,10 @@ def test_heavy_run_and_all_unique_edges():
                                    rtol=1e-4, atol=1e-4)
 
 
-def test_all_same_id():
+@pytest.mark.parametrize("placement", PLACEMENTS)
+def test_all_same_id(placement):
     cat = np.zeros((1, 16, 2), np.int64)
-    route = emb_grad_route(cat, 10)
+    route = emb_grad_route(cat, 10, placement=placement)
     g = np.ones((32, 2), np.float32)
     got = _routed(route, 0, g)
     expected = np.zeros((10, 2), np.float32)
@@ -75,11 +81,20 @@ def test_all_same_id():
     np.testing.assert_allclose(got, expected, rtol=1e-6)
 
 
+def test_placements_agree():
+    rng = np.random.default_rng(5)
+    cat = rng.integers(0, 300, size=(2, 64, 4), dtype=np.int64)
+    g = rng.normal(size=(256, 6)).astype(np.float32)
+    outs = [_routed(emb_grad_route(cat, 300, placement=p), 1, g)
+            for p in PLACEMENTS]
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6, atol=1e-7)
+
+
 def test_u_cap_pads_and_rejects():
     rng = np.random.default_rng(3)
     cat = rng.integers(0, 30, size=(2, 16, 2), dtype=np.int64)
     need = max(len(np.unique(cat[s])) for s in range(2))
-    route = emb_grad_route(cat, 30, u_cap=need + 5)
+    route = emb_grad_route(cat, 30, u_cap=need + 5, placement="scatter")
     assert route.out_ids.shape[1] == need + 5
     # padded sentinel ids are unique and ascending (the scatter's
     # indices_are_sorted + unique_indices claims must stay true)
@@ -89,18 +104,62 @@ def test_u_cap_pads_and_rejects():
     np.testing.assert_allclose(_routed(route, 0, g),
                                _oracle(cat[0], g, 30), rtol=1e-5, atol=1e-5)
     with pytest.raises(ValueError, match="u_cap"):
-        emb_grad_route(cat, 30, u_cap=need - 1)
+        emb_grad_route(cat, 30, u_cap=need - 1, placement="scatter")
 
 
-def test_route_shapes_shared_across_steps():
+def test_unknown_placement_rejected():
+    with pytest.raises(ValueError, match="placement"):
+        emb_grad_route(np.zeros((1, 2, 2), np.int64), 10,
+                       placement="banana")
+
+
+@pytest.mark.parametrize("placement", PLACEMENTS)
+def test_route_shapes_shared_across_steps(placement):
     rng = np.random.default_rng(4)
     # step 0 has many fewer unique ids than step 1 — shapes must match
     cat0 = rng.integers(0, 4, size=(16, 3), dtype=np.int64)
     cat1 = rng.integers(0, 1000, size=(16, 3), dtype=np.int64)
-    route = emb_grad_route(np.stack([cat0, cat1]), 1000)
-    assert route.out_pos.shape == route.out_ids.shape
+    route = emb_grad_route(np.stack([cat0, cat1]), 1000,
+                           placement=placement)
+    a0, a1 = route.step_slice(0), route.step_slice(1)
+    assert all(x.shape == y.shape for x, y in zip(a0, a1))
     for s, c in enumerate([cat0, cat1]):
         g = rng.normal(size=(48, 2)).astype(np.float32)
         np.testing.assert_allclose(_routed(route, s, g),
                                    _oracle(c, g, 1000),
                                    rtol=1e-5, atol=1e-5)
+
+
+def test_direct_scatter_fn_callable():
+    """routed_table_grad stays usable standalone (callers outside the
+    route object, e.g. future streaming integrations)."""
+    rng = np.random.default_rng(6)
+    cat = rng.integers(0, 40, size=(1, 8, 3), dtype=np.int64)
+    route = emb_grad_route(cat, 40, placement="scatter", device=False)
+    g = rng.normal(size=(24, 2)).astype(np.float32)
+    out = routed_table_grad(
+        jnp.asarray(g), jnp.asarray(route.order[0]),
+        jnp.asarray(route.sorted_ids[0]), jnp.asarray(route.out_pos[0]),
+        jnp.asarray(route.out_ids[0]), num_rows=40,
+        fold_passes=route.fold_passes)
+    np.testing.assert_allclose(np.asarray(out), _oracle(cat[0], g, 40),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_auto_placement_budget():
+    """'auto' picks gather until the inverse map outgrows its budget,
+    then scatter — and honors u_cap in both."""
+    from flink_ml_tpu.ops import emb_grad as eg
+
+    cat = np.random.default_rng(8).integers(
+        0, 100, size=(2, 8, 2), dtype=np.int64)
+    assert emb_grad_route(cat, 100, placement="auto").placement == "gather"
+    old = eg._POS_MAP_BUDGET_BYTES
+    eg._POS_MAP_BUDGET_BYTES = 4   # force the fallback
+    try:
+        r = emb_grad_route(cat, 100, placement="auto")
+        assert r.placement == "scatter" and r.pos_map is None
+    finally:
+        eg._POS_MAP_BUDGET_BYTES = old
+    with pytest.raises(ValueError, match="u_cap"):
+        emb_grad_route(cat, 100, u_cap=1, placement="gather")
