@@ -56,6 +56,18 @@ KM_ITERS = 480
 HOST_SUBSAMPLE = 16
 V5E_PEAK_FLOPS = 197e12  # bf16 peak; f32 work => MFU is conservative
 
+# --- frozen host-baseline anchors (VERDICT r4 weak #1) ---------------------
+# The same-run host-numpy denominators swung 2-6.6x across r4 runs on the
+# phasing 1-core bench host while the device numerators held to three
+# significant figures — the ratio column was noise.  From r5 the published
+# vs_baseline ratios divide by these FROZEN anchors: each is the BEST
+# (fastest) host sample recorded across the six r4 TPU runs, i.e. the most
+# conservative ratio.  The live host rate is still measured every run and
+# recorded in notes as host_*_live for drift tracking; a future host
+# change re-pins these with a metric-version bump.
+HOST_LR_EPOCHS_PER_SEC = 2.087    # r4 run-2 host sample (10.202/4.887)
+HOST_KMEANS_ITERS_PER_SEC = 0.3174  # r4 run-5 host sample (630.1/1985)
+
 
 def _smoke() -> bool:
     """Non-TPU backends run a scaled-down smoke pass (CI sanity only)."""
@@ -326,18 +338,21 @@ def bench_logreg(results: dict) -> None:
 
     host_rate = _host_lr_rate(batch, np.random.default_rng(1))
     results["vs_baseline"] = round(results["logreg_epochs_per_sec"]
-                                   / host_rate, 3)
+                                   / HOST_LR_EPOCHS_PER_SEC, 3)
     results.setdefault("notes", {})["lr"] = {
         "rows": rows, "dim": LR_DIM, "nnz": LR_NNZ, "batch": batch,
         "layout": "mixed: 13 dense slots (matvec) + 26 hashed categorical "
                   "(128-lane blocked gather/scatter)",
         "bound": "per-row random-access op rate on the categorical slots",
-        "host_epochs_per_sec": round(host_rate, 6),
+        "host_epochs_per_sec_anchor": HOST_LR_EPOCHS_PER_SEC,
+        "host_epochs_per_sec_live": round(host_rate, 6),
         # metric redefinition marker: r1/early-r2 measured the generic
         # (indices, values) sparse kernel under this key; from r2-final the
         # headline is the mixed layout (the framework's fastest Criteo
-        # path) and logreg_sparse_epochs_per_sec carries the old series
-        "metric_version": 2,
+        # path) and logreg_sparse_epochs_per_sec carries the old series;
+        # v3 (r5): vs_baseline divides by the FROZEN host anchor (see
+        # HOST_LR_EPOCHS_PER_SEC) instead of the noisy same-run sample
+        "metric_version": 3,
     }
 
 
@@ -499,6 +514,11 @@ def bench_logreg_outofcore(results: dict) -> None:
     })
 
 
+#: bump with ANY _synth_tsv format/content change — the e2e leg's cached
+#: day-file is keyed on it (a same-width content change preserves size)
+_SYNTH_TSV_VERSION = 1
+
+
 def _synth_tsv(rows: int, rng: np.random.Generator) -> bytes:
     ints = rng.integers(0, 1000, size=(rows, 13))
     toks = rng.integers(0, 1 << 32, size=(rows, 26))
@@ -540,14 +560,56 @@ def bench_criteo_e2e(results: dict) -> None:
     }
 
     tmp = tempfile.mkdtemp(prefix="bench_criteo_e2e_")
-    day = os.path.join(tmp, "day_0.tsv")
-    template = _synth_tsv(template_rows, np.random.default_rng(23))
+    # 1-second disk microprobe (VERDICT r4 weak #2): the bench disk
+    # phases 26-663 MB/s across runs, so every run records its own
+    # disk phase to make residual e2e swings attributable
+    probe_path = os.path.join(tmp, "disk_probe")
+    probe_block = b"\0" * (8 << 20)
     t0 = time.perf_counter()
-    with open(day, "wb") as f:
-        for _ in range(reps):
-            f.write(template)
-    notes["synth_write_s"] = round(time.perf_counter() - t0, 1)
+    probe_mb = 0
+    with open(probe_path, "wb") as f:
+        while time.perf_counter() - t0 < 1.0:
+            f.write(probe_block)
+            probe_mb += 8
+        f.flush()
+        os.fsync(f.fileno())
+    notes["disk_probe_mb_per_sec"] = round(
+        probe_mb / (time.perf_counter() - t0), 1)
+    os.unlink(probe_path)
+
+    # the seeded day-file is CACHED across runs (VERDICT r4 weak #2: run 6
+    # spent 355 s writing its own synthetic input on a slow disk phase —
+    # more than it charged to e2e); content is deterministic in
+    # (seed, rows), so a size-matched cached file is the same file
+    template = _synth_tsv(template_rows, np.random.default_rng(23))
+    cache_dir = os.environ.get("BENCH_CACHE_DIR",
+                               "/tmp/flink_ml_tpu_bench_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    # filename carries a content version (bump _SYNTH_TSV_VERSION with
+    # any _synth_tsv format change) and reuse re-checks the first
+    # template-block bytes — size alone cannot catch a same-width
+    # content change
+    day = os.path.join(cache_dir,
+                       f"day_s23_v{_SYNTH_TSV_VERSION}_r{rows}.tsv")
     tsv_bytes = len(template) * reps
+
+    def _prefix_matches() -> bool:
+        with open(day, "rb") as f:
+            return f.read(min(len(template), 1 << 20)) == \
+                template[: 1 << 20]
+
+    if (os.path.exists(day) and os.path.getsize(day) == tsv_bytes
+            and _prefix_matches()):
+        notes["synth_write_s"] = 0.0
+        notes["synth_day_file"] = "cached"
+    else:
+        t0 = time.perf_counter()
+        with open(day + ".part", "wb") as f:
+            for _ in range(reps):
+                f.write(template)
+        os.replace(day + ".part", day)
+        notes["synth_write_s"] = round(time.perf_counter() - t0, 1)
+        notes["synth_day_file"] = "written"
 
     # stage 1+2: parse + cache as one pipeline (reader feeds writer)
     batch = 1 << 16
@@ -583,7 +645,12 @@ def bench_criteo_e2e(results: dict) -> None:
     per_batch_s = time.perf_counter() - t0
     train_rows = rows
     projected = per_batch_s * (rows / (1 << 14)) * 2.5 * train_epochs
-    if projected > 150:
+    # budget raised 150 -> 420 s in r5 (VERDICT r4 missing #2): the run-6
+    # calibration put the FULL 10M-row 2-epoch leg at ~268 s through the
+    # tunnel, so the complete measurement fits the budget and the north-
+    # star number stops being a projection.  The subset fallback remains
+    # for genuinely slow tunnel phases.
+    if projected > 420:
         train_rows = min(rows, 1 << 18)
         notes["train_leg"] = (
             f"subset of {train_rows} rows: calibration projects "
@@ -639,6 +706,30 @@ def bench_criteo_e2e(results: dict) -> None:
         notes["e2e_wall_s_note"] = "train leg scaled from subset"
     results["criteo_e2e_rows_per_sec"] = round(
         rows / (ingest_s + train_full_s), 1)
+
+    # cache-ON series (VERDICT r4 missing #2): the SAME train leg with
+    # the decoded replay cache engaged — epoch 0 decodes + records,
+    # epoch 1 replays from RAM.  Reported next to the comparable
+    # cache-OFF series above, never mixed into it.
+    stats_c = PrefetchStats()
+    si_c: dict = {}
+    t0 = time.perf_counter()
+    sgd_fit_outofcore(
+        logistic_loss, make_reader, num_features=LR_DIM, config=cfg,
+        dense_key="features_dense", indices_key="features_indices",
+        prefetch_workers=workers, prefetch_stats=stats_c,
+        cache_decoded=True, stream_info=si_c)
+    train_cached_s = time.perf_counter() - t0
+    cached_full_s = train_cached_s * (rows / train_rows) / train_epochs
+    notes["train_cached"] = {
+        "wall_s": round(train_cached_s, 1),
+        "epoch_s": si_c.get("epoch_seconds"),
+        "cached_batches": si_c.get("decoded_cache_batches", 0),
+        "rows_per_sec": round(train_rows * train_epochs / train_cached_s,
+                              1),
+    }
+    results["criteo_e2e_cached_rows_per_sec"] = round(
+        rows / (ingest_s + cached_full_s), 1)
 
 
 def _host_kmeans_rate(points: np.ndarray, centroids: np.ndarray,
@@ -741,7 +832,9 @@ def bench_kmeans(results: dict) -> None:
         size=(max(n // HOST_SUBSAMPLE, 2 * K), D)).astype(np.float32)
     host_rate = _host_kmeans_rate(host_points, host_points[:K].copy(), n)
     results["kmeans_iterations_per_sec"] = round(tpu_rate, 3)
-    results["kmeans_vs_baseline"] = round(tpu_rate / host_rate, 3)
+    results["kmeans_vs_baseline"] = round(
+        tpu_rate / HOST_KMEANS_ITERS_PER_SEC, 3)
+    results["notes"]["kmeans_host_rate_live"] = round(host_rate, 5)
     # metric_version history for the kmeans series: v1 (r1) = single-trial
     # host baseline; v2 (r2) = best-of-3 host baseline (the r1->r2
     # kmeans_vs_baseline cliff is that redefinition, not a regression);
@@ -750,8 +843,11 @@ def bench_kmeans(results: dict) -> None:
     # v4 (r4, never benched) = tiePolicy default flipped to "split";
     # v5 (r4) = default becomes "first" (exact reference argmin tie
     # semantics, ADVICE r3 medium) — fit-planned path still what's
-    # timed; slightly more work per iteration than v3's "fast".
-    results["notes"]["kmeans_metric_version"] = 5
+    # timed; slightly more work per iteration than v3's "fast";
+    # v6 (r5) = kmeans_vs_baseline divides by the FROZEN host anchor
+    # (HOST_KMEANS_ITERS_PER_SEC — the 6.6x r4 ratio swing was all
+    # denominator); the live host sample moves to notes.
+    results["notes"]["kmeans_metric_version"] = 6
     # assign+reduce are two (n, K, D)-scale matmuls: ~4*n*K*D flops/iter
     results["notes"]["kmeans_tflops"] = round(
         4 * n * K * D * tpu_rate / 1e12, 1)
@@ -822,20 +918,20 @@ def bench_widedeep(results: dict) -> None:
         rng.integers(0, 2, size=(steps, batch)).astype(np.float32))
     mask = jnp.ones((steps, batch), jnp.float32)
     total_vocab = int(np.sum(vocab_sizes))
-    route = emb_grad_route(cat_host, total_vocab)
-    rt = (route.order, route.sorted_ids, route.out_pos, route.out_ids)
-    u_cap = int(route.out_ids.shape[1])
+    route_g = emb_grad_route(cat_host, total_vocab, placement="gather")
+    route_s = emb_grad_route(cat_host, total_vocab, placement="scatter")
 
-    def measure(lazy: bool, routed: bool = False) -> float:
+    def measure(lazy: bool, route=None) -> float:
+        rt = route.stacked_arrays() if route is not None else ()
         train_step, params, opt_state = build_reference_train_step(
             d_dense, vocab_sizes, emb_dim, hidden, lazy_embeddings=lazy,
-            route=route if routed else None)
+            route=route)
 
         @jax.jit
         def run(params, opt_state):
             def step(carry, i):
                 p, o = carry
-                extra = tuple(a[i] for a in rt) if routed else ()
+                extra = tuple(a[i] for a in rt)
                 p, o, loss = train_step(p, o, dense[i], cat[i], y[i],
                                         mask[i], *extra)
                 return (p, o), loss
@@ -855,8 +951,9 @@ def bench_widedeep(results: dict) -> None:
             trials.append(time.perf_counter() - start)
         return min(trials) / steps
 
-    step_s = measure(lazy=False, routed=True)  # product default since r5:
-    #   routedEmbeddingGrad 'auto' — static sort-once table gradients
+    step_s = measure(lazy=False, route=route_g)  # product default since
+    #   r5: routedEmbeddingGrad 'auto', gather placement (scatter-free)
+    scatter_step_s = measure(lazy=False, route=route_s)  # alt placement
     dense_step_s = measure(lazy=False)         # autodiff-scatter baseline
     lazy_step_s = measure(lazy=True)   # opt-in lazyEmbeddingOptimizer
 
@@ -871,14 +968,17 @@ def bench_widedeep(results: dict) -> None:
     # MFU under-reports how memory-bound the step is — this is the
     # denominator the scatter work improves against).  Dense-Adam streams
     # (grad read + m/v/param read+write = 7 passes) over both tables plus
-    # the forward gathers; the routed backward adds its permute gather,
-    # fold passes, and compaction over the (slots, emb) grad rows.
+    # the forward gathers; the routed GATHER-placement backward (what
+    # step_s times) adds the permute gather, the fold passes, the final
+    # row-gather's g_ext read + dense-grad write, and the pos_map read.
     S = batch * n_fields
     tab_bytes = total_vocab * (emb_dim + 1) * 4       # emb + wide, one pass
     adam_streams = 7 * tab_bytes
     fwd_gather = S * (emb_dim + 1) * 4 * 2            # read rows + write out
-    routed_extra = (2 + route.fold_passes) * 2 * S * emb_dim * 4 \
-        + 2 * u_cap * emb_dim * 4
+    routed_extra = ((1 + route_g.fold_passes) * 2 * S * emb_dim * 4
+                    + S * emb_dim * 4                 # g_ext read
+                    + tab_bytes                       # dense-grad write
+                    + total_vocab * 4)                # pos_map read
     hbm_bytes = adam_streams + fwd_gather + routed_extra
     results["widedeep_steps_per_sec"] = round(1.0 / step_s, 1)
     results["notes"]["widedeep"] = {
@@ -888,8 +988,9 @@ def bench_widedeep(results: dict) -> None:
         "rows_per_sec": round(batch / step_s, 1),
         "tflops": round(train_flops / step_s / 1e12, 2),
         "mfu": round(train_flops / step_s / V5E_PEAK_FLOPS, 4),
-        "impl": "routed_emb_grad",
-        "fold_passes": route.fold_passes,
+        "impl": "routed_emb_grad(gather)",
+        "scatter_placement_step_ms": round(1000 * scatter_step_s, 3),
+        "fold_passes": route_g.fold_passes,
         # achieved HBM rate against the analytic table-traffic floor —
         # v5e HBM is ~819 GB/s, so this column reads as "how close to
         # memory-bound the step runs"
@@ -902,6 +1003,179 @@ def bench_widedeep(results: dict) -> None:
         # the rows each batch touches (LazyAdam semantics)
         "lazy_step_ms": round(1000 * lazy_step_s, 3),
         "lazy_rows_per_sec": round(batch / lazy_step_s, 1),
+    }
+
+
+def bench_als(results: dict) -> None:
+    """ALS chip rate (VERDICT r4 missing #3): epochs/sec of EXACTLY the
+    fit-planned epoch body (``als_epoch_step`` — normal-equation
+    accumulation scanned in 64k-rating chunks, batched Cholesky solves,
+    'highest' matmul precision) on one chip, with a same-math host-numpy
+    anchor on a scaled-down replica.  Explicit-feedback ALS-WR config:
+    16k users x 4k items, 2M ratings, rank 64."""
+    import jax
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.models.recommendation.als import als_epoch_step
+
+    smoke = _smoke()
+    n_users = (1 << 14) if not smoke else 1 << 8
+    n_items = (1 << 12) if not smoke else 1 << 6
+    nnz = (1 << 21) if not smoke else 1 << 12
+    rank = 64 if not smoke else 8
+    epochs = 2
+    reg = 0.1
+
+    @jax.jit
+    def gen(key):
+        ku, ki, kr, kf = jax.random.split(key, 4)
+        u = jax.random.randint(ku, (nnz,), 0, n_users, jnp.int32)
+        i = jax.random.randint(ki, (nnz,), 0, n_items, jnp.int32)
+        r = jax.random.normal(kr, (nnz,), jnp.float32)
+        return u, i, r, jnp.ones((nnz,), jnp.float32), \
+            jax.random.normal(kf, (n_users + n_items, rank),
+                              jnp.float32) * (1.0 / np.sqrt(rank))
+
+    u_idx, i_idx, ratings, w, f0 = gen(jax.random.PRNGKey(3))
+    body = als_epoch_step(n_users, n_items, reg, False, 1.0)
+
+    @jax.jit
+    def run(U, V, u_idx, i_idx, r, w):
+        def epoch(state, e):
+            return body(state, e, (u_idx, i_idx, r, w)).feedback, None
+
+        (U, V), _ = jax.lax.scan(epoch, (U, V),
+                                 jnp.arange(epochs, dtype=jnp.int32))
+        return U, V
+
+    U, V = f0[:n_users], f0[n_users:]
+    U1, V1 = run(U, V, u_idx, i_idx, ratings, w)   # compile + warm
+    assert np.all(np.isfinite(np.asarray(U1[:2])))
+    trials = []
+    for t in range(1, 4):
+        # distinct weights per trial (relay-cache defeat, cf. bench_logreg)
+        wt = w * (1.0 + t * 1e-6)
+        start = time.perf_counter()
+        U2, V2 = run(U, V, u_idx, i_idx, ratings, wt)
+        np.asarray(U2[:1])                          # completion fence
+        trials.append(time.perf_counter() - start)
+    epoch_s = min(trials) / epochs
+
+    # host anchor: the same math (chunked outer-product accumulation +
+    # batched solve) on a 1/16-scale replica, rate scaled back — a
+    # same-shape full-size host epoch would not fit the bench budget
+    sub = 16 if not smoke else 2
+    hu, hi, hr = (np.asarray(u_idx[:nnz // sub]) % (n_users // sub),
+                  np.asarray(i_idx[:nnz // sub]) % (n_items // sub),
+                  np.asarray(ratings[:nnz // sub]))
+    hU = np.asarray(f0[:n_users // sub]).copy()
+    hV = np.asarray(f0[n_users:n_users + n_items // sub]).copy()
+
+    def host_solve(factors, g_idx, o_idx, r, n_groups):
+        A = np.zeros((n_groups, rank, rank), np.float32)
+        b = np.zeros((n_groups, rank), np.float32)
+        cnt = np.zeros((n_groups,), np.float32)
+        for s in range(0, len(g_idx), 1 << 14):
+            g, o, rr = g_idx[s:s + (1 << 14)], o_idx[s:s + (1 << 14)], \
+                r[s:s + (1 << 14)]
+            y = factors[o]
+            np.add.at(A, g, y[:, :, None] * y[:, None, :])
+            np.add.at(b, g, rr[:, None] * y)
+            np.add.at(cnt, g, 1.0)
+        A += (reg * np.maximum(cnt, 1.0))[:, None, None] * np.eye(
+            rank, dtype=np.float32)[None]
+        return np.linalg.solve(A, b[..., None])[..., 0]
+
+    t0 = time.perf_counter()
+    hU = host_solve(hV, hu, hi, hr, n_users // sub)
+    hV = host_solve(hU, hi, hu, hr, n_items // sub)
+    host_epoch_s = (time.perf_counter() - t0) * sub
+
+    results["als_epochs_per_sec"] = round(1.0 / epoch_s, 3)
+    results["notes"]["als"] = {
+        "config": (f"{n_users}x{n_items}, {nnz} ratings, rank {rank}, "
+                   "explicit ALS-WR"),
+        "epoch_ms": round(1000 * epoch_s, 1),
+        "ratings_per_sec": round(2 * nnz / epoch_s, 1),  # both half-epochs
+        "vs_host_anchor": round(host_epoch_s / epoch_s, 2),
+        "host_anchor": (f"same math at 1/{sub} scale x {sub} "
+                        f"({host_epoch_s:.2f}s/epoch equivalent)"),
+    }
+
+
+def bench_gbt(results: dict) -> None:
+    """GBT chip rate (VERDICT r4 missing #3): trees/sec of EXACTLY the
+    fit-planned boosting loop (``train_forest`` — jitted per-level
+    histogram/split/route on device, host grad/hess between trees) on a
+    512k x 32 binary problem, with a same-algorithm host-numpy
+    single-tree anchor."""
+    import jax.numpy as jnp  # noqa: F401  (jax init before first use)
+
+    from flink_ml_tpu.models.common.gbt import GBTConfig, train_forest
+
+    smoke = _smoke()
+    n = (1 << 19) if not smoke else 1 << 12
+    d = 32 if not smoke else 8
+    trees = 8 if not smoke else 2
+    depth = 5 if not smoke else 3
+    bins = 64
+
+    rng = np.random.default_rng(29)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+         + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+
+    def grad_hess(y, pred):
+        p = 1.0 / (1.0 + np.exp(-pred))
+        return (p - y), np.maximum(p * (1.0 - p), 1e-16)
+
+    cfg = GBTConfig(num_trees=trees, max_depth=depth, max_bins=bins,
+                    learning_rate=0.2)
+    t0 = time.perf_counter()
+    train_forest(X, y, grad_hess, 0.0, cfg)          # compile + warm
+    warm_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    forest = train_forest(X, y, grad_hess, 0.0, cfg)
+    wall_s = time.perf_counter() - t0
+    assert np.any(forest.feature[0] >= 0), "GBT bench grew no splits"
+
+    # host anchor: one tree of the same histogram algorithm (quantile
+    # bins, (node, feature, bin) G/H sums, best gain split, route) in
+    # numpy on the full data
+    from flink_ml_tpu.models.common.gbt import bin_features
+
+    binned, _ = bin_features(X, bins)
+    g, h = grad_hess(y, np.zeros(n))
+    t0 = time.perf_counter()
+    node_ids = np.zeros(n, np.int64)
+    for level in range(depth):
+        n_nodes = 1 << level
+        Gh = np.zeros((n_nodes, d, bins), np.float64)
+        Hh = np.zeros((n_nodes, d, bins), np.float64)
+        rel = node_ids - (n_nodes - 1)
+        for f in range(d):
+            np.add.at(Gh, (rel, f, binned[:, f]), g)
+            np.add.at(Hh, (rel, f, binned[:, f]), h)
+        Gc, Hc = Gh.cumsum(2), Hh.cumsum(2)
+        Gt, Ht = Gc[:, :, -1:], Hc[:, :, -1:]
+        lam = cfg.reg_lambda
+        gain = (Gc ** 2 / (Hc + lam) + (Gt - Gc) ** 2 / (Ht - Hc + lam)
+                - Gt ** 2 / (Ht + lam))
+        best = gain.reshape(n_nodes, -1).argmax(1)
+        bf, bb = best // bins, best % bins
+        go_left = binned[np.arange(n), bf[rel]] <= bb[rel]
+        node_ids = 2 * node_ids + np.where(go_left, 1, 2)
+    host_tree_s = time.perf_counter() - t0
+
+    results["gbt_trees_per_sec"] = round(trees / wall_s, 3)
+    results["notes"]["gbt"] = {
+        "config": f"{n}x{d}, {trees} trees, depth {depth}, {bins} bins",
+        "wall_s": round(wall_s, 2),
+        "compile_warm_s": round(warm_s, 2),
+        "rows_x_trees_per_sec": round(n * trees / wall_s, 1),
+        "vs_host_anchor": round((host_tree_s * trees) / wall_s, 2),
+        "host_anchor": (f"same histogram algorithm, numpy, "
+                        f"{host_tree_s:.2f}s/tree"),
     }
 
 
@@ -954,7 +1228,7 @@ def main() -> None:
     # error note instead of costing the round its whole bench line
     bench_logreg(results)
     for leg in (bench_logreg_outofcore, bench_criteo_e2e, bench_kmeans,
-                bench_widedeep, bench_wal):
+                bench_widedeep, bench_als, bench_gbt, bench_wal):
         try:
             leg(results)
         except Exception as exc:   # noqa: BLE001
@@ -971,6 +1245,21 @@ def main() -> None:
     }
     line.update(results)
     print(json.dumps(line))
+    # final self-sufficient summary line (VERDICT r4 weak #5): the
+    # driver's capture truncates long output to a 4 KB TAIL, which cut
+    # the headline `value` out of BENCH_r04.json — so the LAST stdout
+    # line always carries the verdict-critical fields on its own, and is
+    # itself a valid bench line if a parser takes the last line instead
+    # of the first.
+    print(json.dumps({
+        "metric": line["metric"], "value": line["value"],
+        "unit": line["unit"], "vs_baseline": line["vs_baseline"],
+        "summary": True,
+        "backend": jax.default_backend(),
+        "lr_impl": line.get("notes", {}).get("lr_impl"),
+        "tpu_unavailable": bool(
+            line.get("notes", {}).get("tpu_unavailable")),
+    }))
 
 
 if __name__ == "__main__":
