@@ -69,6 +69,7 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
                        sharding: Optional[Any] = None,
                        transform: Optional[Callable[[Any], Any]] = None,
                        workers: int = 1,
+                       put_workers: int = 1,
                        stats: Optional[PrefetchStats] = None,
                        put_fn: Optional[Callable[[Any, Any], Any]] = None
                        ) -> Iterator[Any]:
@@ -80,6 +81,14 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
     ``workers`` background threads before the transfer (decode/pad/astype —
     keeps that work off the consumer thread; results are reassembled in
     source order, so worker count never changes what the consumer sees).
+
+    ``put_workers`` issues the transfers themselves from that many
+    threads — on transports where a single ``device_put`` is
+    latency-bound but concurrent transfer RPCs pipeline (the axon
+    tunnel question ``scripts/put_overlap_probe.py`` measures),
+    parallel puts hide most of the per-batch latency.  Results are
+    reassembled in source order, so the consumer sees the same stream
+    at any worker count.
 
     Exceptions raised by the source iterator or the transform are re-raised
     at the consuming ``next()`` call.
@@ -93,6 +102,8 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
         raise ValueError(f"depth must be >= 1, got {depth}")
     if workers < 1:
         raise ValueError(f"workers must be >= 1, got {workers}")
+    if put_workers < 1:
+        raise ValueError(f"put_workers must be >= 1, got {put_workers}")
     st = stats or PrefetchStats()
 
     def put(batch, sh):
@@ -121,7 +132,7 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
             st.transform_s += time.perf_counter() - t0
         return out
 
-    if workers == 1:
+    if workers == 1 and put_workers == 1:
         def worker():
             try:
                 src = iter(batches)
@@ -150,9 +161,29 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
 
         pool = ThreadPoolExecutor(max_workers=workers,
                                   thread_name_prefix="flink-ml-tpu-decode")
-        fq: queue.Queue = queue.Queue(maxsize=depth + workers)
+        fq: queue.Queue = queue.Queue(maxsize=depth + workers + put_workers)
+        # ordered reassembly shared by the putter pool: seq -> device
+        # batch, flushed to q in source order as the prefix completes
+        flush_lock = threading.Lock()
+        pending: dict = {}
+        flush_state = {"next": 0, "total": None, "finished": False}
+
+        def _flush_ready_locked():
+            """Emit the completed prefix (and the terminal _END once the
+            reader's total is known and reached).  Caller holds
+            flush_lock; q puts under the lock are safe — the consumer
+            drains q independently, so progress is guaranteed."""
+            while flush_state["next"] in pending:
+                put_or_abandon(q, pending.pop(flush_state["next"]))
+                flush_state["next"] += 1
+            if (flush_state["total"] is not None
+                    and flush_state["next"] >= flush_state["total"]
+                    and not flush_state["finished"]):
+                flush_state["finished"] = True
+                put_or_abandon(q, _END)
 
         def reader():
+            seq = 0
             try:
                 src = iter(batches)
                 while True:
@@ -164,10 +195,24 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
                     st.read_s += time.perf_counter() - t0
                     if stop.is_set():
                         return
-                    put_or_abandon(fq, pool.submit(timed_transform, batch))
-                put_or_abandon(fq, _END)
+                    put_or_abandon(
+                        fq, (seq, pool.submit(timed_transform, batch)))
+                    seq += 1
+                with flush_lock:
+                    flush_state["total"] = seq
+                    _flush_ready_locked()   # covers the empty stream
             except BaseException as exc:  # noqa: BLE001
-                put_or_abandon(fq, exc)
+                # deliver the error IN STREAM ORDER: it enters the
+                # reassembly at the next seq, so every batch already
+                # read and decoded reaches the consumer first (callers
+                # that checkpoint from the last consumed batch rely on
+                # this)
+                with flush_lock:
+                    pending[seq] = exc
+                    flush_state["total"] = seq + 1
+                    _flush_ready_locked()
+            for _ in range(put_workers):
+                put_or_abandon(fq, _END)
 
         def get_or_abandon(src: queue.Queue):
             """Stop-aware get: the putter must exit when the consumer
@@ -180,39 +225,45 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
             return _END
 
         def putter():
-            try:
-                while True:
-                    item = get_or_abandon(fq)
-                    if item is _END:
-                        put_or_abandon(q, _END)
-                        return
-                    if isinstance(item, BaseException):
-                        put_or_abandon(q, item)
-                        return
-                    # stop-aware future wait, mirroring put/get_or_abandon:
-                    # an abandoned consumer must not leave this thread
-                    # blocked behind a hung transform.  Poll done-ness
-                    # rather than catching TimeoutError from result() —
-                    # futures.TimeoutError IS the builtin TimeoutError on
-                    # 3.11+, so a transform failing with e.g.
-                    # socket.timeout must still propagate, not spin.
-                    while not stop.is_set() and not item.done():
-                        futures.wait([item], timeout=0.1)
-                    if stop.is_set():
-                        item.cancel()
-                        return
-                    batch = item.result()
+            while True:
+                item = get_or_abandon(fq)
+                if item is _END:
+                    return
+                seq, fut = item
+                # stop-aware future wait, mirroring put/get_or_abandon:
+                # an abandoned consumer must not leave this thread
+                # blocked behind a hung transform.  Poll done-ness
+                # rather than catching TimeoutError from result() —
+                # futures.TimeoutError IS the builtin TimeoutError on
+                # 3.11+, so a transform failing with e.g.
+                # socket.timeout must still propagate, not spin.
+                while not stop.is_set() and not fut.done():
+                    futures.wait([fut], timeout=0.1)
+                if stop.is_set():
+                    fut.cancel()
+                    return
+                try:
+                    batch = fut.result()
                     t0 = time.perf_counter()
-                    batch = put(batch, sharding)
-                    st.put_s += time.perf_counter() - t0
-                    put_or_abandon(q, batch)
-            except BaseException as exc:  # noqa: BLE001
-                put_or_abandon(q, exc)
+                    entry = put(batch, sharding)
+                    with st._lock:
+                        st.put_s += time.perf_counter() - t0
+                except BaseException as exc:  # noqa: BLE001
+                    # transform/put errors ride the reassembly at their
+                    # own seq: every earlier batch is delivered first,
+                    # exactly like the reader's error path
+                    entry = exc
+                with flush_lock:
+                    pending[seq] = entry
+                    _flush_ready_locked()
+                if isinstance(entry, BaseException):
+                    return
 
         threads = [threading.Thread(target=reader, daemon=True,
-                                    name="flink-ml-tpu-prefetch-read"),
-                   threading.Thread(target=putter, daemon=True,
-                                    name="flink-ml-tpu-prefetch-put")]
+                                    name="flink-ml-tpu-prefetch-read")]
+        threads += [threading.Thread(target=putter, daemon=True,
+                                     name=f"flink-ml-tpu-prefetch-put-{i}")
+                    for i in range(put_workers)]
 
     for t in threads:
         t.start()
@@ -229,5 +280,5 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
             yield item
     finally:
         stop.set()
-        if workers > 1:
+        if workers > 1 or put_workers > 1:
             pool.shutdown(wait=False, cancel_futures=True)

@@ -1145,6 +1145,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                       dense_key: Optional[str] = None,
                       prefetch_depth: int = 2,
                       prefetch_workers: int = 1,
+                      prefetch_put_workers: int = 1,
                       prefetch_stats=None,
                       cache_decoded="auto",
                       decoded_ram_budget: Optional[int] = None,
@@ -1670,7 +1671,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         for dev_batch in prefetch_to_device(
                 source, depth=prefetch_depth,
                 transform=route, sharding=sharding,
-                workers=prefetch_workers, stats=prefetch_stats,
+                workers=prefetch_workers,
+                put_workers=prefetch_put_workers, stats=prefetch_stats,
                 put_fn=put_fn):
             params, value = batch_step(params, *dev_batch)
             loss_sum = value if loss_sum is None else add(loss_sum, value)

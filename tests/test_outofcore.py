@@ -56,6 +56,59 @@ def test_prefetch_propagates_source_exception():
         next(it)
 
 
+def test_prefetch_put_workers_order_and_values():
+    """Parallel putters must reassemble source order exactly, for every
+    (workers, put_workers) topology, including an empty stream."""
+    batches = [np.full((4,), i, np.float32) for i in range(17)]
+    for w, pw in [(1, 3), (2, 2), (3, 4)]:
+        out = list(prefetch_to_device(iter(batches), depth=2,
+                                      workers=w, put_workers=pw))
+        assert len(out) == 17
+        for i, b in enumerate(out):
+            np.testing.assert_array_equal(np.asarray(b), batches[i])
+    assert list(prefetch_to_device(iter([]), put_workers=3)) == []
+
+
+def test_prefetch_put_workers_propagates_exceptions():
+    def bad_source():
+        yield np.ones(2)
+        yield np.ones(2)
+        raise RuntimeError("disk on fire")
+
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        list(prefetch_to_device(bad_source(), depth=1, put_workers=3))
+
+    def bad_transform(b):
+        raise ValueError("decode exploded")
+
+    with pytest.raises(ValueError, match="decode exploded"):
+        list(prefetch_to_device(iter([np.ones(2)]), transform=bad_transform,
+                                put_workers=2))
+
+
+def test_prefetch_errors_delivered_in_stream_order():
+    """Every batch read before the failure reaches the consumer BEFORE
+    the exception, at any worker topology — callers that checkpoint from
+    the last consumed batch rely on it."""
+    def bad_source():
+        for i in range(12):
+            yield np.full((2,), i, np.float32)
+        raise RuntimeError("disk on fire")
+
+    for w, pw in [(1, 1), (2, 1), (2, 3)]:
+        got = []
+        with pytest.raises(RuntimeError, match="disk on fire"):
+            for b in prefetch_to_device(bad_source(), depth=2,
+                                        workers=w, put_workers=pw):
+                got.append(int(np.asarray(b)[0]))
+        assert got == list(range(12)), (w, pw, got)
+
+
+def test_prefetch_put_workers_validated():
+    with pytest.raises(ValueError, match="put_workers"):
+        list(prefetch_to_device(iter([]), put_workers=0))
+
+
 def test_prefetch_depth_validated():
     with pytest.raises(ValueError, match="depth"):
         list(prefetch_to_device(iter([]), depth=0))
