@@ -1129,14 +1129,47 @@ def bench_gbt(results: dict) -> None:
         p = 1.0 / (1.0 + np.exp(-pred))
         return (p - y), np.maximum(p * (1.0 - p), 1e-16)
 
+    from flink_ml_tpu.models.common import gbt as gbt_mod
+
     cfg = GBTConfig(num_trees=trees, max_depth=depth, max_bins=bins,
                     learning_rate=0.2)
-    t0 = time.perf_counter()
-    train_forest(X, y, grad_hess, 0.0, cfg)          # compile + warm
-    warm_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    forest = train_forest(X, y, grad_hess, 0.0, cfg)
-    wall_s = time.perf_counter() - t0
+
+    def timed_forest(hist_impl: str):
+        old = gbt_mod.HIST_IMPL
+        gbt_mod.HIST_IMPL = hist_impl
+        try:
+            t0 = time.perf_counter()
+            train_forest(X, y, grad_hess, 0.0, cfg)   # compile + warm
+            warm = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            forest = train_forest(X, y, grad_hess, 0.0, cfg)
+            return forest, time.perf_counter() - t0, warm
+        finally:
+            gbt_mod.HIST_IMPL = old
+
+    forest, wall_s, warm_s = timed_forest(gbt_mod.HIST_IMPL)
+    # the MXU double-one-hot histogram alternative.  Parity gate on the
+    # HISTOGRAMS (allclose — the two impls differ in f32 summation
+    # order, so near-tie argmax splits may legitimately pick different
+    # features; exact-tree equality would crash the bench on a ULP):
+    import jax.numpy as _jnp
+
+    rng_p = np.random.default_rng(31)
+    binned_p = _jnp.asarray(rng_p.integers(0, bins, size=(4096, d)),
+                            _jnp.int32)
+    ids_p = _jnp.asarray(rng_p.integers(-1, 4, size=4096), _jnp.int32)
+    gp = _jnp.asarray(rng_p.normal(size=4096), _jnp.float32)
+    hp = _jnp.asarray(rng_p.random(4096) + 0.1, _jnp.float32)
+    gs, hs = gbt_mod._level_histograms_segsum(binned_p, ids_p, gp, hp,
+                                              4, d, bins)
+    gm, hm = gbt_mod._level_histograms_mxu(binned_p, ids_p, gp, hp,
+                                           4, d, bins)
+    if not (np.allclose(np.asarray(gs), np.asarray(gm), rtol=1e-4,
+                        atol=1e-5)
+            and np.allclose(np.asarray(hs), np.asarray(hm), rtol=1e-4,
+                            atol=1e-5)):
+        raise AssertionError("mxu histograms diverged from segsum")
+    forest_mxu, wall_mxu_s, _ = timed_forest("mxu")
     assert np.any(forest.feature[0] >= 0), "GBT bench grew no splits"
 
     # host anchor: one tree of the same histogram algorithm (quantile
@@ -1173,6 +1206,11 @@ def bench_gbt(results: dict) -> None:
         "wall_s": round(wall_s, 2),
         "compile_warm_s": round(warm_s, 2),
         "rows_x_trees_per_sec": round(n * trees / wall_s, 1),
+        "hist_impl": gbt_mod.HIST_IMPL,
+        # the alternative histogram lowering (double one-hot MXU
+        # contraction vs segment_sum scatter-adds); identical trees
+        # asserted above — a chip verdict here flips HIST_IMPL
+        "mxu_hist_wall_s": round(wall_mxu_s, 2),
         "vs_host_anchor": round((host_tree_s * trees) / wall_s, 2),
         "host_anchor": (f"same histogram algorithm, numpy, "
                         f"{host_tree_s:.2f}s/tree"),

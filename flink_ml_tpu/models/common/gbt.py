@@ -103,12 +103,18 @@ def apply_bins_device(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(jnp.isnan(X), edges.shape[1], count)
 
 
+#: histogram implementation: "segsum" (XLA segment_sum scatter-adds, the
+#: r1-r4 path) or "mxu" (double one-hot matmul — histogramming as MXU
+#: contractions, the KMeans-stats pattern applied to split finding).
+#: Module-level so the bench can measure both and a chip verdict can
+#: flip the default; both are exact up to f32 summation order.
+HIST_IMPL = "segsum"
+
+
 @partial(jax.jit, static_argnames=("n_nodes", "d", "bins"))
-def _level_histograms(binned, node_ids, grad, hess, n_nodes: int,
-                      d: int, bins: int):
-    """Per-(node, feature, bin) grad/hess sums for one level — the
-    ADDITIVE piece of split finding: the out-of-core trainer accumulates
-    these over streamed batches and decides splits from the totals."""
+def _level_histograms_segsum(binned, node_ids, grad, hess, n_nodes: int,
+                             d: int, bins: int):
+    """segment_sum form: one scatter-add per (row, feature) key."""
     live = node_ids >= 0
     safe_node = jnp.where(live, node_ids, 0)
     # (node, feature, bin) -> flat key; dead rows land in a scratch key 0
@@ -125,6 +131,60 @@ def _level_histograms(binned, node_ids, grad, hess, n_nodes: int,
                                  flat, seg)
     return (g_hist.reshape(n_nodes, d, bins),
             h_hist.reshape(n_nodes, d, bins))
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "d", "bins"))
+def _level_histograms_mxu(binned, node_ids, grad, hess, n_nodes: int,
+                          d: int, bins: int):
+    """MXU form: hist[node, f, bin] = (onehot_node * value)^T @
+    onehot_bin_f — histogramming as n x n_nodes x bins matmul
+    contractions (no scatter anywhere), scanned over features so the
+    transient one-hots stay at (n, n_nodes) + (n, bins).  ~2*n*nodes*
+    bins MAC per (feature, value) — MXU work standing in for
+    segment_sum's per-element random accumulation."""
+    live = node_ids >= 0
+    safe_node = jnp.where(live, node_ids, 0)
+    w = live.astype(grad.dtype)
+    # (n, n_nodes) one-hots pre-scaled by the two accumulated values —
+    # rows of dead nodes carry zeros, so scratch-node pollution is moot
+    node_oh = (safe_node[:, None]
+               == jnp.arange(n_nodes, dtype=jnp.int32)[None, :])
+    gv = jnp.where(node_oh, (grad * w)[:, None], 0.0)   # (n, n_nodes)
+    hv = jnp.where(node_oh, (hess * w)[:, None], 0.0)
+
+    def per_feature(_, f):
+        bin_oh = (binned[:, f][:, None]
+                  == jnp.arange(bins, dtype=jnp.int32)[None, :]
+                  ).astype(grad.dtype)                  # (n, bins)
+        g_f = jax.lax.dot_general(
+            gv, bin_oh, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (n_nodes, bins)
+        h_f = jax.lax.dot_general(
+            hv, bin_oh, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return None, (g_f, h_f)
+
+    _, (g_hist, h_hist) = jax.lax.scan(
+        per_feature, None, jnp.arange(d, dtype=jnp.int32))
+    # scan stacks (d, n_nodes, bins) -> (n_nodes, d, bins)
+    return (jnp.transpose(g_hist, (1, 0, 2)),
+            jnp.transpose(h_hist, (1, 0, 2)))
+
+
+#: the dispatch table — unknown HIST_IMPL values raise KeyError instead
+#: of silently running the wrong implementation
+_HIST_IMPLS = {"segsum": _level_histograms_segsum,
+               "mxu": _level_histograms_mxu}
+
+
+def _level_histograms(binned, node_ids, grad, hess, n_nodes: int,
+                      d: int, bins: int):
+    """Per-(node, feature, bin) grad/hess sums for one level — the
+    ADDITIVE piece of split finding: the out-of-core trainer accumulates
+    these over streamed batches and decides splits from the totals.
+    Dispatches on :data:`HIST_IMPL`."""
+    return _HIST_IMPLS[HIST_IMPL](binned, node_ids, grad, hess,
+                                  n_nodes, d, bins)
 
 
 def _level_splits(g_hist, h_hist, reg_lambda: float,
@@ -174,10 +234,10 @@ def _apply_split(binned, node_ids, best_feature, best_bin, best_gain):
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "d", "bins", "reg_lambda",
-                                   "min_child_weight"))
+                                   "min_child_weight", "hist_impl"))
 def _build_level(binned, node_ids, grad, hess, n_nodes: int,
                  d: int, bins: int, reg_lambda: float,
-                 min_child_weight: float):
+                 min_child_weight: float, hist_impl: str = "segsum"):
     """One tree level for all ``n_nodes`` nodes at once
     (histograms -> splits -> routing; the three pieces are separate
     functions so the out-of-core trainer can accumulate histograms over
@@ -187,8 +247,8 @@ def _build_level(binned, node_ids, grad, hess, n_nodes: int,
     new_node_ids (n,)).  ``node_ids`` are level-local in [0, n_nodes) with
     -1 marking rows already settled in a leaf.
     """
-    g_hist, h_hist = _level_histograms(binned, node_ids, grad, hess,
-                                       n_nodes, d, bins)
+    g_hist, h_hist = _HIST_IMPLS[hist_impl](binned, node_ids, grad, hess,
+                                            n_nodes, d, bins)
     best_feature, best_bin, best_gain = _level_splits(
         g_hist, h_hist, reg_lambda, min_child_weight)
     new_ids = _apply_split(binned, node_ids, best_feature, best_bin,
@@ -228,7 +288,8 @@ def _train_one_tree(binned, g, h, d: int, config: GBTConfig):
         n_nodes = 2 ** level
         f, b, gain, node_ids = _build_level(
             binned, node_ids, g, h, n_nodes, d, bins,
-            config.reg_lambda, config.min_child_weight)
+            config.reg_lambda, config.min_child_weight,
+            hist_impl=HIST_IMPL)
         level_feature.append(np.asarray(f))
         level_bin.append(np.asarray(b))
         level_gain.append(np.asarray(gain))

@@ -1,0 +1,189 @@
+"""Wide&Deep step-variant shootout on the real chip.
+
+Times one training step at the bench stretch config (26 x 40k vocab,
+emb 64, mlp (1024, 512, 256), batch 8192) for every candidate
+table-gradient implementation, so round 5's default-placement decision
+is a measurement, not a guess:
+
+- ``dense``          — autodiff scatter (the r4 baseline, 18.8 ms).
+- ``routed_gather``  — static route, scatter-free inverse-map placement
+                       (the r5 fit() default).
+- ``routed_scatter`` — static route, sorted-unique scatter placement.
+- ``routed_gather_sorted_fwd`` — EXPERIMENT: the forward reads the
+  embedding table at ``sorted_ids`` (ascending rows — DMA-friendly)
+  and un-permutes within the small (slots, emb) array, so ALL
+  big-table access (forward read, backward dense write) is ascending;
+  the random permutes touch only 54 MB arrays.  Not in the product
+  path until this script proves it.
+- ``lazy``           — LazyAdam (context: the r4 honest negative).
+
+Run (relay up):  python scripts/wdl_step_experiments.py
+Writes one JSON line; paste into R5_TPU_STATUS.md.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.recommendation.widedeep import (
+        _field_offsets,
+        build_reference_train_step,
+        forward_from_rows,
+        init_params,
+    )
+    from flink_ml_tpu.ops.emb_grad import (
+        emb_grad_route,
+        routed_table_grad_gather,
+    )
+
+    smoke = jax.default_backend() != "tpu"
+    n_fields, d_dense = 26, 13
+    vocab_each = (1 << 20) // n_fields if not smoke else 64
+    vocab_sizes = (vocab_each,) * n_fields
+    emb_dim = 64 if not smoke else 8
+    hidden = (1024, 512, 256) if not smoke else (32, 16)
+    batch = (1 << 13) if not smoke else (1 << 8)
+    steps = 8 if not smoke else 2
+    total_vocab = int(np.sum(vocab_sizes))
+    lr = 1e-2
+
+    rng = np.random.default_rng(17)
+    offs = _field_offsets(vocab_sizes)
+    cat_host = (rng.integers(0, vocab_each,
+                             size=(steps, batch, n_fields)).astype(np.int32)
+                + offs[None, None, :].astype(np.int32))
+    dense = jnp.asarray(
+        rng.normal(size=(steps, batch, d_dense)).astype(np.float32))
+    cat = jnp.asarray(cat_host)
+    y = jnp.asarray(
+        rng.integers(0, 2, size=(steps, batch)).astype(np.float32))
+    mask = jnp.ones((steps, batch), jnp.float32)
+
+    route_g = emb_grad_route(cat_host, total_vocab, placement="gather")
+    route_s = emb_grad_route(cat_host, total_vocab, placement="scatter")
+    # inverse permutation for the sorted-forward experiment:
+    # inv[order[i]] = i, so rows_sorted[inv] restores batch order
+    inv_host = np.empty_like(np.asarray(route_g.order))
+    for s in range(steps):
+        inv_host[s][np.asarray(route_g.order[s])] = np.arange(
+            inv_host.shape[1], dtype=np.int32)
+    inv = jnp.asarray(inv_host)
+
+    def sorted_fwd_step():
+        """Custom step: ascending-row table reads + small-array permutes,
+        gather-placement backward.  Matches dense Adam up to f32 order."""
+        params = jax.tree_util.tree_map(
+            jnp.asarray,
+            init_params(np.random.default_rng(0), d_dense, vocab_sizes,
+                        emb_dim, hidden))
+        opt = optax.adam(lr)
+
+        def batch_step(params, opt_state, dense_b, labels, mask_b,
+                       r_order, r_sid, r_pos_map, r_inv):
+            rest = {k: v for k, v in params.items()
+                    if k not in ("emb", "wide_cat")}
+            # forward table reads at ASCENDING rows, then un-permute
+            # inside the small (slots, emb) array (jax.lax.stop_gradient
+            # is not needed: the rows enter the diff'd fn as inputs, so
+            # the backward below is ours, not autodiff's)
+            emb_rows = params["emb"][r_sid][r_inv].reshape(
+                batch, n_fields, emb_dim)
+            wide_rows = params["wide_cat"][r_sid][r_inv].reshape(
+                batch, n_fields)
+
+            def loss_rows(rest, emb_rows, wide_rows):
+                return logistic_loss(
+                    forward_from_rows(rest, dense_b, wide_rows, emb_rows),
+                    labels, mask_b)
+
+            loss, (g_rest, g_emb, g_wide) = jax.value_and_grad(
+                loss_rows, argnums=(0, 1, 2))(rest, emb_rows, wide_rows)
+            # backward identical to the gather placement (the route's own
+            # permute gather runs on the small grad arrays)
+            grads = {
+                **g_rest,
+                "emb": routed_table_grad_gather(
+                    g_emb.reshape(-1, emb_dim), r_order, r_sid,
+                    r_pos_map, fold_passes=route_g.fold_passes),
+                "wide_cat": routed_table_grad_gather(
+                    g_wide.reshape(-1), r_order, r_sid, r_pos_map,
+                    fold_passes=route_g.fold_passes),
+            }
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return jax.jit(batch_step), params, opt.init(params)
+
+    def measure(kind: str) -> float:
+        if kind == "sorted_fwd":
+            step, params, opt_state = sorted_fwd_step()
+            rt = (route_g.order, route_g.sorted_ids, route_g.pos_map, inv)
+
+            def call(p, o, i):
+                return step(p, o, dense[i], y[i], mask[i],
+                            *(a[i] for a in rt))
+        else:
+            route = {"gather": route_g, "scatter": route_s}.get(kind)
+            step, params, opt_state = build_reference_train_step(
+                d_dense, vocab_sizes, emb_dim, hidden, lr=lr,
+                lazy_embeddings=(kind == "lazy"), route=route)
+            rt = route.stacked_arrays() if route is not None else ()
+
+            def call(p, o, i):
+                return step(p, o, dense[i], cat[i], y[i], mask[i],
+                            *(a[i] for a in rt))
+
+        @jax.jit
+        def run(params, opt_state):
+            def body(carry, i):
+                p, o = carry
+                p, o, loss = call(p, o, i)
+                return (p, o), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state),
+                jnp.arange(steps, dtype=jnp.int32))
+            return params, opt_state, losses
+
+        p, o, losses = run(params, opt_state)
+        losses0 = np.asarray(losses)
+        assert np.all(np.isfinite(losses0)), kind
+        if kind != "lazy":
+            # every dense-Adam variant must trace the same trajectory
+            # (differences are f32 summation order only) — a wrong route
+            # fails here, before any number is recorded
+            if "dense" in loss_ref:
+                np.testing.assert_allclose(losses0, loss_ref["dense"],
+                                           rtol=1e-4, atol=1e-5,
+                                           err_msg=kind)
+            else:
+                loss_ref["dense"] = losses0
+        trials = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            p, o, losses = run(p, o)
+            np.asarray(losses)
+            trials.append(time.perf_counter() - t0)
+        return min(trials) / steps
+
+    loss_ref: dict = {}
+    out = {"backend": jax.default_backend(),
+           "config": (f"{n_fields}x{vocab_each} vocab, emb {emb_dim}, "
+                      f"mlp {hidden}, batch {batch}"),
+           "fold_passes": route_g.fold_passes,
+           "variants_allclose": True}
+    for kind in ("dense", "gather", "scatter", "sorted_fwd", "lazy"):
+        out[f"{kind}_step_ms"] = round(1000 * measure(kind), 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -353,3 +353,59 @@ def test_outofcore_workdir_reusable_and_cleaned(tmp_path):
                                work_dir=wd)
     import os
     assert os.listdir(wd) == []   # run dirs removed on return
+
+
+def test_mxu_histograms_match_segsum():
+    """The MXU double-one-hot histogram must equal the segment_sum form
+    (f32 summation order aside) — including dead rows (-1) and empty
+    nodes — and produce identical trees end-to-end."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.models.common import gbt
+
+    rng = np.random.default_rng(21)
+    n, d, bins, n_nodes = 512, 5, 16, 4
+    binned = jnp.asarray(rng.integers(0, bins, size=(n, d)), jnp.int32)
+    ids = jnp.asarray(
+        np.where(rng.random(n) < 0.2, -1,
+                 rng.integers(0, n_nodes, size=n)), jnp.int32)
+    g = jnp.asarray(rng.normal(size=n), jnp.float32)
+    h = jnp.asarray(rng.random(n) + 0.1, jnp.float32)
+    gs, hs = gbt._level_histograms_segsum(binned, ids, g, h, n_nodes, d,
+                                          bins)
+    gm, hm = gbt._level_histograms_mxu(binned, ids, g, h, n_nodes, d,
+                                       bins)
+    np.testing.assert_allclose(np.asarray(gm), np.asarray(gs),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hm), np.asarray(hs),
+                               rtol=1e-5, atol=1e-5)
+
+    # end-to-end: the two impls grow the same forest
+    X = rng.normal(size=(1024, 4))
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+
+    def gh_fn(y, pred):
+        p = 1.0 / (1.0 + np.exp(-pred))
+        return (p - y), np.maximum(p * (1.0 - p), 1e-16)
+
+    cfg = gbt.GBTConfig(num_trees=3, max_depth=3)
+    old = gbt.HIST_IMPL
+    try:
+        gbt.HIST_IMPL = "segsum"
+        f1 = gbt.train_forest(X, y, gh_fn, 0.0, cfg)
+        gbt.HIST_IMPL = "mxu"
+        f2 = gbt.train_forest(X, y, gh_fn, 0.0, cfg)
+    finally:
+        gbt.HIST_IMPL = old
+    # prediction-space equivalence, not exact trees: near-tie argmax
+    # splits may legitimately differ under f32 summation order
+    np.testing.assert_allclose(gbt.predict_forest(X, f1),
+                               gbt.predict_forest(X, f2),
+                               rtol=1e-3, atol=1e-3)
+    # unknown impl names fail loudly, never silently fall back
+    try:
+        gbt.HIST_IMPL = "typo"
+        with pytest.raises(KeyError):
+            gbt._level_histograms(binned, ids, g, h, n_nodes, d, bins)
+    finally:
+        gbt.HIST_IMPL = old
