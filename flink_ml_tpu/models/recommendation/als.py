@@ -57,6 +57,115 @@ __all__ = ["ALS", "ALSModel", "ALSParams", "ALSModelParams"]
 
 _CHUNK = 65536  # ratings per scan step: (chunk, rank^2) is the HBM high-water
 
+#: sorted-path chunk: (chunk, rank^2) outer-product transient per scan
+#: step (134 MB at rank 64) — smaller than _CHUNK because the sorted
+#: path materializes the outers for its MXU contraction
+_SORTED_CHUNK = 8192
+
+#: 'auto' picks the sorted path only while every chunk's group band
+#: stays this narrow: per-chunk MXU work scales with span, so long-tail
+#: data (most groups with 1-2 ratings — the common recommendation
+#: shape) can drive span toward the chunk size and make the one-hot
+#: contraction orders of magnitude more work than the scatter it
+#: replaces.  Span is known at host plan-build time, so the fallback is
+#: free to decide.
+_NEQ_AUTO_SPAN_CAP = 256
+
+
+class NeqPlan:
+    """Static routing for :func:`_normal_equations_sorted` — one host
+    sort per fit side (the ratings are fixed for the whole fit, the
+    same replay insight as the LR/WDL static routes).
+
+    Sorting by group makes each scan chunk's groups a NARROW CONTIGUOUS
+    band ``[g_lo, g_lo + span)`` (``span`` = static max band over
+    chunks), so the normal-equation accumulation becomes one small MXU
+    contraction + one dynamic-slice add per chunk instead of per-rating
+    scatter-adds.  A group whose run crosses a chunk boundary simply
+    keeps accumulating into the same rows from the next chunk — heavy
+    groups need no special path.
+    """
+
+    def __init__(self, group_idx: np.ndarray, chunk: int = _SORTED_CHUNK):
+        group_idx = np.asarray(group_idx)
+        nnz = group_idx.shape[0]
+        self.chunk = int(min(chunk, max(nnz, 1)))
+        self.order = np.argsort(group_idx, kind="stable").astype(np.int64)
+        sg = group_idx[self.order].astype(np.int32)
+        pad = (-nnz) % self.chunk
+        if pad:
+            sg = np.concatenate([sg, np.full(pad, sg[-1] if nnz else 0,
+                                             np.int32)])
+        self.nnz, self.pad = nnz, pad
+        n_chunks = sg.shape[0] // self.chunk
+        self.g_lo = sg[np.arange(n_chunks) * self.chunk].astype(np.int32)
+        local = sg - np.repeat(self.g_lo, self.chunk)
+        self.span = int(local.max(initial=0)) + 1
+        self.local_rank = local.astype(np.int32)
+
+    def sort_pad(self, a: np.ndarray, fill=0) -> np.ndarray:
+        """``a`` reordered by the plan's sort, padded to the chunk
+        multiple with ``fill`` (pad weights MUST be 0 — every
+        accumulator term is weight-scaled, which is what makes the pad
+        slots inert)."""
+        out = np.asarray(a)[self.order]
+        if self.pad:
+            out = np.concatenate(
+                [out, np.full((self.pad,) + out.shape[1:], fill,
+                              out.dtype)])
+        return out
+
+
+def _normal_equations_sorted(factors, other_idx, ratings, weights,
+                             local_rank, g_lo, n_groups: int, span: int,
+                             chunk: int, implicit: bool, alpha: float):
+    """Sorted-path normal equations: inputs are PRE-SORTED by group and
+    padded (see :class:`NeqPlan`).  Equals :func:`_normal_equations` up
+    to f32 summation order, with zero scatters."""
+    rank = factors.shape[1]
+    n_chunks = other_idx.shape[0] // chunk
+    span_iota = jnp.arange(span, dtype=jnp.int32)
+
+    def scan_step(carry, xs):
+        A, b, cnt = carry
+        o, r, w, lr_, glo = xs
+        y = factors[o]                                   # (chunk, rank)
+        oh = lr_[:, None] == span_iota[None, :]          # (chunk, span)
+        if implicit:
+            conf_m1 = alpha * jnp.abs(r) * w             # c - 1, weighted
+            aw, bw = conf_m1, w + conf_m1
+        else:
+            aw, bw = w, w * r
+        outer = (y[:, :, None] * y[:, None, :]).reshape(-1, rank * rank)
+        A_part = jax.lax.dot_general(
+            jnp.where(oh, aw[:, None], 0.0), outer,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).reshape(span, rank, rank)
+        b_part = jax.lax.dot_general(
+            jnp.where(oh, bw[:, None], 0.0), y,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (span, rank)
+        cnt_part = jnp.sum(jnp.where(oh, w[:, None], 0.0), axis=0)
+        A = jax.lax.dynamic_update_slice(
+            A, jax.lax.dynamic_slice(
+                A, (glo, 0, 0), (span, rank, rank)) + A_part, (glo, 0, 0))
+        b = jax.lax.dynamic_update_slice(
+            b, jax.lax.dynamic_slice(b, (glo, 0), (span, rank)) + b_part,
+            (glo, 0))
+        cnt = jax.lax.dynamic_update_slice(
+            cnt, jax.lax.dynamic_slice(cnt, (glo,), (span,)) + cnt_part,
+            (glo,))
+        return (A, b, cnt), None
+
+    # `span` rows of slack so the last band's slice stays in bounds
+    init = (jnp.zeros((n_groups + span, rank, rank), factors.dtype),
+            jnp.zeros((n_groups + span, rank), factors.dtype),
+            jnp.zeros((n_groups + span,), factors.dtype))
+    xs = tuple(x.reshape(n_chunks, chunk, *x.shape[1:])
+               for x in (other_idx, ratings, weights, local_rank))
+    (A, b, cnt), _ = jax.lax.scan(scan_step, init, xs + (g_lo,))
+    return A[:n_groups], b[:n_groups], cnt[:n_groups]
+
 
 class ALSModelParams(HasPredictionCol):
     USER_COL = StringParam("userCol", "User id column.", default="user")
@@ -86,6 +195,16 @@ class ALSParams(ALSModelParams, HasMaxIter, HasSeed):
         default=False)
     ALPHA = FloatParam("alpha", "Implicit-feedback confidence scale.",
                        default=1.0, validator=ParamValidators.gt_eq(0))
+    NEQ_IMPL = StringParam(
+        "normalEquationsImpl",
+        "Normal-equation accumulation: 'sorted' (default via 'auto') — "
+        "one static host sort per fit turns the per-rating scatter-adds "
+        "into chunked MXU contractions over narrow contiguous group "
+        "bands (the LR/WDL static-routing insight applied to ALS); "
+        "'scatter' keeps the jnp .at[].add form.  Both are exact up to "
+        "f32 summation order.",
+        default="auto",
+        validator=ParamValidators.in_array(("auto", "sorted", "scatter")))
 
     def get_rating_col(self) -> str:
         return self.get(ALSParams.RATING_COL)
@@ -162,14 +281,11 @@ def _normal_equations(factors, group_idx, other_idx, ratings, weights,
     return A, b, cnt
 
 
-def _solve_side(prev, factors, group_idx, other_idx, ratings, weights,
-                n_groups: int, reg: float, implicit: bool, alpha: float):
-    """One half-epoch: re-solve ``prev``-side factors against fixed
-    ``factors``.  Groups with zero observed weight keep their previous
-    factors."""
+def _solve_from_neq(prev, factors, A, b, cnt, reg: float, implicit: bool):
+    """The solve tail shared by both normal-equation forms: regularize,
+    batched Cholesky, keep previous factors for unobserved/singular
+    groups."""
     rank = factors.shape[1]
-    A, b, cnt = _normal_equations(factors, group_idx, other_idx, ratings,
-                                  weights, n_groups, implicit, alpha)
     eye = jnp.eye(rank, dtype=factors.dtype)
     if implicit:
         gram = factors.T @ factors                         # shared Y^T Y
@@ -187,21 +303,55 @@ def _solve_side(prev, factors, group_idx, other_idx, ratings, weights,
     return jnp.where(ok, solved, prev)
 
 
+def _solve_side(prev, factors, group_idx, other_idx, ratings, weights,
+                n_groups: int, reg: float, implicit: bool, alpha: float):
+    """One half-epoch: re-solve ``prev``-side factors against fixed
+    ``factors``.  Groups with zero observed weight keep their previous
+    factors."""
+    A, b, cnt = _normal_equations(factors, group_idx, other_idx, ratings,
+                                  weights, n_groups, implicit, alpha)
+    return _solve_from_neq(prev, factors, A, b, cnt, reg, implicit)
+
+
+def _solve_side_sorted(prev, factors, plan: "NeqPlan", other_idx, ratings,
+                       weights, local_rank, g_lo, n_groups: int,
+                       reg: float, implicit: bool, alpha: float):
+    """Sorted-path half-epoch (arrays pre-sorted by this side's group)."""
+    A, b, cnt = _normal_equations_sorted(
+        factors, other_idx, ratings, weights, local_rank, g_lo,
+        n_groups, plan.span, plan.chunk, implicit, alpha)
+    return _solve_from_neq(prev, factors, A, b, cnt, reg, implicit)
+
+
 def als_epoch_step(n_users: int, n_items: int, reg: float, implicit: bool,
-                   alpha: float):
-    """One ALS epoch (users then items) as an ``iterate`` body."""
+                   alpha: float, plans=None):
+    """One ALS epoch (users then items) as an ``iterate`` body.
+
+    ``plans=(plan_u, plan_v)`` (:class:`NeqPlan`) switches to the
+    sorted normal equations — the data tuple is then the pre-sorted
+    per-side arrays (see :meth:`ALS.fit`) instead of the raw
+    ``(u_idx, i_idx, r, w)``."""
 
     def body(state, epoch, data):
         U, V = state
-        u_idx, i_idx, r, w = data
         # TPU f32 matmuls default to bf16 inputs; the normal equations and
         # triangular solves need true f32 or convergence stalls well short
         # of the CPU result (rank is tiny, so "highest" costs nothing).
         with jax.default_matmul_precision("highest"):
-            U = _solve_side(U, V, u_idx, i_idx, r, w, n_users, reg, implicit,
-                            alpha)
-            V = _solve_side(V, U, i_idx, u_idx, r, w, n_items, reg, implicit,
-                            alpha)
+            if plans is None:
+                u_idx, i_idx, r, w = data
+                U = _solve_side(U, V, u_idx, i_idx, r, w, n_users, reg,
+                                implicit, alpha)
+                V = _solve_side(V, U, i_idx, u_idx, r, w, n_items, reg,
+                                implicit, alpha)
+            else:
+                plan_u, plan_v = plans
+                (ou, ru, wu, lru, glu,
+                 ov, rv, wv, lrv, glv) = data
+                U = _solve_side_sorted(U, V, plan_u, ou, ru, wu, lru, glu,
+                                       n_users, reg, implicit, alpha)
+                V = _solve_side_sorted(V, U, plan_v, ov, rv, wv, lrv, glv,
+                                       n_items, reg, implicit, alpha)
         return IterationBodyResult(feedback=(U, V))
 
     return body
@@ -373,12 +523,39 @@ class ALS(ALSParams, Estimator[ALSModel]):
         V0 = (rng.normal(size=(len(item_ids), rank)) * scale).astype(
             np.float32)
 
-        data = (jnp.asarray(u_idx, jnp.int32), jnp.asarray(i_idx, jnp.int32),
-                jnp.asarray(ratings), jnp.ones(len(ratings), jnp.float32))
+        weights = np.ones(len(ratings), np.float32)
+        neq_mode = self.get(ALSParams.NEQ_IMPL)
+        plans = None
+        if neq_mode in ("auto", "sorted"):
+            # one static host sort per side (the ratings are fixed for
+            # the whole fit); the data tuple ships pre-sorted, so no
+            # per-epoch permute exists on device
+            plan_u = NeqPlan(u_idx)
+            plan_v = NeqPlan(i_idx)
+            if (neq_mode == "auto"
+                    and max(plan_u.span, plan_v.span) > _NEQ_AUTO_SPAN_CAP):
+                plan_u = plan_v = None   # long-tail data: scatter wins
+            else:
+                plans = (plan_u, plan_v)
+        if plans is not None:
+            data = tuple(jnp.asarray(a) for a in (
+                plan_u.sort_pad(i_idx.astype(np.int32)),
+                plan_u.sort_pad(ratings),
+                plan_u.sort_pad(weights),
+                plan_u.local_rank, plan_u.g_lo,
+                plan_v.sort_pad(u_idx.astype(np.int32)),
+                plan_v.sort_pad(ratings),
+                plan_v.sort_pad(weights),
+                plan_v.local_rank, plan_v.g_lo))
+        else:
+            plans = None
+            data = (jnp.asarray(u_idx, jnp.int32),
+                    jnp.asarray(i_idx, jnp.int32),
+                    jnp.asarray(ratings), jnp.asarray(weights))
         result = iterate(
             als_epoch_step(len(user_ids), len(item_ids),
                            self.get_reg_param(), self.get_implicit_prefs(),
-                           self.get_alpha()),
+                           self.get_alpha(), plans=plans),
             (jnp.asarray(U0), jnp.asarray(V0)),
             data,
             max_epochs=self.get_max_iter(),

@@ -28,10 +28,15 @@ Run (relay up): python scripts/put_overlap_probe.py
 """
 
 import json
+import os
+import sys
 import threading
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
 
 
 def main() -> None:

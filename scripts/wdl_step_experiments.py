@@ -22,9 +22,14 @@ Writes one JSON line; paste into R5_TPU_STATUS.md.
 """
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
 
 
 def main() -> None:
