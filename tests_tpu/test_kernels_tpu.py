@@ -193,3 +193,82 @@ def test_ell_margin_kernel_parity(tpu, rng):
         jnp.asarray(w), layv.src[0], layv.pos[0], layv.mask[0],
         m_len=m_len, val=layv.val[0], precision="highest"))
     np.testing.assert_allclose(got[:batch], wantv, atol=1e-4)
+
+
+def test_routed_table_grad_both_placements_on_device(tpu, rng):
+    """The r5 routed table gradients (ops/emb_grad.py): both placements
+    must compile and match the scatter-add oracle on the real chip
+    (pure-XLA paths, but the sorted-unique scatter flags and the big
+    row-gather are exactly what a backend change could break)."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.ops.emb_grad import emb_grad_route
+
+    vocab, emb = 4096, 8
+    cat = rng.integers(0, vocab, size=(2, 64, 4)).astype(np.int64)
+    g = rng.normal(size=(256, emb)).astype(np.float32)
+    want = np.zeros((vocab, emb), np.float64)
+    np.add.at(want, cat[0].reshape(-1), g)
+    for placement in ("gather", "scatter"):
+        route = emb_grad_route(cat, vocab, placement=placement)
+        got = np.asarray(route.apply(
+            jnp.asarray(g), *(jnp.asarray(np.asarray(a))
+                              for a in route.step_slice(0))))
+        np.testing.assert_allclose(got, want.astype(np.float32),
+                                   rtol=1e-4, atol=1e-4, err_msg=placement)
+
+
+def test_als_sorted_neq_on_device(tpu, rng):
+    """Sorted MXU normal equations vs the scatter form on the chip
+    (dynamic-slice band accumulation + one-hot dot_general under
+    'highest' precision)."""
+    import jax
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.models.recommendation.als import (
+        NeqPlan, _normal_equations, _normal_equations_sorted)
+
+    n_groups, n_other, nnz, rank = 16, 8, 512, 4
+    g = rng.integers(0, n_groups, size=nnz)
+    o = rng.integers(0, n_other, size=nnz).astype(np.int32)
+    r = rng.normal(size=nnz).astype(np.float32)
+    w = np.ones(nnz, np.float32)
+    factors = rng.normal(size=(n_other, rank)).astype(np.float32)
+    plan = NeqPlan(g, chunk=128)
+    with jax.default_matmul_precision("highest"):
+        A0, b0, c0 = _normal_equations(
+            jnp.asarray(factors), jnp.asarray(g, jnp.int32),
+            jnp.asarray(o), jnp.asarray(r), jnp.asarray(w),
+            n_groups, False, 1.0)
+        A1, b1, c1 = _normal_equations_sorted(
+            jnp.asarray(factors), jnp.asarray(plan.sort_pad(o)),
+            jnp.asarray(plan.sort_pad(r)), jnp.asarray(plan.sort_pad(w)),
+            jnp.asarray(plan.local_rank), jnp.asarray(plan.g_lo),
+            n_groups, plan.span, plan.chunk, False, 1.0)
+    np.testing.assert_allclose(np.asarray(A1), np.asarray(A0),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(b1), np.asarray(b0),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c0),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gbt_mxu_hist_on_device(tpu, rng):
+    """MXU double-one-hot histograms vs segment_sum on the chip."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.models.common import gbt
+
+    n, d, bins, n_nodes = 256, 4, 16, 4
+    binned = jnp.asarray(rng.integers(0, bins, size=(n, d)), jnp.int32)
+    ids = jnp.asarray(rng.integers(-1, n_nodes, size=n), jnp.int32)
+    g = jnp.asarray(rng.normal(size=n), jnp.float32)
+    h = jnp.asarray(rng.random(n) + 0.1, jnp.float32)
+    gs, hs = gbt._level_histograms_segsum(binned, ids, g, h, n_nodes, d,
+                                          bins)
+    gm, hm = gbt._level_histograms_mxu(binned, ids, g, h, n_nodes, d,
+                                       bins)
+    np.testing.assert_allclose(np.asarray(gm), np.asarray(gs),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(hm), np.asarray(hs),
+                               rtol=1e-4, atol=1e-4)
