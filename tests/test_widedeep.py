@@ -404,3 +404,20 @@ def test_routed_on_rejected_by_streaming_fit(tmp_path):
            .set(WideDeep.ROUTED_EMB_GRAD, "on"))
     with pytest.raises(ValueError, match="streaming"):
         est.fit_outofcore(lambda: iter(()))
+
+
+def test_routed_fit_exact_with_padding_rows():
+    """n not divisible by the global batch: the epoch layout pads rows
+    with mask 0 and cat id 0 — their loss gradients are exactly zero,
+    so the routed path must still match the autodiff-scatter fit."""
+    t = _ctr_table(n=500)          # 500 % 32 != 0 -> padded final rows
+    def fit(mode):
+        return (WideDeep().set_vocab_sizes([10, 7]).set_max_iter(6)
+                .set_seed(0).set(WideDeep.ROUTED_EMB_GRAD, mode).fit(t))
+    m_r, m_d = fit("on"), fit("off")
+    np.testing.assert_allclose(m_r._loss_log, m_d._loss_log,
+                               rtol=1e-5, atol=1e-6)
+    for k in ("emb", "wide_cat"):
+        np.testing.assert_allclose(np.asarray(m_r._params[k]),
+                                   np.asarray(m_d._params[k]),
+                                   rtol=1e-4, atol=1e-5)
