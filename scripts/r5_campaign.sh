@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-5 TPU measurement campaign — run the moment the relay is up.
+# Order per R5_TPU_STATUS.md: kernel tier gates timing; headline bench
+# extends the r4 band; probes decide the armed chip verdicts.
+# Usage: bash scripts/r5_campaign.sh [run_number]
+set -u
+cd "$(dirname "$0")/.."
+N="${1:-1}"
+
+echo "== 0. relay probe (90 s cap)"
+timeout 90 python -c "import jax; print(jax.devices())" || {
+    echo "RELAY DOWN — aborting campaign"; exit 1; }
+
+echo "== 1. TPU kernel tier (gates all timing)"
+python -m pytest tests_tpu/ -m tpu -q | tail -3 || {
+    echo "KERNEL TIER RED — fix before timing"; exit 1; }
+
+echo "== 2. headline bench -> TPU_BENCH_r05_run${N}.json"
+python bench.py > "TPU_BENCH_r05_run${N}.json" 2> "TPU_BENCH_r05_run${N}.err"
+tail -1 "TPU_BENCH_r05_run${N}.json"
+
+echo "== 3. put-overlap probe"
+python scripts/put_overlap_probe.py | tee "TPU_PUT_PROBE_r05.json"
+
+echo "== 4. WDL step shootout"
+python scripts/wdl_step_experiments.py | tee "TPU_WDL_SHOOTOUT_r05.json"
+
+echo "== campaign run ${N} done; record verdicts in R5_TPU_STATUS.md"
