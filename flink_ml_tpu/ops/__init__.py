@@ -1,3 +1,9 @@
+from .emb_grad import (  # noqa: F401
+    EmbGradRoute,
+    emb_grad_route,
+    routed_table_grad,
+    routed_table_grad_gather,
+)
 from .ell_scatter import (  # noqa: F401
     EllLayout,
     ell_layout,
