@@ -1136,7 +1136,7 @@ def bench_gbt(results: dict) -> None:
     histogram/split/route on device, host grad/hess between trees) on a
     512k x 32 binary problem, with a same-algorithm host-numpy
     single-tree anchor."""
-    import jax.numpy as jnp  # noqa: F401  (jax init before first use)
+    import jax.numpy as jnp
 
     from flink_ml_tpu.models.common.gbt import GBTConfig, train_forest
 
@@ -1179,14 +1179,12 @@ def bench_gbt(results: dict) -> None:
     # HISTOGRAMS (allclose — the two impls differ in f32 summation
     # order, so near-tie argmax splits may legitimately pick different
     # features; exact-tree equality would crash the bench on a ULP):
-    import jax.numpy as _jnp
-
     rng_p = np.random.default_rng(31)
-    binned_p = _jnp.asarray(rng_p.integers(0, bins, size=(4096, d)),
-                            _jnp.int32)
-    ids_p = _jnp.asarray(rng_p.integers(-1, 4, size=4096), _jnp.int32)
-    gp = _jnp.asarray(rng_p.normal(size=4096), _jnp.float32)
-    hp = _jnp.asarray(rng_p.random(4096) + 0.1, _jnp.float32)
+    binned_p = jnp.asarray(rng_p.integers(0, bins, size=(4096, d)),
+                           jnp.int32)
+    ids_p = jnp.asarray(rng_p.integers(-1, 4, size=4096), jnp.int32)
+    gp = jnp.asarray(rng_p.normal(size=4096), jnp.float32)
+    hp = jnp.asarray(rng_p.random(4096) + 0.1, jnp.float32)
     gs, hs = gbt_mod._level_histograms_segsum(binned_p, ids_p, gp, hp,
                                               4, d, bins)
     gm, hm = gbt_mod._level_histograms_mxu(binned_p, ids_p, gp, hp,
