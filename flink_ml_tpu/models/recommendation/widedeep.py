@@ -287,6 +287,7 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
 
     def fit_outofcore(self, make_reader, *, mesh=None,
                       prefetch_depth: int = 2, prefetch_workers: int = 1,
+                      prefetch_put_workers: int = 1,
                       prefetch_stats=None) -> "WideDeepModel":
         """Out-of-core ``fit``: epochs stream from ``make_reader()`` (the
         ``sgd_fit_outofcore`` reader protocol — a fresh per-epoch
@@ -363,6 +364,7 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
             for dev_batch in prefetch_to_device(
                     reader, depth=prefetch_depth, transform=to_host_batch,
                     sharding=sharding, workers=prefetch_workers,
+                    put_workers=prefetch_put_workers,
                     stats=prefetch_stats, put_fn=put_fn):
                 if step_fn is None:
                     d_dense = int(dev_batch[0].shape[1])
