@@ -190,6 +190,50 @@ def main() -> None:
            "variants_allclose": True}
     for kind in ("dense", "gather", "scatter", "sorted_fwd", "lazy"):
         out[f"{kind}_step_ms"] = round(1000 * measure(kind), 3)
+
+    # stage ablation of the routed-gather table gradient alone (no MLP,
+    # no Adam): attributes any gap to permute / folds / placement so a
+    # miss against the <=12 ms step target names its next lever.  The
+    # fold-less and stage-only timings compute WRONG values on purpose —
+    # they exist to time the remaining stages.
+    from flink_ml_tpu.ops.emb_grad import _folded_ext
+
+    S = batch * n_fields
+    g_keys = jax.random.split(jax.random.PRNGKey(5), 2)
+    g_flat = jax.random.normal(g_keys[0], (S, emb_dim), jnp.float32)
+    rt = route_g.stacked_arrays()
+
+    def timed(fn):
+        @jax.jit
+        def run(g_flat, mul):
+            def body(carry, i):
+                r = fn(g_flat * mul, rt[0][i], rt[1][i], rt[2][i])
+                return carry, jnp.sum(r[:1])
+
+            return jax.lax.scan(body, 0.0,
+                                jnp.arange(steps, dtype=jnp.int32))
+
+        run(g_flat, 1.0)
+        trials = []
+        for t in range(1, 4):
+            t0 = time.perf_counter()
+            _, s = run(g_flat, 1.0 + t * 1e-6)
+            np.asarray(s)
+            trials.append(time.perf_counter() - t0)
+        return round(1000 * min(trials) / steps, 3)
+
+    out["ablate_grad_full_ms"] = timed(
+        lambda g, o, sid, pm: routed_table_grad_gather(
+            g, o, sid, pm, fold_passes=route_g.fold_passes))
+    out["ablate_grad_nofold_ms"] = timed(
+        lambda g, o, sid, pm: routed_table_grad_gather(
+            g, o, sid, pm, fold_passes=0))
+    out["ablate_permute_only_ms"] = timed(
+        lambda g, o, sid, pm: jnp.take(g, o, axis=0, unique_indices=True))
+    out["ablate_fold_only_ms"] = timed(
+        lambda g, o, sid, pm: _folded_ext(
+            g, jnp.arange(S, dtype=jnp.int32), sid,
+            route_g.fold_passes)[0])
     print(json.dumps(out))
 
 
