@@ -15,14 +15,17 @@ echo "== 1. TPU kernel tier (gates all timing)"
 python -m pytest tests_tpu/ -m tpu -q | tail -3 || {
     echo "KERNEL TIER RED — fix before timing"; exit 1; }
 
-echo "== 2. headline bench -> TPU_BENCH_r05_run${N}.json"
-python bench.py > "TPU_BENCH_r05_run${N}.json" 2> "TPU_BENCH_r05_run${N}.err"
-tail -1 "TPU_BENCH_r05_run${N}.json"
+# short-window ordering: the round's decision measurements (minutes)
+# run BEFORE the full bench (~15-20 min) so a brief relay window still
+# answers the armed verdicts
+echo "== 2. WDL step shootout (the r5 headline decision)"
+python scripts/wdl_step_experiments.py | tee "TPU_WDL_SHOOTOUT_r05.json"
 
 echo "== 3. put-overlap probe"
 python scripts/put_overlap_probe.py | tee "TPU_PUT_PROBE_r05.json"
 
-echo "== 4. WDL step shootout"
-python scripts/wdl_step_experiments.py | tee "TPU_WDL_SHOOTOUT_r05.json"
+echo "== 4. full bench -> TPU_BENCH_r05_run${N}.json"
+python bench.py > "TPU_BENCH_r05_run${N}.json" 2> "TPU_BENCH_r05_run${N}.err"
+tail -1 "TPU_BENCH_r05_run${N}.json"
 
 echo "== campaign run ${N} done; record verdicts in R5_TPU_STATUS.md"
