@@ -407,7 +407,13 @@ def bench_logreg_outofcore(results: dict) -> None:
         # v3 (r4): 3 epochs with the decoded replay cache engaged — the
         # per-epoch average now mixes one record epoch with two replay
         # epochs (v2 averaged two identical decode-every-epoch passes)
-        "outofcore_metric_version": 3,
+        # v4 (r6): the fit runs chunked dispatch (steps_per_dispatch=8
+        # default) — epoch times amortize the per-dispatch round-trip
+        # 8x, and put/wait attribution is per-CHUNK (~1/8 the puts), so
+        # v3-and-earlier per-batch numbers are not comparable.  The
+        # put_workers=4 A/B deliberately pins steps_per_dispatch=1 to
+        # keep measuring per-batch put parallelism.
+        "outofcore_metric_version": 4,
     }
 
     # raw-TSV leg of the north-star ingest: Criteo parser MB/s (host-only
@@ -466,15 +472,51 @@ def bench_logreg_outofcore(results: dict) -> None:
         config=SGDConfig(learning_rate=0.5, max_epochs=2, tol=0),
         dense_key="features_dense", indices_key="features_indices",
         prefetch_workers=workers, prefetch_put_workers=4,
+        # per-batch dispatch keeps this leg measuring PUT parallelism:
+        # chunked puts would collapse it to ~2 transfers/epoch
+        steps_per_dispatch=1,
         prefetch_stats=stats_pw, cache_decoded=False)
     pw_wall_s = time.perf_counter() - t0
     pw = {k: round(v / 2 * 1000, 1)
-          for k, v in stats_pw.as_dict().items() if k != "batches"}
+          for k, v in stats_pw.as_dict().items()
+          if k not in ("batches", "chunks")}
     notes["outofcore_put_workers4"] = {
         "epoch_s": round(pw_wall_s / 2, 2),
         "device_put_ms_per_epoch": pw["put_s"],
         "infeed_gap_ms_per_epoch": pw["consumer_wait_s"],
     }
+
+    # chunked-dispatch A/B (this PR): W=1 (one jit dispatch per batch)
+    # vs the default W=8 scan under otherwise-identical settings
+    # (cache_decoded off so every epoch pays the same decode).  The
+    # headline is the closed fraction of the fused-vs-out-of-core gap —
+    # how much of the per-batch-dispatch overhead the chunked scan
+    # recovers.
+    # A W=8 chunk pads short epochs to 8 steps (dead steps compute and
+    # discard — the price of one compiled program for every chunk), so
+    # the A/B needs >= 2 full chunks per epoch to measure amortization
+    # rather than padding waste: the smoke shape's 4-batch epoch is
+    # degenerate, so size the A/B's batch for 16 batches/epoch.
+    ab_batch = batch if rows // batch >= 16 else rows // 16
+    n_batches_ab = -(-rows // ab_batch)
+    chunk_ab = {}
+    for w_steps in (1, 8):
+        si_w: dict = {}
+        sgd_fit_outofcore(
+            logistic_loss,
+            lambda: DataCacheReader(cache, batch_rows=ab_batch),
+            num_features=LR_DIM,
+            config=SGDConfig(learning_rate=0.5, max_epochs=2, tol=0),
+            dense_key="features_dense", indices_key="features_indices",
+            prefetch_workers=workers, steps_per_dispatch=w_steps,
+            cache_decoded=False, stream_info=si_w)
+        # epoch 0 pays each W's one-time scan-program compile; the LAST
+        # epoch is the steady state the amortization claim is about
+        chunk_ab[w_steps] = {
+            "epoch_s": si_w["epoch_seconds"][-1],
+            "dispatches": si_w["dispatches_per_epoch"][-1],
+        }
+    w1_s, w8_s = chunk_ab[1]["epoch_s"], chunk_ab[8]["epoch_s"]
 
     # shuffled + block-keyed decode cache (r4): per-epoch reshuffle with
     # decode amortization — epoch 2 serves every block's decoded layout
@@ -501,8 +543,23 @@ def bench_logreg_outofcore(results: dict) -> None:
 
     fused_epoch_s = (rows / results["rows_per_sec"]
                      if "rows_per_sec" in results else float("nan"))
+    # chunked-dispatch breakdown: dispatch reduction at the default W=8
+    # and the fraction of the fused-vs-out-of-core gap the scan closed
+    gap = w1_s - fused_epoch_s
+    notes["outofcore_chunked"] = {
+        "steps_per_dispatch": stream_info.get("steps_per_dispatch"),
+        "dispatches_per_epoch": stream_info.get("dispatches_per_epoch"),
+        "ab_batches_per_epoch": n_batches_ab,
+        "dispatch_reduction_at_w8": round(
+            n_batches_ab / chunk_ab[8]["dispatches"], 2),
+        "w1_epoch_ms": round(1000 * w1_s, 1),
+        "w8_epoch_ms": round(1000 * w8_s, 1),
+        "gap_closed_fraction": (round((w1_s - w8_s) / gap, 3)
+                                if np.isfinite(gap) and gap > 0 else None),
+    }
     per_epoch = {k: round(v / cfg.max_epochs * 1000, 1)
-                 for k, v in stats.as_dict().items() if k != "batches"}
+                 for k, v in stats.as_dict().items()
+                 if k not in ("batches", "chunks")}
     # r4 decoded replay cache: epoch 0 decodes + records, epochs 1+ replay
     # from RAM — the steady-state multi-epoch rate is the REPLAY rate
     ep_s = stream_info.get("epoch_seconds", [])

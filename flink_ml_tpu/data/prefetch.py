@@ -24,6 +24,18 @@ cumulative seconds spent reading host batches, transforming, in
 ingest).  This is the instrumentation VERDICT r2 asked for: it separates
 host-decode from transfer from compute so the out-of-core benchmark can
 attribute its overhead.
+
+``chunks=W`` turns the pipeline's unit of work from one batch into a
+CHUNK of ``W`` consecutive batches stacked along a new leading axis —
+the feed side of chunked-scan dispatch: the consumer runs one jitted
+``lax.scan`` over the chunk, so ``W`` optimizer steps cost one host
+dispatch, and the ``device_put`` of chunk N+1 still overlaps compute on
+chunk N (the same double buffering, one level up).  The final short
+chunk pads by repeating its last batch; the per-chunk validity mask
+(1.0 for real batches) makes the pad steps inert in a masked scan.
+Chunk mode yields ``(chunk, mask, n_valid)`` triples — ``chunk`` the
+stacked device pytree, ``mask`` a device ``(W,)`` f32, ``n_valid`` the
+host-side real-batch count (no device sync needed to count steps).
 """
 
 from __future__ import annotations
@@ -38,8 +50,10 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 import jax
+import numpy as np
 
-__all__ = ["prefetch_to_device", "PrefetchStats"]
+__all__ = ["prefetch_to_device", "PrefetchStats", "masked_chunk_scan",
+           "chunk_consumer_plan"]
 
 _END = object()
 
@@ -48,21 +62,107 @@ _END = object()
 class PrefetchStats:
     """Cumulative pipeline timing (seconds) and batch count.  Single
     writer per field (each stage runs on one thread; transform workers
-    accumulate under the lock)."""
+    accumulate under the lock).
+
+    In ``chunks=W`` mode ``transform_s`` covers decode AND chunk
+    assembly (both run in the decode workers); ``assemble_s`` breaks out
+    the stack/pad/mask portion, ``put_s``/``wait_s`` become per-CHUNK
+    transfer/wait time, and ``chunks`` counts dispatched chunks
+    (``batches`` keeps counting real batches)."""
     read_s: float = 0.0        # source iterator next()
     transform_s: float = 0.0   # decode/pad (sum over workers)
     put_s: float = 0.0         # device_put scheduling
     wait_s: float = 0.0        # consumer blocked on empty queue
     batches: int = 0
+    assemble_s: float = 0.0    # chunk stack/pad/mask (within transform_s)
+    chunks: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False)
 
     def as_dict(self) -> dict:
-        return {"read_s": round(self.read_s, 4),
-                "transform_s": round(self.transform_s, 4),
-                "put_s": round(self.put_s, 4),
-                "consumer_wait_s": round(self.wait_s, 4),
-                "batches": self.batches}
+        d = {"read_s": round(self.read_s, 4),
+             "transform_s": round(self.transform_s, 4),
+             "put_s": round(self.put_s, 4),
+             "consumer_wait_s": round(self.wait_s, 4),
+             "batches": self.batches}
+        if self.chunks:
+            d["chunk_assemble_s"] = round(self.assemble_s, 4)
+            d["chunks"] = self.chunks
+        return d
+
+
+def _grouped(batches: Iterable[Any], size: int) -> Iterator[list]:
+    """Consecutive ``size``-item groups of ``batches`` (final group
+    short).  A mid-group source error propagates immediately — items
+    already read in the broken group are dropped, which keeps the error
+    in stream order from the consumer's point of view."""
+    group: list = []
+    for item in batches:
+        group.append(item)
+        if len(group) == size:
+            yield group
+            group = []
+    if group:
+        yield group
+
+
+def _assemble_chunk(items: list, size: int):
+    """Stack ``items`` (pytrees of equal-shaped leaves) along a new
+    leading axis, padding short chunks by repeating the last item;
+    returns ``(chunk, mask (size,) f32, n_valid)``."""
+    n_valid = len(items)
+    if n_valid < size:
+        items = items + [items[-1]] * (size - n_valid)
+    chunk = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *items)
+    mask = np.zeros((size,), np.float32)
+    mask[:n_valid] = 1.0
+    return chunk, mask, n_valid
+
+
+def masked_chunk_scan(step: Callable, state: Any, loss_sum, chunk, mask):
+    """THE consumer half of ``chunks=W``: run ``step(state, *batch) ->
+    (new_state, loss)`` over every stacked batch of ``chunk`` as one
+    ``lax.scan``, freezing ``state`` and skipping the loss accumulation
+    on masked (padded) steps — dead steps are exact no-ops, which is
+    what makes any two ``W`` values bit-exact on the same stream.  One
+    copy of the freeze/accumulate logic shared by the sgd and WideDeep
+    streaming fits (callers jit + donate the ``(state, loss_sum)``
+    carry); the hosted ``iterate`` chunk loop carries extra epoch/vote
+    structure and stays separate."""
+    import jax.numpy as jnp
+
+    def scan_step(carry, xs):
+        state, loss_sum = carry
+        *batch, m = xs
+        new_state, loss = step(state, *batch)
+        valid = m > 0
+        state = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(valid, n, o), new_state, state)
+        loss_sum = loss_sum + jnp.where(valid, loss, 0.0)
+        return (state, loss_sum), None
+
+    (state, loss_sum), _ = jax.lax.scan(scan_step, (state, loss_sum),
+                                        tuple(chunk) + (mask,))
+    return state, loss_sum
+
+
+def chunk_consumer_plan(mesh, specs, W: int, prefetch_depth: int):
+    """THE shared consumer wiring for ``chunks=W`` prefetch (one copy
+    for every adopter — sgd and WideDeep both use it): returns
+    ``(sharding, depth)`` where ``sharding`` describes the ``(chunk,
+    mask)`` pair — each per-batch PartitionSpec in ``specs`` gains a
+    leading (unsharded) chunk axis, the validity mask replicates — and
+    ``depth`` converts the caller's per-batch ``prefetch_depth`` into
+    chunks (``ceil(prefetch_depth / W)``).  NOTE the floor: staging
+    cannot drop below ONE chunk, so chunked mode keeps ``W`` batches
+    staged plus ``W`` in compute regardless of ``prefetch_depth`` —
+    memory-constrained deployments bound the footprint by lowering
+    ``steps_per_dispatch``, not ``prefetch_depth``."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = (tuple(NamedSharding(mesh, P(None, *p)) for p in specs),
+                NamedSharding(mesh, P()))
+    return sharding, max(1, -(-prefetch_depth // W))
 
 
 def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
@@ -71,10 +171,14 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
                        workers: int = 1,
                        put_workers: int = 1,
                        stats: Optional[PrefetchStats] = None,
-                       put_fn: Optional[Callable[[Any, Any], Any]] = None
+                       put_fn: Optional[Callable[[Any, Any], Any]] = None,
+                       chunks: Optional[int] = None
                        ) -> Iterator[Any]:
     """Iterate device-resident copies of ``batches``, staying ``depth``
-    batches ahead of the consumer.
+    UNITS OF WORK ahead of the consumer — a unit is one batch, or one
+    ``chunks=W``-batch chunk in chunk mode (so staging memory scales
+    with ``depth * W`` batches there; chunking callers size ``depth``
+    in chunks, typically 1).
 
     ``sharding`` (e.g. a ``NamedSharding`` or a pytree of them matching the
     batch structure) is passed to ``device_put``; ``transform`` runs on
@@ -97,6 +201,17 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
     ``jax.device_put``) — multi-host callers pass an assembly that builds
     non-fully-addressable global arrays from each process's local batch
     (``jax.make_array_from_process_local_data``).
+
+    ``chunks=W`` (an int >= 1; default None = classic per-batch yields)
+    groups every ``W`` consecutive (transformed) batches into one
+    stacked chunk (see module docstring); ``sharding`` then describes
+    the ``(chunk, mask)`` pair — stacked leaves carry a leading chunk
+    axis — and the iterator yields ``(chunk, mask, n_valid)`` triples.
+    ``chunks=1`` keeps one batch per chunk but still emits the stacked
+    triple form, so a ``W=1`` consumer runs the SAME scan program as
+    ``W>1`` (the bit-exact fallback).  Incompatible with ``put_fn``
+    (process-local assembly is per-batch); multi-process callers use
+    ``chunks=None``.
     """
     if depth < 1:
         raise ValueError(f"depth must be >= 1, got {depth}")
@@ -104,9 +219,36 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
         raise ValueError(f"workers must be >= 1, got {workers}")
     if put_workers < 1:
         raise ValueError(f"put_workers must be >= 1, got {put_workers}")
+    if chunks is not None and chunks < 1:
+        raise ValueError(f"chunks must be >= 1 (or None), got {chunks}")
+    if chunks is not None and put_fn is not None:
+        raise ValueError(
+            "chunks= does not compose with put_fn (process-local "
+            "assembly is per-batch); use chunks=None on process-"
+            "spanning meshes")
     st = stats or PrefetchStats()
 
+    if chunks is not None:
+        item_transform = transform
+        batches = _grouped(batches, chunks)
+
+        def transform(group):  # noqa: F811 — chunk-mode transform
+            items = ([item_transform(b) for b in group]
+                     if item_transform is not None else list(group))
+            t0 = time.perf_counter()
+            assembled = _assemble_chunk(items, chunks)
+            with st._lock:
+                st.assemble_s += time.perf_counter() - t0
+                st.chunks += 1
+            return assembled
+
     def put(batch, sh):
+        if chunks is not None:
+            chunk, mask, n_valid = batch
+            payload = (chunk, mask)
+            moved = jax.device_put(payload, sh) if sh is not None \
+                else jax.device_put(payload)
+            return moved + (n_valid,)
         # honor the documented 2-arg put_fn contract on BOTH branches
         if put_fn is not None:
             return put_fn(batch, sh)
@@ -166,21 +308,61 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
         # batch, flushed to q in source order as the prefix completes
         flush_lock = threading.Lock()
         pending: dict = {}
-        flush_state = {"next": 0, "total": None, "finished": False}
+        flush_state = {"next": 0, "total": None, "finished": False,
+                       "draining": False}
+        # latched once an in-stream error entry is FLUSHED: the consumer
+        # will raise at that seq, so later transfers are pure waste —
+        # putters check this before waiting on decodes / issuing puts
+        failed = threading.Event()
 
-        def _flush_ready_locked():
-            """Emit the completed prefix (and the terminal _END once the
-            reader's total is known and reached).  Caller holds
-            flush_lock; q puts under the lock are safe — the consumer
-            drains q independently, so progress is guaranteed."""
+        def _collect_ready_locked() -> list:
+            """Pop the completed prefix (appending the terminal _END once
+            the reader's total is known and reached).  Caller holds
+            flush_lock; no queue puts happen here — the blocking puts run
+            OUTSIDE the lock so put concurrency survives backpressure (a
+            full q must stall only the emitter, not every putter trying
+            to register a completion)."""
+            ready: list = []
             while flush_state["next"] in pending:
-                put_or_abandon(q, pending.pop(flush_state["next"]))
+                entry = pending.pop(flush_state["next"])
+                if isinstance(entry, BaseException):
+                    failed.set()
+                ready.append(entry)
                 flush_state["next"] += 1
             if (flush_state["total"] is not None
                     and flush_state["next"] >= flush_state["total"]
                     and not flush_state["finished"]):
                 flush_state["finished"] = True
-                put_or_abandon(q, _END)
+                ready.append(_END)
+            return ready
+
+        def _flush_ready():
+            """Emit every ready entry to q in source order.  Exactly one
+            thread drains at a time (the ``draining`` flag): a second
+            completer registers its entry and leaves — the active drainer
+            re-collects after each emit round, so nothing is stranded —
+            and the single-drainer rule is what preserves source order
+            now that the puts happen outside flush_lock."""
+            flush_lock.acquire()
+            try:
+                if flush_state["draining"]:
+                    return
+                flush_state["draining"] = True
+                try:
+                    while True:
+                        ready = _collect_ready_locked()
+                        if not ready:
+                            return
+                        flush_lock.release()
+                        try:
+                            for entry in ready:
+                                put_or_abandon(q, entry)
+                        finally:
+                            flush_lock.acquire()
+                finally:
+                    flush_state["draining"] = False
+            finally:
+                flush_lock.release()
 
         def reader():
             seq = 0
@@ -195,12 +377,14 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
                     st.read_s += time.perf_counter() - t0
                     if stop.is_set():
                         return
+                    if failed.is_set():
+                        break   # consumer will raise; stop reading ahead
                     put_or_abandon(
                         fq, (seq, pool.submit(timed_transform, batch)))
                     seq += 1
                 with flush_lock:
                     flush_state["total"] = seq
-                    _flush_ready_locked()   # covers the empty stream
+                _flush_ready()   # covers the empty stream
             except BaseException as exc:  # noqa: BLE001
                 # deliver the error IN STREAM ORDER: it enters the
                 # reassembly at the next seq, so every batch already
@@ -210,7 +394,7 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
                 with flush_lock:
                     pending[seq] = exc
                     flush_state["total"] = seq + 1
-                    _flush_ready_locked()
+                _flush_ready()
             for _ in range(put_workers):
                 put_or_abandon(fq, _END)
 
@@ -226,6 +410,11 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
 
         def putter():
             while True:
+                # a flushed in-stream error means the consumer raises at
+                # that seq: stop pulling work — every further device_put
+                # would transfer batches nobody will ever read
+                if failed.is_set():
+                    return
                 item = get_or_abandon(fq)
                 if item is _END:
                     return
@@ -237,13 +426,16 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
                 # futures.TimeoutError IS the builtin TimeoutError on
                 # 3.11+, so a transform failing with e.g.
                 # socket.timeout must still propagate, not spin.
-                while not stop.is_set() and not fut.done():
+                while not stop.is_set() and not failed.is_set() \
+                        and not fut.done():
                     futures.wait([fut], timeout=0.1)
-                if stop.is_set():
+                if stop.is_set() or failed.is_set():
                     fut.cancel()
                     return
                 try:
                     batch = fut.result()
+                    if failed.is_set():   # error flushed during decode
+                        return
                     t0 = time.perf_counter()
                     entry = put(batch, sharding)
                     with st._lock:
@@ -255,7 +447,7 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
                     entry = exc
                 with flush_lock:
                     pending[seq] = entry
-                    _flush_ready_locked()
+                _flush_ready()
                 if isinstance(entry, BaseException):
                     return
 
@@ -276,7 +468,7 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
                 return
             if isinstance(item, BaseException):
                 raise item
-            st.batches += 1
+            st.batches += item[2] if chunks is not None else 1
             yield item
     finally:
         stop.set()

@@ -57,10 +57,21 @@ class IterationConfig:
     # Donate the state buffers to the jitted step so the feedback pytree is
     # updated in place in HBM (flat memory across epochs).
     donate_state: bool = True
+    # Hosted-mode dispatch amortization: scan this many epochs per jit
+    # dispatch (device-resident data only).  Listener callbacks,
+    # termination-vote syncs, and checkpoint cuts move to CHUNK
+    # boundaries; results stay bit-exact vs steps_per_dispatch=1 (a
+    # terminated vote freezes the carried state inside the scan).  1 =
+    # the classic one-dispatch-per-epoch loop.
+    steps_per_dispatch: int = 1
 
     def __post_init__(self):
         if self.mode not in ("auto", "hosted", "fused"):
             raise ValueError(f"Unknown iteration mode {self.mode!r}")
+        if self.steps_per_dispatch < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1, got "
+                f"{self.steps_per_dispatch}")
 
 
 @dataclass

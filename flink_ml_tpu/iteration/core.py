@@ -249,6 +249,7 @@ def iterate(
     *,
     config: Optional[IterationConfig] = None,
     max_epochs: Optional[int] = None,
+    steps_per_dispatch: Optional[int] = None,
     listeners: Sequence[IterationListener] = (),
     per_round_init: Optional[Callable[[], Any]] = None,
     per_round: Optional[Sequence[str]] = None,
@@ -276,10 +277,24 @@ def iterate(
 
     Termination: ``max_epochs`` reached, OR the body's ``termination`` vote
     is zero/false, OR an iterator data source is exhausted.
+
+    ``steps_per_dispatch=W`` (hosted mode, device-resident data): scan
+    ``W`` epochs per jit dispatch — one host round-trip (and one
+    termination-vote sync) per ``W`` epochs instead of per epoch.
+    Listener callbacks and checkpoint cuts fire at chunk boundaries
+    (``on_epoch_watermark_incremented`` once per chunk, with the last
+    completed epoch's context).  Bit-exact vs ``W=1``: a mid-chunk
+    termination vote freezes the carried state for the rest of the
+    chunk, so the returned state is the voting epoch's feedback exactly
+    as in the per-epoch loop.  Ignored (with per-epoch stepping) for
+    per-epoch data sources, unjitted bodies, and PER_ROUND lifecycles.
     """
     config = config or IterationConfig()
     if max_epochs is not None:
         config = dataclasses.replace(config, max_epochs=max_epochs)
+    if steps_per_dispatch is not None:
+        config = dataclasses.replace(config,
+                                     steps_per_dispatch=steps_per_dispatch)
 
     if per_round:
         if not isinstance(initial_state, dict):
@@ -426,6 +441,37 @@ def _iterate_hosted(body: BodyFn, initial_state, provider: _DataProvider,
     else:
         step = lambda s, e, d: _call_body(body, s, e, d)  # noqa: E731
 
+    # Chunked dispatch (steps_per_dispatch=W > 1): one jitted lax.scan
+    # runs W epochs per host round-trip — per-epoch data sources can't
+    # chunk (the host pulls between epochs), and unjitted/per-round
+    # bodies keep the classic loop.
+    W = config.steps_per_dispatch
+    chunked = (W > 1 and config.jit and provider.is_static
+               and not per_round_lifecycle)
+    if chunked:
+        @partial(jax.jit, static_argnums=(3,),
+                 donate_argnums=(0,) if donating else ())
+        def chunk_step(state, e0, data, w: int):
+            def scan_step(carry, epoch):
+                state, alive = carry
+                res = _call_body(body, state, epoch, data)
+                # a dead step (post-vote) freezes the carry, so the
+                # returned state is the VOTING epoch's feedback — the
+                # exact per-epoch-loop semantics
+                new_state = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(alive, n, o),
+                    res.feedback, state)
+                vote = (jnp.asarray(res.termination)
+                        .astype(bool).reshape(())
+                        if res.termination is not None
+                        else jnp.asarray(True))
+                return ((new_state, jnp.logical_and(alive, vote)),
+                        (res.outputs, alive))
+            (state, alive), (outs, ran) = jax.lax.scan(
+                scan_step, (state, jnp.asarray(True)),
+                e0 + jnp.arange(w, dtype=jnp.int32))
+            return state, alive, outs, ran
+
     manager: Optional[CheckpointManager] = None
     if isinstance(checkpoint, CheckpointManager):
         manager = checkpoint
@@ -462,6 +508,48 @@ def _iterate_hosted(body: BodyFn, initial_state, provider: _DataProvider,
             if provider.exhausted:
                 terminated_reason = "stream_end"
                 break
+            if chunked:
+                from ..parallel.mesh import fetch_replicated
+
+                w = (W if config.max_epochs is None
+                     else min(W, config.max_epochs - epoch))
+                state, alive, outs, ran = chunk_step(
+                    state, jnp.asarray(epoch, jnp.int32), epoch_data, w)
+                # ONE host sync per chunk: which scan steps ran, and
+                # whether the vote says continue
+                ran_h = np.asarray(fetch_replicated(ran)).astype(bool)
+                alive_h = bool(np.asarray(fetch_replicated(alive)))
+                n_run = int(ran_h.sum())
+                last_outputs = None
+                if outs is not None:
+                    for i in range(w):
+                        if ran_h[i]:
+                            last_outputs = jax.tree_util.tree_map(
+                                lambda x, i=i: x[i], outs)
+                            outputs_log.append(last_outputs)
+                epoch += n_run
+                ctx = EpochContext(epoch=epoch - 1, state=state,
+                                   outputs=last_outputs, side=side)
+                for listener in listeners:
+                    listener.on_epoch_watermark_incremented(epoch - 1, ctx)
+                stop = not alive_h
+                if manager is not None and (
+                        stop or any(manager.should_save(e) for e in
+                                    range(epoch - n_run + 1, epoch + 1))):
+                    extra = {"terminated": stop}
+                    snap = provider.snapshot()
+                    if snap:
+                        extra["source_snapshot"] = snap
+                    if getattr(manager.config, "async_save", False):
+                        to_save = (_private_copy(state) if donating
+                                   else state)
+                        manager.save_async(epoch, to_save, extra)
+                    else:
+                        manager.save(epoch, state, extra)
+                if stop:
+                    terminated_reason = "criteria"
+                    break
+                continue
             if per_round_lifecycle and epoch > start_epoch:
                 state = per_round_init()
             res = step(state, jnp.asarray(epoch, jnp.int32), epoch_data)

@@ -45,6 +45,15 @@ class GBTConfig:
     max_bins: int = 64
     reg_lambda: float = 1.0
     min_child_weight: float = 1e-3
+    #: out-of-core chunked dispatch: stack this many streamed batches
+    #: into one device chunk and run each pass's per-batch device work
+    #: as ONE jitted lax.scan — every histogram/leaf/margin pass costs
+    #: ``ceil(n_batches / W)`` dispatches (and device transfers)
+    #: instead of ``n_batches``.  Short final chunks pad with zero-
+    #: gradient batches, which are inert in every additive pass.  1 =
+    #: one dispatch per batch through the same scan program.  In-core
+    #: training ignores it.
+    steps_per_dispatch: int = 8
 
 
 @dataclass
@@ -384,6 +393,59 @@ def _route_to_level(binned, feature_rows, threshold_rows, level: int):
     return ids
 
 
+@partial(jax.jit, static_argnames=("level", "n_nodes", "d", "bins",
+                                   "hist_impl"))
+def _chunk_level_histograms(binned_c, g_c, h_c, feature_rows,
+                            threshold_rows, g_init, h_init, level: int,
+                            n_nodes: int, d: int, bins: int,
+                            hist_impl: str):
+    """Chunked histogram pass: one lax.scan accumulates the level
+    histograms of a whole (W, rows, d) chunk in ONE dispatch — the
+    per-batch route+histogram work is identical, only the dispatch
+    boundary moves.  The RUNNING histograms ride in as the scan carry
+    (``g_init``/``h_init``), so accumulation stays strictly per-batch
+    sequential across chunk boundaries — f32 addition is
+    non-associative, and summing each chunk separately would make the
+    result W-dependent.  Zero-gradient (padding) batches add exact
+    zeros."""
+    def scan_step(carry, xs):
+        gh_acc, hh_acc = carry
+        b, g, h = xs
+        ids = _route_to_level(b, feature_rows, threshold_rows, level)
+        gh, hh = _HIST_IMPLS[hist_impl](b, ids, g, h, n_nodes, d, bins)
+        return (gh_acc + gh, hh_acc + hh), None
+
+    (g_hist, h_hist), _ = jax.lax.scan(scan_step, (g_init, h_init),
+                                       (binned_c, g_c, h_c))
+    return g_hist, h_hist
+
+
+@partial(jax.jit, static_argnames=("depth", "n_nodes"))
+def _chunk_leaf_sums(binned_c, g_c, h_c, feature_rows, threshold_rows,
+                     depth: int, n_nodes: int):
+    """Chunked leaf-sum pass: stacked per-batch (G, H) node sums from one
+    dispatch (kept per-batch so the host's f64 accumulation order matches
+    the per-batch path exactly)."""
+    def scan_step(_, xs):
+        b, g, h = xs
+        ids = _route_to_level(b, feature_rows, threshold_rows, depth)
+        return None, _leaf_sums(ids, g, h, n_nodes)
+
+    _, (gs, hs) = jax.lax.scan(scan_step, None, (binned_c, g_c, h_c))
+    return gs, hs
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def _chunk_tree_preds(binned_c, feature, threshold, value, depth: int):
+    """Chunked margin pass: stacked (W, rows) tree predictions from one
+    dispatch."""
+    def scan_step(_, b):
+        return None, _predict_tree_jit(b, feature, threshold, value, depth)
+
+    _, preds = jax.lax.scan(scan_step, None, binned_c)
+    return preds
+
+
 def train_forest_outofcore(make_reader, grad_hess, base_score,
                            config: GBTConfig, *,
                            features_key: str = "features",
@@ -481,7 +543,9 @@ def train_forest_outofcore(make_reader, grad_hess, base_score,
         margins[:] = base_score
 
         def cache_batches():
-            """(slice, binned int32 device, y f64, margins f64) batches."""
+            """(slice, binned int32 HOST, y f64, margins f64) batches —
+            host-side so the chunked passes stack W batches and pay one
+            device transfer per chunk."""
             reader = DataCacheReader(cache_dir,
                                      batch_rows=batch_device_rows)
             start = 0
@@ -489,7 +553,7 @@ def train_forest_outofcore(make_reader, grad_hess, base_score,
                 rows = len(batch["label"])
                 sl = slice(start, start + rows)
                 start += rows
-                yield (sl, jnp.asarray(batch["binned"].astype(np.int32)),
+                yield (sl, batch["binned"].astype(np.int32),
                        np.asarray(batch["label"], np.float64), margins[sl])
 
         return _boost_outofcore(cache_batches, margins, grad_hess,
@@ -503,6 +567,62 @@ def _boost_outofcore(cache_batches, margins, grad_hess, base_score: float,
                      config: GBTConfig) -> Forest:
     bins = config.max_bins
     depth = config.max_depth
+    W = max(1, int(config.steps_per_dispatch))
+
+    # Chunked dispatch (config.steps_per_dispatch): every streamed pass
+    # stacks W batches into one (W, rows, d) device chunk and runs the
+    # per-batch route/histogram/predict work as ONE jitted lax.scan —
+    # ceil(n_batches / W) dispatches + transfers per pass instead of
+    # n_batches.  Rows pad to the first batch's count and short final
+    # chunks pad with whole zero batches: zero gradients/hessians make
+    # every padded slot an exact no-op in the additive passes, and the
+    # margin pass writes back only each real batch's real rows.
+    def chunked_batches(need_gh: bool):
+        """Yield (sls, binned_c (W, R, d) device i32, g_c, h_c (W, R)
+        device f32 or None): ``sls`` lists the real batches' row
+        slices.  Grouping rides the prefetch pipeline's ``_grouped``
+        (one W-grouping protocol in the repo)."""
+        from ...data.prefetch import _grouped
+
+        rows_full: Optional[int] = None
+
+        def emit(group):
+            R = rows_full
+            sls = [sl for sl, _, _, _ in group]
+            if (len(group) == W
+                    and all(b.shape[0] == R for _, b, _, _ in group)):
+                # the steady case: equal full batches stack in one copy
+                binned_c = np.stack([b for _, b, _, _ in group])
+                if need_gh:
+                    g_c = np.stack([g for _, _, g, _ in group])
+                    h_c = np.stack([h for _, _, _, h in group])
+            else:
+                # ragged tail: zero-pad short rows / missing batches
+                binned_c = np.zeros((W, R, d), np.int32)
+                g_c = np.zeros((W, R), np.float32) if need_gh else None
+                h_c = np.zeros((W, R), np.float32) if need_gh else None
+                for j, (_, b, g, h) in enumerate(group):
+                    binned_c[j, :b.shape[0]] = b
+                    if need_gh:
+                        g_c[j, :b.shape[0]] = g
+                        h_c[j, :b.shape[0]] = h
+            return (sls, jnp.asarray(binned_c),
+                    jnp.asarray(g_c) if need_gh else None,
+                    jnp.asarray(h_c) if need_gh else None)
+
+        def prepared():
+            for sl, binned_b, y_b, m_b in cache_batches():
+                if need_gh:
+                    g, h = grad_hess(y_b, m_b)
+                    yield (sl, binned_b, np.asarray(g, np.float32),
+                           np.asarray(h, np.float32))
+                else:
+                    yield (sl, binned_b, None, None)
+
+        for group in _grouped(prepared(), W):
+            if rows_full is None:
+                rows_full = group[0][1].shape[0]
+            yield emit(group)
 
     n_nodes_total = 2 ** (depth + 1) - 1
     features = np.full((config.num_trees, n_nodes_total), -1, np.int32)
@@ -516,17 +636,16 @@ def _boost_outofcore(cache_batches, margins, grad_hess, base_score: float,
         base = 0
         for level in range(depth):
             n_nodes = 2 ** level
-            g_hist = h_hist = None
+            # running histograms thread through every chunk's scan carry
+            # (strictly sequential per-batch accumulation, W-independent)
+            g_hist = jnp.zeros((n_nodes, d, bins), jnp.float32)
+            h_hist = jnp.zeros((n_nodes, d, bins), jnp.float32)
             f_dev = jnp.asarray(feature_row)
             thr_dev = jnp.asarray(threshold_row)
-            for sl, binned_b, y_b, m_b in cache_batches():
-                g, h = grad_hess(y_b, m_b)
-                ids = _route_to_level(binned_b, f_dev, thr_dev, level)
-                gh, hh = _level_histograms(
-                    binned_b, ids, jnp.asarray(g, jnp.float32),
-                    jnp.asarray(h, jnp.float32), n_nodes, d, bins)
-                g_hist = gh if g_hist is None else g_hist + gh
-                h_hist = hh if h_hist is None else h_hist + hh
+            for _, binned_c, g_c, h_c in chunked_batches(True):
+                g_hist, h_hist = _chunk_level_histograms(
+                    binned_c, g_c, h_c, f_dev, thr_dev, g_hist, h_hist,
+                    level, n_nodes, d, bins, HIST_IMPL)
             bf, bb, bg = _level_splits(g_hist, h_hist, config.reg_lambda,
                                        config.min_child_weight)
             bf, bb, bg = np.asarray(bf), np.asarray(bb), np.asarray(bg)
@@ -541,19 +660,22 @@ def _boost_outofcore(cache_batches, margins, grad_hess, base_score: float,
             value_row[base:base + n_nodes] = np.where(split, 0.0, vals)
             base += n_nodes
 
-        # deepest level: always leaves — one leaf-sum pass
+        # deepest level: always leaves — one leaf-sum pass (per-batch
+        # sums come back stacked; the host's f64 accumulation order
+        # stays per-batch, identical to the unchunked path)
         n_nodes = 2 ** depth
         G = np.zeros((n_nodes,), np.float64)
         H = np.zeros((n_nodes,), np.float64)
         f_dev = jnp.asarray(feature_row)
         thr_dev = jnp.asarray(threshold_row)
-        for sl, binned_b, y_b, m_b in cache_batches():
-            g, h = grad_hess(y_b, m_b)
-            ids = _route_to_level(binned_b, f_dev, thr_dev, depth)
-            gs, hs = _leaf_sums(ids, jnp.asarray(g, jnp.float32),
-                                jnp.asarray(h, jnp.float32), n_nodes)
-            G += np.asarray(gs, np.float64)
-            H += np.asarray(hs, np.float64)
+        for sls, binned_c, g_c, h_c in chunked_batches(True):
+            gs, hs = _chunk_leaf_sums(binned_c, g_c, h_c, f_dev, thr_dev,
+                                      depth, n_nodes)
+            gs = np.asarray(gs, np.float64)
+            hs = np.asarray(hs, np.float64)
+            for j in range(len(sls)):
+                G += gs[j]
+                H += hs[j]
         value_row[base:base + n_nodes] = (
             -G / (H + config.reg_lambda)).astype(np.float32)
 
@@ -561,11 +683,13 @@ def _boost_outofcore(cache_batches, margins, grad_hess, base_score: float,
         feat_dev = jnp.asarray(feature_row)
         thr_dev = jnp.asarray(threshold_row)
         val_dev = jnp.asarray(value_row)
-        for sl, binned_b, _, _ in cache_batches():
-            pred = _predict_tree_jit(binned_b, feat_dev, thr_dev, val_dev,
-                                     depth)
-            margins[sl] += config.learning_rate * np.asarray(pred,
-                                                             np.float64)
+        for sls, binned_c, _, _ in chunked_batches(False):
+            preds = np.asarray(_chunk_tree_preds(binned_c, feat_dev,
+                                                 thr_dev, val_dev, depth),
+                               np.float64)
+            for j, sl in enumerate(sls):
+                margins[sl] += (config.learning_rate
+                                * preds[j, :sl.stop - sl.start])
         features[t], thresholds[t], values[t] = (feature_row,
                                                  threshold_row, value_row)
     margins.flush()

@@ -304,9 +304,12 @@ class LinearEstimatorBase(LinearEstimatorParams, Estimator):
         and ordering (shuffle when writing the cache or vary segment order
         per epoch).  Extra keyword arguments (``cache_decoded``,
         ``decoded_ram_budget``, ``stream_info``, ``prefetch_*``,
-        ``ell_*``) forward to :func:`sgd_fit_outofcore` — in particular
+        ``steps_per_dispatch``, ``ell_*``) forward to
+        :func:`sgd_fit_outofcore` — in particular
         ``cache_decoded=False`` opts out of the decoded replay cache for
-        readers that intentionally vary their stream per epoch."""
+        readers that intentionally vary their stream per epoch, and
+        ``steps_per_dispatch`` (default 8) sizes the chunked-scan
+        dispatch (W batches per jitted dispatch, bit-exact at any W)."""
         feat = self.get_features_col()
         state, loss_log = sgd_fit_outofcore(
             LOSSES[self.loss_name], make_reader,

@@ -1147,6 +1147,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                       prefetch_workers: int = 1,
                       prefetch_put_workers: int = 1,
                       prefetch_stats=None,
+                      steps_per_dispatch: int = 8,
                       cache_decoded="auto",
                       decoded_ram_budget: Optional[int] = None,
                       stream_info: Optional[dict] = None,
@@ -1193,7 +1194,33 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     ``config.global_batch_size`` and ``config.seed`` are inert here — batch
     size is the reader's ``batch_rows`` and any shuffling must happen in the
     reader (e.g. shuffle when writing the cache, or shuffle segment order
-    per epoch).  A factory that accepts an ``epoch`` keyword is called
+    per epoch).
+
+    **Chunked dispatch** (``steps_per_dispatch=W``, default 8): ``W``
+    consecutive prefetched batches are stacked on the host into one
+    device chunk (the prefetch pipeline's ``chunks=W`` mode — the
+    ``device_put`` of chunk N+1 overlaps compute on chunk N) and one
+    jitted ``lax.scan`` with a donated carry runs all ``W`` optimizer
+    steps, so an epoch costs ``ceil(n_batches / W)`` dispatches instead
+    of ``n_batches`` — the fixed per-dispatch host round-trip (dominant
+    on tunneled/relay transports) amortizes ``W``-fold.  The final
+    short chunk pads with a validity mask whose dead steps freeze the
+    carry, so results are BIT-EXACT vs ``W=1`` (asserted in tests);
+    mid-epoch checkpoint cuts land at chunk boundaries.  Process-
+    spanning meshes force ``W=1`` (chunk assembly is per-process-local).
+    The pipeline runs at ``ceil(prefetch_depth / W)`` CHUNKS of depth,
+    floored at ONE — so chunked mode keeps at least ``W`` batches
+    staged (plus the ``W``-batch chunk in compute), a ~``W/3``-fold
+    device-staging increase over the classic per-batch pipeline at the
+    default ``prefetch_depth=2``; memory-constrained deployments bound
+    the footprint by lowering ``steps_per_dispatch`` (``W=1``
+    reproduces the old footprint), and host-side assembly stages up to
+    ``W`` decoded batches per in-flight chunk.  Dead (padded) steps
+    COMPUTE and discard — the price of one compiled program for every
+    chunk — so keep ``W`` well under the epoch's batch count: a 4-batch
+    epoch at ``W=8`` runs 8 steps' compute for 4 batches' progress.
+
+    A factory that accepts an ``epoch`` keyword is called
     with the actual epoch number — pair it with
     :class:`~...data.datacache.ShuffledCacheReader` for per-epoch
     reshuffling that stays exact across checkpoint resume (a
@@ -1318,26 +1345,47 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     elif isinstance(checkpoint, CheckpointConfig):
         manager = CheckpointManager(checkpoint)
 
-    x_sh = NamedSharding(mesh, P("data", None))
-    v_sh = NamedSharding(mesh, P("data"))
+    x_p = P("data", None)
+    v_p = P("data")
     if stream_sharded:
         # layout stacks carry a leading device dim sharded over 'data'
-        g3 = NamedSharding(mesh, P("data", None, None))
-        g2 = NamedSharding(mesh, P("data", None))
-        sharding = (x_sh, g3, g3, g3, g2, g2, g2, g3, v_sh, v_sh)
+        g3, g2 = P("data", None, None), P("data", None)
+        specs = (x_p, g3, g3, g3, g2, g2, g2, g3, v_p, v_p)
     elif stream_ell:
-        r_sh = NamedSharding(mesh, P())  # layout grids: single device
+        r_p = P()  # layout grids: single device
         # (dense, src, pos, mask, ovf_idx, ovf_src, heavy_idx,
         #  heavy_cnt, y, w) — the raw cat tensor never ships: margins
         # and scatters both ride the layout (r4)
-        sharding = (x_sh, r_sh, r_sh, r_sh, r_sh, r_sh, r_sh, r_sh,
-                    v_sh, v_sh)
+        specs = (x_p, r_p, r_p, r_p, r_p, r_p, r_p, r_p, v_p, v_p)
     else:
-        sharding = ((x_sh, x_sh, v_sh, v_sh) if (sparse or mixed)
-                    else (x_sh, v_sh, v_sh))
+        specs = ((x_p, x_p, v_p, v_p) if (sparse or mixed)
+                 else (x_p, v_p, v_p))
     # process-spanning mesh: each process's decoded batch is its LOCAL
     # slice; assemble the global (non-fully-addressable) batch arrays
     put_fn = _assemble_process_local if procs > 1 else None
+
+    # Chunked dispatch: W batches stack into one device chunk and run as
+    # one donated-carry lax.scan — one dispatch per W steps.  W=1 is the
+    # exact-equivalence fallback: one batch per dispatch through the
+    # SAME scan program, so any two W values are bit-exact on the same
+    # stream (XLA compiles the per-batch jit and the scan body slightly
+    # differently, so sameness of the PROGRAM, not just the math, is
+    # what the guarantee rides on).  Chunk assembly is per-process-
+    # local, so process-spanning meshes keep the classic per-batch loop.
+    W = max(1, int(steps_per_dispatch))
+    chunked = procs == 1
+    if chunked:
+        from ...data.prefetch import chunk_consumer_plan, masked_chunk_scan
+
+        sharding, chunk_depth = chunk_consumer_plan(mesh, specs, W,
+                                                    prefetch_depth)
+        chunk_step = jax.jit(
+            lambda params, loss_sum, chunk, mask: masked_chunk_scan(
+                update, params, loss_sum, chunk, mask),
+            donate_argnums=(0, 1))
+    else:
+        W = 1
+        sharding = tuple(NamedSharding(mesh, p) for p in specs)
 
     from ...utils.padding import FixedRowBatcher
 
@@ -1506,6 +1554,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         })
 
     epoch_secs: list = []
+    dispatch_log: list = []   # jitted-step dispatches per epoch
     for epoch in range(start_epoch, config.max_epochs):
         t_epoch = time.perf_counter()
         rec_cache = None
@@ -1667,23 +1716,48 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         loss_sum = resume_loss_sum
         n_batches = resume_n_batches
         step_in_epoch = skip_steps
+        n_dispatches = 0
         resume_loss_sum, resume_n_batches, skip_steps = None, 0, 0
-        for dev_batch in prefetch_to_device(
-                source, depth=prefetch_depth,
-                transform=route, sharding=sharding,
-                workers=prefetch_workers,
-                put_workers=prefetch_put_workers, stats=prefetch_stats,
-                put_fn=put_fn):
-            params, value = batch_step(params, *dev_batch)
-            loss_sum = value if loss_sum is None else add(loss_sum, value)
-            n_batches += 1
-            step_in_epoch += 1
-            global_step += 1
-            if (manager is not None and checkpoint_every_steps > 0
-                    and step_in_epoch % checkpoint_every_steps == 0):
-                _save(epoch, step_in_epoch, loss_sum, n_batches)
+        if chunked:
+            for chunk, mask, n_valid in prefetch_to_device(
+                    source, depth=chunk_depth,
+                    transform=route, sharding=sharding,
+                    workers=prefetch_workers,
+                    put_workers=prefetch_put_workers, stats=prefetch_stats,
+                    chunks=W):
+                if loss_sum is None:
+                    loss_sum = jnp.zeros((), jnp.float32)
+                params, loss_sum = chunk_step(params, loss_sum, chunk, mask)
+                n_batches += n_valid
+                step_in_epoch += n_valid
+                global_step += n_valid
+                n_dispatches += 1
+                # mid-epoch cuts land at chunk boundaries: save when the
+                # chunk crossed a checkpoint_every_steps multiple
+                if (manager is not None and checkpoint_every_steps > 0
+                        and step_in_epoch // checkpoint_every_steps
+                        > (step_in_epoch - n_valid)
+                        // checkpoint_every_steps):
+                    _save(epoch, step_in_epoch, loss_sum, n_batches)
+        else:
+            for dev_batch in prefetch_to_device(
+                    source, depth=prefetch_depth,
+                    transform=route, sharding=sharding,
+                    workers=prefetch_workers,
+                    put_workers=prefetch_put_workers, stats=prefetch_stats,
+                    put_fn=put_fn):
+                params, value = batch_step(params, *dev_batch)
+                loss_sum = value if loss_sum is None else add(loss_sum, value)
+                n_batches += 1
+                step_in_epoch += 1
+                global_step += 1
+                n_dispatches += 1
+                if (manager is not None and checkpoint_every_steps > 0
+                        and step_in_epoch % checkpoint_every_steps == 0):
+                    _save(epoch, step_in_epoch, loss_sum, n_batches)
         if loss_sum is None:
             raise ValueError("make_reader() returned an empty epoch")
+        dispatch_log.append(n_dispatches)
         if rec_cache is not None:
             rec_cache.finish(step_in_epoch)
             replay_cache = rec_cache
@@ -1703,6 +1777,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     params = _fetch_replicated(params)
     if stream_info is not None:
         stream_info["impl"] = stream_impl
+        stream_info["steps_per_dispatch"] = W
+        stream_info["dispatches_per_epoch"] = dispatch_log
         if block_cache is not None:
             stream_info["decoded_cache_mode"] = "block"
             stream_info["decoded_cache_batches"] = len(block_cache)

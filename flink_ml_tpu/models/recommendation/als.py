@@ -72,6 +72,33 @@ _SORTED_CHUNK = 8192
 _NEQ_AUTO_SPAN_CAP = 256
 
 
+def _neq_plan_span(group_idx: np.ndarray, chunk: int = _SORTED_CHUNK) -> int:
+    """The chunk-band span :class:`NeqPlan` would compute for
+    ``group_idx``, WITHOUT the plan's O(nnz log nnz) argsort or its
+    O(nnz) local-rank arrays: within a sorted chunk the band maximum
+    sits at the chunk's last slot, so span needs only the sorted group
+    value at each chunk boundary — and the sorted sequence is fully
+    determined by ``np.bincount`` (each group id repeated by its
+    count).  O(nnz + n_groups) time, O(n_groups) memory.  'auto' mode
+    consults this BEFORE building a plan, so long-tail datasets — the
+    common recommendation shape, which falls back to scatter — skip
+    both argsorts entirely."""
+    group_idx = np.asarray(group_idx)
+    nnz = group_idx.shape[0]
+    if nnz == 0:
+        return 1
+    chunk = int(min(chunk, nnz))
+    cum = np.cumsum(np.bincount(group_idx))
+    n_chunks = -(-nnz // chunk)
+    starts = np.arange(n_chunks) * chunk
+    # the plan pads the tail chunk by repeating the last sorted group,
+    # so its band ends at sorted position nnz - 1
+    ends = np.minimum(starts + chunk - 1, nnz - 1)
+    lo = np.searchsorted(cum, starts, side="right")
+    hi = np.searchsorted(cum, ends, side="right")
+    return int((hi - lo).max()) + 1
+
+
 class NeqPlan:
     """Static routing for :func:`_normal_equations_sorted` — one host
     sort per fit side (the ratings are fixed for the whole fit, the
@@ -527,15 +554,19 @@ class ALS(ALSParams, Estimator[ALSModel]):
         neq_mode = self.get(ALSParams.NEQ_IMPL)
         plans = None
         if neq_mode in ("auto", "sorted"):
-            # one static host sort per side (the ratings are fixed for
-            # the whole fit); the data tuple ships pre-sorted, so no
-            # per-epoch permute exists on device
-            plan_u = NeqPlan(u_idx)
-            plan_v = NeqPlan(i_idx)
+            # 'auto' bounds the span from a cheap bincount FIRST: the
+            # long-tail common case falls back to scatter without ever
+            # paying the plan's two O(nnz log nnz) argsorts
             if (neq_mode == "auto"
-                    and max(plan_u.span, plan_v.span) > _NEQ_AUTO_SPAN_CAP):
-                plan_u = plan_v = None   # long-tail data: scatter wins
+                    and max(_neq_plan_span(u_idx), _neq_plan_span(i_idx))
+                    > _NEQ_AUTO_SPAN_CAP):
+                pass   # long-tail data: scatter wins; no plan is built
             else:
+                # one static host sort per side (the ratings are fixed
+                # for the whole fit); the data tuple ships pre-sorted,
+                # so no per-epoch permute exists on device
+                plan_u = NeqPlan(u_idx)
+                plan_v = NeqPlan(i_idx)
                 plans = (plan_u, plan_v)
         if plans is not None:
             data = tuple(jnp.asarray(a) for a in (

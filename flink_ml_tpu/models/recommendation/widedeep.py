@@ -288,7 +288,8 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
     def fit_outofcore(self, make_reader, *, mesh=None,
                       prefetch_depth: int = 2, prefetch_workers: int = 1,
                       prefetch_put_workers: int = 1,
-                      prefetch_stats=None) -> "WideDeepModel":
+                      prefetch_stats=None,
+                      steps_per_dispatch: int = 8) -> "WideDeepModel":
         """Out-of-core ``fit``: epochs stream from ``make_reader()`` (the
         ``sgd_fit_outofcore`` reader protocol — a fresh per-epoch
         iterator of host batch dicts with this estimator's column names;
@@ -302,13 +303,23 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
         device memory between epochs.  The mesh's ``data`` axis shards
         each batch.
 
+        **Chunked dispatch** (``steps_per_dispatch=W``, default 8):
+        single-process fits stack ``W`` consecutive batches into one
+        device chunk and run all ``W`` Adam steps as one jitted
+        ``lax.scan`` with a donated carry — one host dispatch per ``W``
+        steps (the ``sgd_fit_outofcore`` posture; see its docstring).
+        The final short chunk pads with a validity mask whose dead
+        steps freeze params AND optimizer state, so any two ``W``
+        values are bit-exact on the same stream.
+
         **Multi-host**: pass a process-spanning mesh and call from EVERY
         process with a reader over THAT process's data shard (the
         ``sgd_fit_outofcore`` posture): the global batch is the per-step
         concatenation over processes, assembled inside the prefetch
         pipeline, and every process must deliver the SAME number of
         equal-sized batches per epoch (mismatches deadlock in the
-        collectives)."""
+        collectives).  Multi-process fits keep the classic per-batch
+        loop (chunk assembly is per-process-local)."""
         from ...data.prefetch import prefetch_to_device
         from ...parallel.mesh import (
             assemble_process_local,
@@ -350,9 +361,51 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
             # (mask-weighted loss; lazy update drops weight-0 ids)
             return batcher.pad((dense, cat, y, mask), have=y.shape[0])
 
-        bsh = NamedSharding(mesh, P("data"))
-        sharding = (NamedSharding(mesh, P("data", None)),
-                    NamedSharding(mesh, P("data", None)), bsh, bsh)
+        specs = (P("data", None), P("data", None), P("data"), P("data"))
+        # chunked dispatch (single-process): W batches per jitted scan —
+        # W=1 is the bit-exact fallback through the SAME scan program
+        chunked = mesh_process_count(mesh) == 1
+        W = max(1, int(steps_per_dispatch)) if chunked else 1
+        if chunked:
+            from ...data.prefetch import chunk_consumer_plan
+
+            sharding, chunk_depth = chunk_consumer_plan(
+                mesh, specs, W, prefetch_depth)
+        else:
+            sharding = tuple(NamedSharding(mesh, p) for p in specs)
+
+        def _build_chunk_step(raw_step):
+            # the shared masked scan freezes the WHOLE carried state —
+            # here (params, opt_state), so dead (padded) steps freeze
+            # the optimizer moments too — bit-exact vs the unpadded
+            # stream
+            from ...data.prefetch import masked_chunk_scan
+
+            def step(state, *batch):
+                params, opt_state = state
+                params, opt_state, loss = raw_step(params, opt_state,
+                                                   *batch)
+                return (params, opt_state), loss
+
+            def _chunk_runner(state, loss_sum, chunk, cmask):
+                return masked_chunk_scan(step, state, loss_sum, chunk,
+                                         cmask)
+
+            return jax.jit(_chunk_runner, donate_argnums=(0, 1))
+
+        def _lazy_init(d_dense: int):
+            # init + optax state build on HOST values, then replicate
+            # both: optax.init on a non-addressable process-spanning
+            # array would create mismatched local state (every process
+            # seeds identically)
+            host_params = init_params(
+                rng, d_dense, vocab_sizes,
+                self.EMBEDDING_DIM, self.HIDDEN_UNITS)
+            raw_step, host_opt = _make_train_ops(
+                host_params, self.LEARNING_RATE,
+                bool(self.LAZY_EMB_OPT))
+            return (replicate(host_params, mesh),
+                    replicate(host_opt, mesh), raw_step)
 
         epoch_sums: List = []   # per-epoch (device scalar, n_batches):
         max_epochs = self.get_max_iter()  # fetched ONCE after the loop so
@@ -361,30 +414,38 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
             reader = _reader_for_epoch(make_reader, epoch)
             loss_sum = None
             n_batches = 0
-            for dev_batch in prefetch_to_device(
-                    reader, depth=prefetch_depth, transform=to_host_batch,
-                    sharding=sharding, workers=prefetch_workers,
-                    put_workers=prefetch_put_workers,
-                    stats=prefetch_stats, put_fn=put_fn):
-                if step_fn is None:
-                    d_dense = int(dev_batch[0].shape[1])
-                    # init + optax state build on HOST values, then
-                    # replicate both: optax.init on a non-addressable
-                    # process-spanning array would create mismatched
-                    # local state (every process seeds identically)
-                    host_params = init_params(
-                        rng, d_dense, vocab_sizes,
-                        self.EMBEDDING_DIM, self.HIDDEN_UNITS)
-                    raw_step, host_opt = _make_train_ops(
-                        host_params, self.LEARNING_RATE,
-                        bool(self.LAZY_EMB_OPT))
-                    params = replicate(host_params, mesh)
-                    opt_state = replicate(host_opt, mesh)
-                    step_fn = jax.jit(raw_step, donate_argnums=(0, 1))
-                params, opt_state, loss = step_fn(params, opt_state,
-                                                  *dev_batch)
-                loss_sum = loss if loss_sum is None else add(loss_sum, loss)
-                n_batches += 1
+            if chunked:
+                for chunk, cmask, n_valid in prefetch_to_device(
+                        reader, depth=chunk_depth,
+                        transform=to_host_batch, sharding=sharding,
+                        workers=prefetch_workers,
+                        put_workers=prefetch_put_workers,
+                        stats=prefetch_stats, chunks=W):
+                    if step_fn is None:
+                        params, opt_state, raw_step = _lazy_init(
+                            int(chunk[0].shape[2]))
+                        step_fn = _build_chunk_step(raw_step)
+                    if loss_sum is None:
+                        loss_sum = jnp.zeros((), jnp.float32)
+                    (params, opt_state), loss_sum = step_fn(
+                        (params, opt_state), loss_sum, chunk, cmask)
+                    n_batches += n_valid
+            else:
+                for dev_batch in prefetch_to_device(
+                        reader, depth=prefetch_depth,
+                        transform=to_host_batch, sharding=sharding,
+                        workers=prefetch_workers,
+                        put_workers=prefetch_put_workers,
+                        stats=prefetch_stats, put_fn=put_fn):
+                    if step_fn is None:
+                        params, opt_state, raw_step = _lazy_init(
+                            int(dev_batch[0].shape[1]))
+                        step_fn = jax.jit(raw_step, donate_argnums=(0, 1))
+                    params, opt_state, loss = step_fn(params, opt_state,
+                                                      *dev_batch)
+                    loss_sum = (loss if loss_sum is None
+                                else add(loss_sum, loss))
+                    n_batches += 1
             if loss_sum is None:
                 raise ValueError("make_reader() returned an empty epoch")
             epoch_sums.append((loss_sum, n_batches))

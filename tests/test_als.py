@@ -326,3 +326,25 @@ def test_auto_falls_back_to_scatter_on_long_tail():
     finally:
         als_mod._NEQ_AUTO_SPAN_CAP = old
     assert model.get_model_data()  # fit completed on the scatter path
+
+
+def test_neq_plan_span_matches_full_plan():
+    """The bincount-based span bound 'auto' consults BEFORE building a
+    NeqPlan must equal the plan's own span exactly — it is the same
+    sorted-sequence arithmetic without the O(nnz log nnz) argsort."""
+    from flink_ml_tpu.models.recommendation.als import (NeqPlan,
+                                                        _neq_plan_span)
+
+    rng = np.random.default_rng(0)
+    cases = []
+    for _ in range(8):
+        n_groups = int(rng.integers(1, 500))
+        nnz = int(rng.integers(1, 5000))
+        cases.append(rng.integers(0, n_groups, nnz))       # uniform
+        cases.append((rng.pareto(0.5, nnz) * 10).astype(np.int64)
+                     % n_groups)                           # long tail
+    cases.append(np.zeros(300, np.int64))                  # single group
+    cases.append(np.arange(300))                           # all singletons
+    for g in cases:
+        for chunk in (7, 64, 8192):
+            assert _neq_plan_span(g, chunk) == NeqPlan(g, chunk).span
