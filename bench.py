@@ -544,7 +544,12 @@ def bench_logreg_outofcore(results: dict) -> None:
     fused_epoch_s = (rows / results["rows_per_sec"]
                      if "rows_per_sec" in results else float("nan"))
     # chunked-dispatch breakdown: dispatch reduction at the default W=8
-    # and the fraction of the fused-vs-out-of-core gap the scan closed
+    # and the fraction of the fused-vs-out-of-core gap the scan closed.
+    # The fraction is only meaningful when the A/B ran at the SAME batch
+    # size the fused leg was timed at — in smoke mode ab_batch shrinks to
+    # get 16 steps/epoch while fused_epoch_s derives from the fused run's
+    # own batch size, and dividing those conflates step-count scaling
+    # with per-dispatch overhead, so it reports None there.
     gap = w1_s - fused_epoch_s
     notes["outofcore_chunked"] = {
         "steps_per_dispatch": stream_info.get("steps_per_dispatch"),
@@ -555,7 +560,9 @@ def bench_logreg_outofcore(results: dict) -> None:
         "w1_epoch_ms": round(1000 * w1_s, 1),
         "w8_epoch_ms": round(1000 * w8_s, 1),
         "gap_closed_fraction": (round((w1_s - w8_s) / gap, 3)
-                                if np.isfinite(gap) and gap > 0 else None),
+                                if ab_batch == batch
+                                and np.isfinite(gap) and gap > 0
+                                else None),
     }
     per_epoch = {k: round(v / cfg.max_epochs * 1000, 1)
                  for k, v in stats.as_dict().items()
@@ -1408,6 +1415,101 @@ def bench_online_ftrl(results: dict) -> None:
     }
 
 
+def bench_serving(results: dict) -> None:
+    """Online serving leg (serving/ subsystem): p50/p99 request latency and
+    throughput at 1/8/64 concurrent clients against one warmed LR
+    endpoint.  This leg is DESIGNED for the CPU smoke path — what it
+    measures is the serving runtime itself (queue + micro-batcher +
+    bucketed warm-compiled executors), whose costs are host-side; the
+    per-client request stream is single-row/few-row tables, the realistic
+    online shape.  Deliberately NOT scaled down off-TPU."""
+    from flink_ml_tpu import Table
+    from flink_ml_tpu.models.classification.logisticregression import (
+        LogisticRegressionModel)
+    from flink_ml_tpu.serving import ModelRegistry, ServingEndpoint
+
+    import threading
+
+    d = 64
+    rng = np.random.default_rng(17)
+    model = LogisticRegressionModel()
+    model.set_model_data(Table({
+        "coefficients": rng.normal(size=(1, d)),
+        "intercept": np.array([0.1])}))
+    feats = Table({"features": rng.normal(size=(1024, d))
+                   .astype(np.float32)})
+
+    registry = ModelRegistry()
+    warm_t0 = time.perf_counter()
+    registry.deploy("lr", model, feats.take(2), max_batch_rows=256)
+    warm_s = time.perf_counter() - warm_t0
+    endpoint = ServingEndpoint(registry, "lr", max_batch_rows=256,
+                               max_wait_ms=1.0,
+                               queue_capacity=1 << 14).start()
+
+    serving: dict = {
+        "serving_metric_version": 1,
+        "config": f"LR dense d={d}, 1-8 row requests, max_batch_rows=256, "
+                  "max_wait_ms=1.0",
+        "warmup_s": round(warm_s, 3),
+    }
+    try:
+        for clients in (1, 8, 64):
+            per_client = 64 if clients < 64 else 16
+            latencies: list = []
+            lat_lock = threading.Lock()
+            errors: list = []
+
+            def client(worker):
+                crng = np.random.default_rng(worker)
+                mine = []
+                try:
+                    for _ in range(per_client):
+                        start = int(crng.integers(0, 1000))
+                        rows = int(crng.integers(1, 9))
+                        req = feats.slice(start, start + rows)
+                        t0 = time.perf_counter()
+                        endpoint.predict(req, timeout=120)
+                        mine.append(time.perf_counter() - t0)
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(repr(exc)[:200])
+                with lat_lock:
+                    latencies.extend(mine)
+
+            batches_before = endpoint.metrics.batches.value
+            wall_t0 = time.perf_counter()
+            threads = [threading.Thread(target=client, args=(w,))
+                       for w in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(300)
+            wall = time.perf_counter() - wall_t0
+            n = len(latencies)
+            lat = np.asarray(latencies)
+            leg = {
+                "requests": n,
+                "requests_per_sec": round(n / wall, 1),
+                "p50_ms": round(1e3 * float(np.quantile(lat, 0.5)), 3)
+                if n else None,
+                "p99_ms": round(1e3 * float(np.quantile(lat, 0.99)), 3)
+                if n else None,
+                "batches": endpoint.metrics.batches.value - batches_before,
+            }
+            if errors:
+                leg["errors"] = errors[:3]
+            serving[f"clients_{clients}"] = leg
+        snap = endpoint.metrics.snapshot()
+        serving["shed"] = snap["shed"]
+        serving["final_fill_ratio"] = snap["batch_fill_ratio"]
+        results["serving_requests_per_sec"] = \
+            serving["clients_64"]["requests_per_sec"]
+        results["serving_p99_ms"] = serving["clients_64"]["p99_ms"]
+    finally:
+        endpoint.close()
+    results["notes"]["serving"] = serving
+
+
 def bench_wal(results: dict) -> None:
     """Write-ahead window log durability cost (VERDICT r3 weak #7): live
     windows/s through the full per-window fsync pair, host-side only
@@ -1469,7 +1571,7 @@ def main() -> None:
             "probe?) — this line records the failure, not a rate")
     for leg in (bench_logreg_outofcore, bench_criteo_e2e, bench_kmeans,
                 bench_widedeep, bench_als, bench_gbt, bench_online_ftrl,
-                bench_wal):
+                bench_serving, bench_wal):
         try:
             leg(results)
         except Exception as exc:   # noqa: BLE001

@@ -76,8 +76,18 @@ class PrefetchStats:
     batches: int = 0
     assemble_s: float = 0.0    # chunk stack/pad/mask (within transform_s)
     chunks: int = 0
+    chunk_size: Optional[int] = None   # W in chunks=W mode, else None
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False)
+
+    def pad_fraction(self) -> float:
+        """Fraction of dispatched chunk steps that were padding (the final
+        short chunk repeats its last batch): ``(chunks*W - batches) /
+        (chunks*W)``.  0.0 outside chunk mode or before any chunk."""
+        if not self.chunks or not self.chunk_size:
+            return 0.0
+        slots = self.chunks * self.chunk_size
+        return (slots - self.batches) / slots
 
     def as_dict(self) -> dict:
         d = {"read_s": round(self.read_s, 4),
@@ -88,7 +98,24 @@ class PrefetchStats:
         if self.chunks:
             d["chunk_assemble_s"] = round(self.assemble_s, 4)
             d["chunks"] = self.chunks
+            d["pad_fraction"] = round(self.pad_fraction(), 4)
         return d
+
+    def publish(self, group) -> None:
+        """Write the current stats into a ``utils.metrics.MetricGroup`` as
+        gauges (the observability follow-up to the chunked-dispatch layer:
+        internal fields become scrapeable endpoint metrics).  Gauge names
+        match :meth:`as_dict` plus ``chunks_emitted`` / ``put_overlap_s``
+        aliases for the per-chunk view; safe to call repeatedly — gauges
+        are overwritten in place."""
+        group.gauge("read_s").set(round(self.read_s, 4))
+        group.gauge("transform_s").set(round(self.transform_s, 4))
+        group.gauge("put_overlap_s").set(round(self.put_s, 4))
+        group.gauge("consumer_wait_s").set(round(self.wait_s, 4))
+        group.gauge("batches").set(self.batches)
+        group.gauge("chunks_emitted").set(self.chunks)
+        group.gauge("pad_fraction").set(round(self.pad_fraction(), 4))
+        group.gauge("chunk_assemble_s").set(round(self.assemble_s, 4))
 
 
 def _grouped(batches: Iterable[Any], size: int) -> Iterator[list]:
@@ -172,7 +199,8 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
                        put_workers: int = 1,
                        stats: Optional[PrefetchStats] = None,
                        put_fn: Optional[Callable[[Any, Any], Any]] = None,
-                       chunks: Optional[int] = None
+                       chunks: Optional[int] = None,
+                       metric_group: Optional[Any] = None
                        ) -> Iterator[Any]:
     """Iterate device-resident copies of ``batches``, staying ``depth``
     UNITS OF WORK ahead of the consumer — a unit is one batch, or one
@@ -212,6 +240,12 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
     ``W>1`` (the bit-exact fallback).  Incompatible with ``put_fn``
     (process-local assembly is per-batch); multi-process callers use
     ``chunks=None``.
+
+    ``metric_group`` (a ``utils.metrics.MetricGroup``) publishes the
+    cumulative :class:`PrefetchStats` as live gauges — chunks emitted, pad
+    fraction, put-overlap time, per-stage seconds — refreshed at every
+    yielded item and once more at stream end, so a fit's ingest pipeline
+    is observable through the same registry as its epoch metrics.
     """
     if depth < 1:
         raise ValueError(f"depth must be >= 1, got {depth}")
@@ -227,6 +261,8 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
             "assembly is per-batch); use chunks=None on process-"
             "spanning meshes")
     st = stats or PrefetchStats()
+    if chunks is not None:
+        st.chunk_size = chunks
 
     if chunks is not None:
         item_transform = transform
@@ -469,8 +505,12 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
             if isinstance(item, BaseException):
                 raise item
             st.batches += item[2] if chunks is not None else 1
+            if metric_group is not None:
+                st.publish(metric_group)
             yield item
     finally:
         stop.set()
+        if metric_group is not None:
+            st.publish(metric_group)
         if workers > 1 or put_workers > 1:
             pool.shutdown(wait=False, cancel_futures=True)

@@ -51,7 +51,7 @@ from ...parallel.mesh import (
     replicate,
 )
 from ...utils import persist
-from ...utils.padding import pad_rows_with_mask
+from ...utils.padding import pad_rows_to_bucket, pad_rows_with_mask
 
 __all__ = ["KMeans", "KMeansModel", "KMeansParams", "KMeansModelParams"]
 
@@ -551,8 +551,12 @@ class KMeansModel(KMeansModelParams, Model):
         measure = DistanceMeasure.get_instance(self.get_distance_measure())
         points = stack_vectors(table[self.get_features_col()]).astype(
             np.float32)
+        # bucketed batch shape: mixed request sizes share one compiled
+        # assign program per power-of-two bucket (utils/padding.py); the
+        # per-row argmin makes pad rows inert, sliced off below
+        (padded,), n = pad_rows_to_bucket((points,))
         assign = np.asarray(
-            _predict(measure, points, jnp.asarray(self._centroids)))
+            _predict(measure, padded, jnp.asarray(self._centroids)))[:n]
         return [table.with_column(self.get_prediction_col(),
                                   assign.astype(np.int64))]
 

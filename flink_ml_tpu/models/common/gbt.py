@@ -52,7 +52,11 @@ class GBTConfig:
     #: instead of ``n_batches``.  Short final chunks pad with zero-
     #: gradient batches, which are inert in every additive pass.  1 =
     #: one dispatch per batch through the same scan program.  In-core
-    #: training ignores it.
+    #: training ignores it.  NOTE the device-memory trade: each transfer
+    #: stages a ``(W, batch_device_rows, d)`` chunk — W times the
+    #: per-batch staging — so deployments that sized
+    #: ``batch_device_rows`` to fit HBM must either shrink it by W or
+    #: set ``steps_per_dispatch=1`` to keep the old footprint.
     steps_per_dispatch: int = 8
 
 
@@ -757,10 +761,15 @@ def train_forest_softmax(X: np.ndarray, y_ids: np.ndarray, n_classes: int,
 
 
 def predict_forest_softmax(X: np.ndarray, forest: SoftmaxForest) -> np.ndarray:
-    """Per-class margins (n, K)."""
+    """Per-class margins (n, K).  Rows zero-pad to the shared power-of-two
+    bucket (``utils/padding.py``) so mixed batch sizes reuse one compiled
+    tree-walk per bucket; routing is per-row, pad rows slice off."""
+    from ...utils.padding import pad_rows_to_bucket
+
     binned = apply_bins(X, forest.bin_edges)
+    (binned,), n = pad_rows_to_bucket((binned,))
     depth = int(np.log2(forest.feature.shape[2] + 1)) - 1
-    margins = np.tile(forest.base_scores, (len(X), 1))
+    margins = np.tile(forest.base_scores, (binned.shape[0], 1))
     binned_dev = jnp.asarray(binned)
     for t in range(forest.feature.shape[0]):
         for k in range(forest.n_classes):
@@ -770,7 +779,7 @@ def predict_forest_softmax(X: np.ndarray, forest: SoftmaxForest) -> np.ndarray:
                                   jnp.asarray(forest.threshold[t, k]),
                                   jnp.asarray(forest.value[t, k]), depth),
                 np.float64)
-    return margins
+    return margins[:n]
 
 
 def _predict_tree(binned: np.ndarray, feature: np.ndarray,
@@ -802,12 +811,17 @@ def _predict_tree_jit(binned, feature, threshold, value, depth: int):
 
 
 def predict_forest(X: np.ndarray, forest: Forest) -> np.ndarray:
-    """Sum of tree outputs, margin scale."""
+    """Sum of tree outputs, margin scale.  Rows zero-pad to the shared
+    power-of-two bucket (``utils/padding.py``): one compiled tree-walk per
+    bucket serves every batch size, pad rows slice off."""
+    from ...utils.padding import pad_rows_to_bucket
+
     binned = apply_bins(X, forest.bin_edges)
+    (binned,), n = pad_rows_to_bucket((binned,))
     depth = int(np.log2(forest.feature.shape[1] + 1)) - 1
-    pred = np.full((len(X),), forest.base_score, np.float64)
+    pred = np.full((binned.shape[0],), forest.base_score, np.float64)
     for t in range(forest.feature.shape[0]):
         pred += forest.learning_rate * _predict_tree(
             binned, forest.feature[t], forest.threshold[t],
             forest.value[t], depth)
-    return pred
+    return pred[:n]

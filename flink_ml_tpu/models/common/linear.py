@@ -28,6 +28,7 @@ from ...params.shared import (
     HasWeightCol,
 )
 from ...utils import persist
+from ...utils.padding import pad_rows_to_bucket
 from .losses import LOSSES
 from .sgd import (
     LinearState,
@@ -174,6 +175,12 @@ class LinearModelBase(LinearModelParams, Model):
 
     # -- inference ----------------------------------------------------------
     def _margins(self, table: Table) -> np.ndarray:
+        """Margins at BUCKETED batch shapes: rows zero-pad to the shared
+        power-of-two bucket (``utils/padding.py``) before the jitted score,
+        so mixed batch sizes — offline transforms and the online serving
+        micro-batches alike — hit a bounded set of compiled programs
+        instead of retracing per shape.  Pad rows are sliced off; margins
+        are row-independent, so real rows are bit-identical."""
         self._require_model()
         kind, feats = resolve_features(table, self.get_features_col())
         w = jnp.asarray(self._state.coefficients, jnp.float32)
@@ -181,15 +188,17 @@ class LinearModelBase(LinearModelParams, Model):
         if kind == "sparse":
             idx, vals, _ = feats
             check_sparse_indices(idx, self._state.coefficients.shape[0])
+            (idx, vals), n = pad_rows_to_bucket((idx, vals))
             return np.asarray(_jit_sparse_margins(idx, vals, w, b),
-                              np.float64)
+                              np.float64)[:n]
         if kind == "mixed":
             dense, cat = feats
             check_sparse_indices(cat, self._state.coefficients.shape[0])
+            (dense, cat), n = pad_rows_to_bucket((dense, cat))
             return np.asarray(_jit_mixed_margins(dense, cat, w, b),
-                              np.float64)
-        return np.asarray(_jit_margins(feats.astype(np.float32), w, b),
-                          np.float64)
+                              np.float64)[:n]
+        (X,), n = pad_rows_to_bucket((feats.astype(np.float32),))
+        return np.asarray(_jit_margins(X, w, b), np.float64)[:n]
 
     def _decision(self, margins: np.ndarray) -> np.ndarray:
         raise NotImplementedError

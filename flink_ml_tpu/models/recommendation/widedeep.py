@@ -495,7 +495,14 @@ class WideDeepModel(WideDeepParams, Model):
                            np.float32)
         cat = np.asarray(table[self.CAT_FEATURES_COL], np.int32)
         cat = _validate_cat_ids(cat, self._vocab_sizes)
-        scores = np.asarray(_jit_scores(self._params, dense, cat), np.float64)
+        # bucketed batch shape (utils/padding.py): one compiled forward per
+        # power-of-two bucket serves every batch size; the per-row forward
+        # makes zero-pad rows (id 0 is always a valid slot) inert
+        from ...utils.padding import pad_rows_to_bucket
+
+        (dense_p, cat_p), n = pad_rows_to_bucket((dense, cat))
+        scores = np.asarray(_jit_scores(self._params, dense_p, cat_p),
+                            np.float64)[:n]
         out = table.with_column(self.get_raw_prediction_col(), scores)
         out = out.with_column(self.get_prediction_col(),
                               (scores > 0.5).astype(np.int64))

@@ -1,0 +1,43 @@
+"""Online serving runtime (the request path of the ROADMAP north star).
+
+Training produces fitted ``Model``\\s; this package turns them into
+endpoints that keep the accelerator saturated under many small concurrent
+requests while bounding tail latency:
+
+- :mod:`.batcher` — bounded request queue + dynamic micro-batcher
+  (max-wait coalescing, shed-on-full admission control),
+- :mod:`.executor` — ``ServableModel``: bucketed power-of-two batch
+  shapes, eager per-bucket warm-up, donated-input jitted scores for the
+  specialized families — zero steady-state retraces, bit-exact with
+  offline ``transform()``,
+- :mod:`.registry` — versioned model registry with atomic hot-swap under
+  a generation counter (warm-up off the serving path; in-flight batches
+  finish on the version they started on),
+- :mod:`.endpoint` — the serve loop wiring them together, with
+  per-endpoint ``MetricGroup`` gauges (queue depth, fill ratio, p50/p99
+  latency, requests/sec, shed count),
+- :mod:`.metrics` — the latency/throughput instrumentation.
+
+Quick start::
+
+    from flink_ml_tpu.serving import serve_model
+
+    endpoint = serve_model(fitted_model, example_request_table)
+    prediction = endpoint.predict(request_table)     # == offline transform
+    endpoint.registry.deploy("default", "/path/v2")  # atomic hot-swap
+    endpoint.close()
+"""
+
+from .batcher import MicroBatcher, ServingOverloadedError, ServingRequest
+from .endpoint import ServingEndpoint, serve_model
+from .executor import ServableModel, make_servable
+from .metrics import LatencyTracker, ServingMetrics
+from .registry import DeployedModel, ModelRegistry
+
+__all__ = [
+    "MicroBatcher", "ServingOverloadedError", "ServingRequest",
+    "ServingEndpoint", "serve_model",
+    "ServableModel", "make_servable",
+    "LatencyTracker", "ServingMetrics",
+    "DeployedModel", "ModelRegistry",
+]

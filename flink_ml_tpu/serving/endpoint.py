@@ -1,0 +1,175 @@
+"""The serving endpoint: queue -> micro-batcher -> compiled executor.
+
+One background serve thread per endpoint drives the loop:
+
+1. ``next_batch`` coalesces concurrent requests under the max-wait
+   deadline (``batcher.py``),
+2. the live :class:`~.registry.DeployedModel` is captured ONCE for the
+   batch (hot-swap atomicity: every request in a batch runs on one fully
+   warmed version; later batches pick up a swapped version on their next
+   capture),
+3. request tables concatenate into one batch table, the executor pads it
+   to the power-of-two bucket and runs the warm-compiled predict,
+4. each request's Future resolves to ITS slice of the output rows.
+
+Backpressure is the batcher's bounded queue (shed-on-full with
+:class:`~.batcher.ServingOverloadedError`); per-endpoint gauges/counters
+(queue depth, batch fill ratio, p50/p99 latency, requests/sec, shed
+count) live in a ``utils.metrics.MetricGroup`` via
+:class:`~.metrics.ServingMetrics`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from concurrent.futures import Future
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..data.table import Table
+from .batcher import MicroBatcher, ServingOverloadedError, ServingRequest
+from .metrics import ServingMetrics
+from .registry import ModelRegistry
+
+__all__ = ["ServingEndpoint", "serve_model"]
+
+
+class ServingEndpoint:
+    """Serve one registry entry.  ``submit`` returns a Future resolving to
+    the output Table for exactly the submitted rows; ``predict`` is the
+    blocking convenience.  Construct, then ``start()`` once the model is
+    deployed and warmed — ``start`` refuses to serve an unwarmed model so
+    readiness implies zero steady-state retraces."""
+
+    def __init__(self, registry: ModelRegistry, name: str = "default", *,
+                 max_batch_rows: int = 256, max_wait_ms: float = 2.0,
+                 queue_capacity: int = 1024,
+                 metrics: Optional[ServingMetrics] = None):
+        self._registry = registry
+        self._name = name
+        self._batcher = MicroBatcher(max_batch_rows=max_batch_rows,
+                                     max_wait_ms=max_wait_ms,
+                                     queue_capacity=queue_capacity)
+        self.metrics = metrics or ServingMetrics()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def registry(self) -> ModelRegistry:
+        """The backing registry — hot-swap via
+        ``endpoint.registry.deploy(name, new_version)``."""
+        return self._registry
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ServingEndpoint":
+        deployed = self._registry.current(self._name)   # raises if absent
+        if not deployed.servable.ready:
+            raise RuntimeError(
+                f"model {self._name!r} (gen {deployed.generation}) is not "
+                "warmed up; deploy() warms automatically — a custom "
+                "servable must warm_up() before the endpoint starts")
+        if self._thread is not None:
+            raise RuntimeError("endpoint already started")
+        self._thread = threading.Thread(
+            target=self._serve_loop, daemon=True,
+            name=f"flink-ml-tpu-serve-{self._name}")
+        self._thread.start()
+        return self
+
+    @property
+    def ready(self) -> bool:
+        if self._thread is None or not self._thread.is_alive():
+            return False
+        try:
+            return self._registry.current(self._name).servable.ready
+        except KeyError:
+            return False
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop admitting, drain queued requests, join the serve loop."""
+        self._batcher.close()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    # -- request path -------------------------------------------------------
+    def submit(self, table: Table) -> Future:
+        """Enqueue one request; sheds with ``ServingOverloadedError`` when
+        the bounded queue is full."""
+        try:
+            request = self._batcher.submit(table)
+        except ServingOverloadedError:
+            self.metrics.on_shed(self._batcher.queue_depth)
+            raise
+        self.metrics.on_submit(self._batcher.queue_depth)
+        return request.future
+
+    def predict(self, table: Table, timeout: Optional[float] = 30.0
+                ) -> Table:
+        return self.submit(table).result(timeout)
+
+    # -- serve loop ---------------------------------------------------------
+    def _serve_loop(self) -> None:
+        while True:
+            batch = self._batcher.next_batch(timeout=0.05)
+            if batch:
+                self._process(batch)
+            elif self._batcher.closed and self._batcher.empty:
+                return
+
+    @staticmethod
+    def _concat(tables: List[Table]) -> Table:
+        if len(tables) == 1:
+            return tables[0]
+        names = tables[0].column_names
+        return Table({
+            name: np.concatenate([t[name] for t in tables], axis=0)
+            for name in names})
+
+    def _process(self, batch: List[ServingRequest]) -> None:
+        # ONE capture per batch: the hot-swap atomicity point.  Every
+        # request below runs on this (immutable, fully warmed) version
+        # even if a deploy publishes mid-predict.
+        deployed = self._registry.current(self._name)
+        servable = deployed.servable
+        rows = sum(r.rows for r in batch)
+        try:
+            for request in batch:
+                servable.check_schema(request.table)
+            out = servable.predict(self._concat([r.table for r in batch]))
+        except BaseException as exc:  # noqa: BLE001 — delivered per-request
+            for request in batch:
+                request.future.set_exception(exc)
+            return
+        offset = 0
+        now = time.perf_counter()
+        latencies = []
+        for request in batch:
+            request.future.set_result(
+                out.slice(offset, offset + request.rows))
+            offset += request.rows
+            latencies.append(now - request.submitted_at)
+        self.metrics.on_batch(
+            n_requests=len(batch), rows=rows,
+            bucket=servable.bucket_for(rows), latencies_s=latencies,
+            queue_depth=self._batcher.queue_depth,
+            generation=deployed.generation)
+
+
+def serve_model(model: Any, example: Table, *, name: str = "default",
+                max_batch_rows: int = 256, max_wait_ms: float = 2.0,
+                queue_capacity: int = 1024,
+                **servable_kwargs: Any) -> ServingEndpoint:
+    """One-call serving for a single fitted model: build a registry,
+    deploy + warm the model, start the endpoint.  Hot-swap later versions
+    with ``endpoint.registry.deploy(name, new_model)``."""
+    registry = ModelRegistry()
+    registry.deploy(name, model, example,
+                    max_batch_rows=max_batch_rows, **servable_kwargs)
+    endpoint = ServingEndpoint(registry, name,
+                               max_batch_rows=max_batch_rows,
+                               max_wait_ms=max_wait_ms,
+                               queue_capacity=queue_capacity)
+    return endpoint.start()

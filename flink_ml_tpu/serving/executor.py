@@ -1,0 +1,253 @@
+"""Compiled executors: ``ServableModel`` wraps a fitted Model for serving.
+
+The adapter's contract:
+
+- **Bucketed shapes.**  Every predict pads its rows to a power-of-two
+  bucket (``utils/padding.py``), so the full space of request/batch sizes
+  in ``[1, max_batch_rows]`` maps onto ``log2`` many compiled programs.
+- **Eager warm-up.**  ``warm_up()`` runs one predict per bucket BEFORE the
+  endpoint reports ready, so steady-state traffic of mixed sizes triggers
+  zero new XLA compiles (asserted in ``tests/test_serving.py`` with a JAX
+  lowering counter).
+- **Bit-exact with offline ``transform()``.**  The served computation is
+  either literally ``model.transform`` (the generic adapter — same jit
+  cache, same host post-processing) or an expression-identical jitted
+  score function for the specialized families; pad rows are inert in
+  every row-independent predict, so serving a request returns exactly the
+  rows offline ``transform`` would.
+- **Donated inputs.**  The specialized executors donate the padded feature
+  buffer to the jitted score on TPU backends (the per-request transfer
+  buffer is dead after the call — donation lets XLA reuse the HBM
+  allocation instead of holding both).  Donation is skipped on backends
+  that ignore it (CPU) to avoid spurious warnings.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.table import Table
+from ..utils.padding import (
+    DEFAULT_BUCKET_CAP,
+    DEFAULT_MIN_BUCKET,
+    bucket_rows,
+    bucket_sizes,
+    pad_rows_to_bucket,
+)
+
+__all__ = ["ServableModel", "make_servable"]
+
+
+# One jit per (name) shared by every servable instance — deploys of new
+# model versions hit the same compile cache, so a hot-swap warm-up only
+# pays tracing for shapes the process has never seen.
+_JIT_CACHE: Dict[str, Callable] = {}
+_JIT_LOCK = threading.Lock()
+
+
+def _serving_jit(name: str, fn: Callable, donate_argnums: Tuple[int, ...],
+                 static_argnums: Tuple[int, ...] = ()) -> Callable:
+    with _JIT_LOCK:
+        cached = _JIT_CACHE.get(name)
+        if cached is None:
+            donate = (donate_argnums
+                      if jax.default_backend() == "tpu" else ())
+            cached = jax.jit(fn, donate_argnums=donate,
+                             static_argnums=static_argnums)
+            _JIT_CACHE[name] = cached
+    return cached
+
+
+class ServableModel:
+    """A fitted Model adapted for online serving: schema-checked,
+    bucket-padded, warm-compiled predict.
+
+    ``example`` is a small Table carrying the REQUEST schema (the columns
+    clients send — typically one row of the training table minus the
+    label); warm-up tiles it to every bucket size.  The generic adapter
+    serves ANY stage whose ``transform`` is row-independent; the
+    specialized subclasses below add donated-input jitted score paths for
+    the families the serving layer optimizes.
+    """
+
+    def __init__(self, model, example: Table, *,
+                 max_batch_rows: int = 256,
+                 min_bucket: int = DEFAULT_MIN_BUCKET,
+                 output_cols: Optional[Sequence[str]] = None):
+        if not hasattr(model, "transform"):
+            raise TypeError(
+                f"{type(model).__name__} has no transform(); only fitted "
+                "Models/Transformers are servable")
+        if example.num_rows == 0:
+            raise ValueError("example must carry at least one row")
+        if max_batch_rows > DEFAULT_BUCKET_CAP:
+            raise ValueError(
+                f"max_batch_rows={max_batch_rows} exceeds the bucket cap "
+                f"({DEFAULT_BUCKET_CAP}) above which predict paths keep "
+                "exact shapes — the zero-retrace warm-up cannot cover it")
+        self.model = model
+        self.example = example
+        self.min_bucket = min_bucket
+        self.max_batch_rows = max_batch_rows
+        self.buckets = bucket_sizes(max_batch_rows, min_bucket)
+        self.output_cols = tuple(output_cols) if output_cols else None
+        self._schema = set(example.column_names)
+        self._ready = False
+
+    # -- predict ------------------------------------------------------------
+    def check_schema(self, table: Table) -> None:
+        names = set(table.column_names)
+        if names != self._schema:
+            raise ValueError(
+                f"request schema {sorted(names)} does not match the "
+                f"endpoint's example schema {sorted(self._schema)}")
+
+    def bucket_for(self, rows: int) -> int:
+        return bucket_rows(rows, min_bucket=self.min_bucket)
+
+    def predict(self, table: Table) -> Table:
+        """Serve one (micro-)batch: returns the transform output for
+        exactly ``table``'s rows, computed at the padded bucket shape."""
+        out = self._run(table)
+        if self.output_cols:
+            out = out.select(*self.output_cols)
+        return out
+
+    def _run(self, table: Table) -> Table:
+        # generic adapter: the model's own transform IS the compiled
+        # executor — its predict entry points bucket-pad internally
+        # (utils/padding.py), so this path shares the offline jit cache
+        # and is bit-exact with offline transform by construction
+        return self.model.transform(table)[0]
+
+    # -- warm-up ------------------------------------------------------------
+    def _tiled_example(self, rows: int) -> Table:
+        reps = -(-rows // self.example.num_rows)
+        return Table({
+            name: np.concatenate([col] * reps, axis=0)[:rows]
+            for name, col in self.example.to_dict().items()})
+
+    def warm_up(self) -> "ServableModel":
+        """Compile every bucket eagerly (one predict per ladder rung) so
+        the endpoint only reports ready once steady state is retrace-free.
+        Runs on the deploying thread — OFF the serving path, so a hot-swap
+        warms the incoming version while the old one keeps serving."""
+        for bucket in self.buckets:
+            self._run(self._tiled_example(bucket))
+        self._ready = True
+        return self
+
+    @property
+    def ready(self) -> bool:
+        return self._ready
+
+
+# -- specialized executors ---------------------------------------------------
+
+def _linear_margins(X, w, b):
+    return X @ w + b
+
+
+class _LinearServable(ServableModel):
+    """Linear family (LogisticRegression / LinearRegression / LinearSVC):
+    dense features score through a donated-input jitted margin; sparse and
+    mixed layouts fall back to the model's own (bucket-routed) transform."""
+
+    def _run(self, table: Table) -> Table:
+        from ..models.common.linear import resolve_features
+
+        model = self.model
+        kind, feats = resolve_features(table, model.get_features_col())
+        if kind != "dense":
+            return model.transform(table)[0]
+        model._require_model()
+        w = jnp.asarray(model._state.coefficients, jnp.float32)
+        b = jnp.asarray(model._state.intercept, jnp.float32)
+        (X,), n = pad_rows_to_bucket((feats.astype(np.float32),),
+                                     min_bucket=self.min_bucket)
+        fn = _serving_jit("linear_margins", _linear_margins, (0,))
+        margins = np.asarray(fn(X, w, b), np.float64)[:n]
+        out = table.with_column(model.get_prediction_col(),
+                                model._decision(margins))
+        raw_col = model.get_raw_prediction_col()
+        if raw_col:
+            out = out.with_column(raw_col, model._raw(margins))
+        return out
+
+
+def _kmeans_assign(measure, points, centroids):
+    return jnp.argmin(measure.pairwise(points, centroids), axis=1)
+
+
+class _KMeansServable(ServableModel):
+    """KMeansModel: donated-input jitted nearest-centroid assign."""
+
+    def _run(self, table: Table) -> Table:
+        from ..distance import DistanceMeasure
+        from ..linalg import stack_vectors
+
+        model = self.model
+        model._require_model()
+        measure = DistanceMeasure.get_instance(model.get_distance_measure())
+        points = stack_vectors(
+            table[model.get_features_col()]).astype(np.float32)
+        (points,), n = pad_rows_to_bucket((points,),
+                                          min_bucket=self.min_bucket)
+        fn = _serving_jit("kmeans_assign", _kmeans_assign,
+                         (1,), static_argnums=(0,))
+        assign = np.asarray(
+            fn(measure, points, jnp.asarray(model._centroids)))[:n]
+        return table.with_column(model.get_prediction_col(),
+                                 assign.astype(np.int64))
+
+
+def _widedeep_scores(params, dense, cat_ids):
+    from ..models.recommendation.widedeep import forward
+
+    return jax.nn.sigmoid(forward(params, dense, cat_ids))
+
+
+class _WideDeepServable(ServableModel):
+    """WideDeepModel: donated-input jitted sigmoid(forward)."""
+
+    def _run(self, table: Table) -> Table:
+        from ..models.recommendation.widedeep import _validate_cat_ids
+
+        model = self.model
+        model._require_model()
+        dense = np.asarray(table[model.DENSE_FEATURES_COL], np.float32)
+        cat = np.asarray(table[model.CAT_FEATURES_COL], np.int32)
+        cat = _validate_cat_ids(cat, model._vocab_sizes)
+        (dense, cat), n = pad_rows_to_bucket((dense, cat),
+                                             min_bucket=self.min_bucket)
+        fn = _serving_jit("widedeep_scores", _widedeep_scores, (1, 2))
+        scores = np.asarray(fn(model._params, dense, cat), np.float64)[:n]
+        out = table.with_column(model.get_raw_prediction_col(), scores)
+        return out.with_column(model.get_prediction_col(),
+                               (scores > 0.5).astype(np.int64))
+
+
+def make_servable(model, example: Table, **kwargs: Any) -> ServableModel:
+    """Adapt a fitted Model for serving, picking the specialized executor
+    for the covered families (linear / KMeans / Wide&Deep; GBT and every
+    other row-independent transform serve through the generic adapter,
+    whose predict entry points are bucket-routed since this PR)."""
+    from ..models.clustering.kmeans import KMeansModel
+    from ..models.common.linear import LinearModelBase
+    from ..models.recommendation.widedeep import WideDeepModel
+
+    if isinstance(model, LinearModelBase):
+        cls: type = _LinearServable
+    elif isinstance(model, KMeansModel):
+        cls = _KMeansServable
+    elif isinstance(model, WideDeepModel):
+        cls = _WideDeepServable
+    else:
+        cls = ServableModel
+    return cls(model, example, **kwargs)

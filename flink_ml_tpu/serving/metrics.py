@@ -1,0 +1,116 @@
+"""Serving observability: per-endpoint latency quantiles + throughput.
+
+Reuses the framework's :class:`~flink_ml_tpu.utils.metrics.MetricGroup`
+registry (the Flink metric-group analog) so an endpoint's gauges flatten
+into the same ``snapshot()`` namespace as training metrics.  The latency
+quantiles come from a bounded ring buffer — O(window) memory for a
+process-lifetime endpoint, quantiles over the most recent ``window``
+requests (the operationally relevant horizon for p99).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils.metrics import MetricGroup
+
+__all__ = ["LatencyTracker", "ServingMetrics"]
+
+
+class LatencyTracker:
+    """Ring buffer of the most recent ``window`` request latencies
+    (seconds); thread-safe, constant memory."""
+
+    def __init__(self, window: int = 4096):
+        if window <= 0:
+            raise ValueError("window must be positive")
+        self._buf = np.zeros((window,), np.float64)
+        self._idx = 0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._buf[self._idx] = seconds
+            self._idx = (self._idx + 1) % self._buf.shape[0]
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def quantile(self, q: float) -> float:
+        """Latency quantile in SECONDS over the retained window (0.0 when
+        nothing recorded yet)."""
+        with self._lock:
+            n = min(self._count, self._buf.shape[0])
+            if n == 0:
+                return 0.0
+            return float(np.quantile(self._buf[:n], q))
+
+
+class ServingMetrics:
+    """The per-endpoint metric bundle: queue depth, batch fill ratio,
+    p50/p99 latency, requests/sec, shed count — all living in one
+    ``MetricGroup`` subtree so ``group.snapshot()`` exports them next to
+    every other framework metric."""
+
+    def __init__(self, group: Optional[MetricGroup] = None,
+                 latency_window: int = 4096):
+        self.group = group or MetricGroup("serving")
+        self.requests = self.group.counter("requests")
+        self.batches = self.group.counter("batches")
+        self.shed = self.group.counter("shed")
+        self._queue_depth = self.group.gauge("queue_depth")
+        self._fill = self.group.gauge("batch_fill_ratio")
+        self._p50 = self.group.gauge("latency_p50_ms")
+        self._p99 = self.group.gauge("latency_p99_ms")
+        self._rate = self.group.gauge("requests_per_sec")
+        self._generation = self.group.gauge("model_generation")
+        self.latency = LatencyTracker(latency_window)
+        self._rate_lock = threading.Lock()
+        self._rate_t: Optional[float] = None
+        self._rate_value = 0.0
+
+    def on_shed(self, queue_depth: int) -> None:
+        self.shed.inc()
+        self._queue_depth.set(queue_depth)
+
+    def on_submit(self, queue_depth: int) -> None:
+        self._queue_depth.set(queue_depth)
+
+    def on_batch(self, *, n_requests: int, rows: int, bucket: int,
+                 latencies_s: List[float], queue_depth: int,
+                 generation: Optional[int] = None) -> None:
+        """Record one served micro-batch.  ``bucket`` is the padded batch
+        size the executor compiled for — ``rows / bucket`` is the fill
+        ratio (1.0 = the padding overhead was zero)."""
+        now = time.perf_counter()
+        self.batches.inc()
+        self.requests.inc(n_requests)
+        for lat in latencies_s:
+            self.latency.record(lat)
+        self._queue_depth.set(queue_depth)
+        self._fill.set(round(rows / max(bucket, 1), 4))
+        self._p50.set(round(1e3 * self.latency.quantile(0.50), 3))
+        self._p99.set(round(1e3 * self.latency.quantile(0.99), 3))
+        if generation is not None:
+            self._generation.set(generation)
+        with self._rate_lock:
+            if self._rate_t is not None:
+                dt = max(now - self._rate_t, 1e-9)
+                inst = n_requests / dt
+                # EWMA over batches: smooth enough to gauge, cheap enough
+                # to update on every batch
+                self._rate_value = (0.8 * self._rate_value + 0.2 * inst
+                                    if self._rate_value else inst)
+                self._rate.set(round(self._rate_value, 2))
+            self._rate_t = now
+
+    def snapshot(self) -> Dict[str, object]:
+        return self.group.snapshot()

@@ -1,0 +1,110 @@
+"""Model registry with atomic hot-swap.
+
+Versions load from the framework's persistence layout
+(``utils/persist.py`` — ``{path}/metadata`` + ``{path}/data``; load
+failures surface as diagnosable ``IOError``\\s naming the path and the
+stored class name), adapt through :func:`~.executor.make_servable`, and
+warm up OFF the serving path: the deploying thread compiles every bucket
+while the previous version keeps answering traffic.  Only then does the
+new version publish, as ONE reference assignment under the registry lock
+tagged with a monotonically increasing **generation**.
+
+Atomicity contract: a reader (the endpoint's serve loop) takes
+``current(name)`` exactly once per micro-batch, so every request in a
+batch runs on one fully-warmed version; in-flight batches keep their
+(old) servable alive by plain reference and finish on it.  No request can
+ever observe a half-loaded model, because nothing is published before
+``warm_up`` returns.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from ..data.table import Table
+from ..utils import persist
+from .executor import ServableModel, make_servable
+
+__all__ = ["DeployedModel", "ModelRegistry"]
+
+
+@dataclass(frozen=True)
+class DeployedModel:
+    """One published version: immutable, so a reference captured at batch
+    formation stays internally consistent for the batch's lifetime."""
+    name: str
+    servable: ServableModel
+    generation: int
+    source: str
+    deployed_at: float
+
+
+class ModelRegistry:
+    """name -> live :class:`DeployedModel`, swapped atomically."""
+
+    def __init__(self, servable_factory: Optional[Callable] = None):
+        self._factory = servable_factory or make_servable
+        self._live: Dict[str, DeployedModel] = {}
+        self._lock = threading.Lock()
+
+    def deploy(self, name: str, model: Any,
+               example: Optional[Table] = None,
+               **servable_kwargs: Any) -> DeployedModel:
+        """Load (if ``model`` is a saved-stage path), adapt, warm up, then
+        atomically publish as the next generation of ``name``.  On a
+        re-deploy, ``example`` (and servable config) may be omitted to
+        inherit the incumbent's."""
+        if isinstance(model, str):
+            source = model
+            model = persist.load_stage(model)
+        else:
+            source = f"<memory:{type(model).__name__}>"
+        incumbent = self._live.get(name)
+        if example is None:
+            if incumbent is None:
+                raise ValueError(
+                    f"first deploy of {name!r} needs an example Table "
+                    "(the request schema warm-up tiles over)")
+            example = incumbent.servable.example
+            if not servable_kwargs:
+                servable_kwargs = {
+                    "max_batch_rows": incumbent.servable.max_batch_rows,
+                    "min_bucket": incumbent.servable.min_bucket,
+                    "output_cols": incumbent.servable.output_cols,
+                }
+        servable = self._factory(model, example, **servable_kwargs)
+        servable.warm_up()   # off the serving path: old version still live
+        with self._lock:
+            previous = self._live.get(name)
+            generation = (previous.generation + 1) if previous else 1
+            deployed = DeployedModel(name=name, servable=servable,
+                                     generation=generation, source=source,
+                                     deployed_at=time.time())
+            self._live[name] = deployed   # THE swap: one dict assignment
+        return deployed
+
+    def current(self, name: str) -> DeployedModel:
+        """The live version — one atomic read; callers serving a batch
+        call this ONCE and use the returned reference throughout."""
+        with self._lock:
+            deployed = self._live.get(name)
+        if deployed is None:
+            raise KeyError(
+                f"no model deployed under {name!r}; call deploy() first "
+                f"(deployed: {self.names()})")
+        return deployed
+
+    def generation(self, name: str) -> int:
+        return self.current(name).generation
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._live)
+
+    def undeploy(self, name: str) -> None:
+        with self._lock:
+            self._live.pop(name, None)

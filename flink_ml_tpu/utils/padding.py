@@ -9,7 +9,21 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["FixedRowBatcher", "pad_rows_with_mask"]
+__all__ = ["FixedRowBatcher", "pad_rows_with_mask", "bucket_rows",
+           "bucket_sizes", "pad_rows_to_bucket", "DEFAULT_MIN_BUCKET"]
+
+#: Smallest row bucket the shared predict paths pad to.  Every batch size in
+#: [1, 8] compiles the same program, and each further power of two adds one
+#: compile — the bucket ladder the serving warm-up walks.
+DEFAULT_MIN_BUCKET = 8
+
+#: Largest batch the shared predict paths bucket-pad.  Above this, padding
+#: to the next power of two would cost up to 2x the FLOPs and peak device
+#: memory of the exact shape — a bad trade for huge OFFLINE tables, whose
+#: single exact-shape compile is amortized over the whole call anyway.
+#: Online serving batches sit far below this (``max_batch_rows``), so the
+#: zero-retrace guarantee is unaffected.
+DEFAULT_BUCKET_CAP = 1 << 16
 
 
 class FixedRowBatcher:
@@ -60,6 +74,62 @@ class FixedRowBatcher:
             np.concatenate(
                 [a, np.zeros((rows - have,) + a.shape[1:], a.dtype)])
             for a in arrays)
+
+
+def bucket_rows(n: int, *, min_bucket: int = DEFAULT_MIN_BUCKET) -> int:
+    """The power-of-two row bucket ``n`` rows pad to (floored at
+    ``min_bucket``).  Bucketing is what makes predict paths compile a
+    BOUNDED set of programs: every distinct request/batch size in
+    ``(bucket/2, bucket]`` hits the same jitted executable, so steady-state
+    traffic of mixed sizes triggers zero retraces after one warm-up pass
+    over the ladder."""
+    if min_bucket <= 0:
+        raise ValueError("min_bucket must be positive")
+    if n <= min_bucket:
+        return min_bucket
+    return 1 << (int(n) - 1).bit_length()
+
+
+def bucket_sizes(max_rows: int,
+                 min_bucket: int = DEFAULT_MIN_BUCKET) -> Tuple[int, ...]:
+    """The full bucket ladder covering every batch of ``1..max_rows`` rows
+    (ascending powers of two) — what a serving warm-up must compile for the
+    endpoint to promise zero steady-state retraces."""
+    if max_rows <= 0:
+        raise ValueError("max_rows must be positive")
+    sizes = []
+    b = bucket_rows(1, min_bucket=min_bucket)
+    top = bucket_rows(max_rows, min_bucket=min_bucket)
+    while b <= top:
+        sizes.append(b)
+        b <<= 1
+    return tuple(sizes)
+
+
+def pad_rows_to_bucket(arrays: Sequence[np.ndarray], *,
+                       min_bucket: int = DEFAULT_MIN_BUCKET,
+                       max_bucket_rows: Optional[int] = DEFAULT_BUCKET_CAP
+                       ) -> Tuple[Tuple[np.ndarray, ...], int]:
+    """Zero-pad every array's leading dim to the shared power-of-two bucket;
+    returns ``(padded_arrays, n_real_rows)`` — the caller slices device
+    results back to ``[:n]``.  Safe for every ROW-INDEPENDENT predict
+    computation (margins, per-row argmin, tree routing, MLP forward): pad
+    rows never influence real rows, and zero is a valid filler for both
+    float features and int id/bin columns (id 0 always exists).
+
+    Batches above ``max_bucket_rows`` (None = unlimited) keep their exact
+    shape: the up-to-2x pad cost only buys retrace-freedom for REPEATED
+    mixed sizes, which huge one-shot offline tables don't have."""
+    n = int(arrays[0].shape[0])
+    if max_bucket_rows is not None and n > max_bucket_rows:
+        return tuple(np.asarray(a) for a in arrays), n
+    bucket = bucket_rows(n, min_bucket=min_bucket)
+    if n == bucket:
+        return tuple(np.asarray(a) for a in arrays), n
+    return tuple(
+        np.concatenate(
+            [a, np.zeros((bucket - n,) + a.shape[1:], a.dtype)])
+        for a in arrays), n
 
 
 def pad_rows_with_mask(arr, multiple: int,

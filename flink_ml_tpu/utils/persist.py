@@ -50,6 +50,27 @@ def _resolve_class(class_name: str) -> type:
     return obj
 
 
+def _resolve_saved_class(path: str, meta: Dict[str, Any]) -> type:
+    """Resolve ``meta["className"]`` for the stage saved at ``path``,
+    converting the raw importlib/getattr failure modes (module renamed,
+    class deleted, metadata truncated) into a diagnosable ``IOError``
+    naming the path and the stored class name — the model registry's
+    hot-load path depends on these being actionable."""
+    class_name = meta.get("className")
+    if not class_name:
+        raise IOError(
+            f"Metadata at {path} has no className entry; the directory is "
+            "not a saved stage (or the metadata file is truncated)")
+    try:
+        return _resolve_class(class_name)
+    except (ImportError, AttributeError, ValueError) as exc:
+        raise IOError(
+            f"Cannot load stage at {path}: the stored class "
+            f"{class_name!r} is not importable ({exc}).  The class was "
+            "renamed/removed since the stage was saved, or the save came "
+            "from a different code version.") from exc
+
+
 def save_metadata(stage, path: str, extra: Optional[Dict[str, Any]] = None) -> None:
     """Mirror of ``ReadWriteUtils.saveMetadata`` (``ReadWriteUtils.java:77-96``).
 
@@ -104,7 +125,7 @@ def load_stage(path: str):
     """Reflective dispatch to the saved class's ``load``
     (``ReadWriteUtils.java:294-314``)."""
     meta = load_metadata(path)
-    cls = _resolve_class(meta["className"])
+    cls = _resolve_saved_class(path, meta)
     load_fn = getattr(cls, "load", None)
     if load_fn is None:
         raise IOError(f"Class {meta['className']} does not implement load()")
@@ -116,7 +137,7 @@ def load_stage_param(path: str):
     (``ReadWriteUtils.java:258-280``) — for stages whose state is purely
     their params."""
     meta = load_metadata(path)
-    cls = _resolve_class(meta["className"])
+    cls = _resolve_saved_class(path, meta)
     stage = cls()
     stage.params_from_json(meta.get("paramMap", {}))
     return stage
