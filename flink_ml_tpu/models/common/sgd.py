@@ -75,6 +75,17 @@ class SGDConfig:
     #: "highest" restores bit-comparable-to-XLA gathers at ~2.4x the
     #: step cost.
     ell_precision: str = "default"
+    #: How the data-parallel gradient sum is performed
+    #: (:class:`~flink_ml_tpu.parallel.grad_reduce.GradReduceConfig`).
+    #: ``None`` (default) and ``mode="exact"`` keep the legacy implicit
+    #: GSPMD ``lax.psum`` path bit-identically; compressed modes
+    #: (``topk`` error-feedback sparsification, ``int8`` block
+    #: quantization, hierarchical ICI x DCN composition) route the DENSE
+    #: trainers' gradients through an explicit
+    #: :func:`~flink_ml_tpu.parallel.grad_reduce.reduce_gradients` —
+    #: the EF residual rides the donated scan carry next to the weights
+    #: and round-trips through checkpoints with them.
+    grad_reduce: Optional[object] = None
 
 
 #: Classic minibatch default when nothing layout-aware applies.
@@ -209,8 +220,28 @@ def sgd_fit_params(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
     (exact for class ids < 2^24 — cast back inside the loss)."""
     mesh = mesh or default_mesh()
     n = features.shape[0]
-    steps, batch, perm = _plan_epoch_layout_for_mesh(
-        n, resolve_global_batch_size(config, n), mesh, config.seed)
+    gr = _active_grad_reduce(config)
+    if gr is None:
+        batch_axis = "data"
+        steps, batch, perm = _plan_epoch_layout_for_mesh(
+            n, resolve_global_batch_size(config, n), mesh, config.seed)
+    else:
+        axes, n_dev_red, batch_axis = _grad_reduce_layout(gr, mesh)
+        if axes == ("data",):
+            steps, batch, perm = _plan_epoch_layout_for_mesh(
+                n, resolve_global_batch_size(config, n), mesh, config.seed)
+        else:
+            # hierarchical: the batch shards over dcn x data; the fused
+            # fit stays single-process (multi-host compressed training
+            # rides sgd_fit_outofcore's per-process readers)
+            if _mesh_process_count(mesh) > 1:
+                raise ValueError(
+                    "hierarchical grad_reduce in the fused fit requires a "
+                    "single-process mesh; stream multi-host fits through "
+                    "sgd_fit_outofcore")
+            steps, batch, perm = plan_epoch_layout(
+                n, resolve_global_batch_size(config, n), n_dev_red,
+                config.seed)
 
     X = prepare_epoch_tensor(features.astype(np.float32), perm, steps, batch)
     y = prepare_epoch_tensor(labels.astype(np.float32), perm, steps, batch)
@@ -218,13 +249,24 @@ def sgd_fit_params(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
               else np.ones((n,), np.float32))
     w = prepare_epoch_tensor(w_host, perm, steps, batch, pad_value=0.0)
 
-    X = _put_epoch_tensor(X, mesh, P(None, "data", None))
-    y = _put_epoch_tensor(y, mesh, P(None, "data"))
-    w = _put_epoch_tensor(w, mesh, P(None, "data"))
+    X = _put_epoch_tensor(X, mesh, P(None, batch_axis, None))
+    y = _put_epoch_tensor(y, mesh, P(None, batch_axis))
+    w = _put_epoch_tensor(w, mesh, P(None, batch_axis))
 
-    update = _linear_update(loss_fn, config)
-    return _run_minibatch_epochs(update, (X, y, w), init_params, steps,
-                                 config, mesh)
+    if gr is None:
+        update = _linear_update(loss_fn, config)
+        return _run_minibatch_epochs(update, (X, y, w), init_params, steps,
+                                     config, mesh)
+    from ...parallel import grad_reduce as GR
+
+    update = _linear_update_reduced(loss_fn, config, mesh)
+    init_params = dict(init_params)
+    init_params[GR_STATE_KEY] = GR.init_state(gr, {
+        k: init_params[k] for k in ("w", "b")}, n_dev_red)
+    params, loss_log = _run_minibatch_epochs(update, (X, y, w), init_params,
+                                             steps, config, mesh)
+    params.pop(GR_STATE_KEY, None)
+    return params, loss_log
 
 
 def _run_minibatch_epochs(update, data: tuple, init_params, steps: int,
@@ -300,6 +342,96 @@ def _linear_update(loss_fn: LossFn, config: SGDConfig):
         new_b = params["b"] - (lr * grads["b"]
                                if config.fit_intercept else 0.0)
         return {"w": new_w, "b": new_b}, value
+
+    return update
+
+
+#: Reserved params-pytree key the compressed-reduction trainers use to
+#: carry reducer state (EF residual / rounding key) in the SAME donated
+#: scan carry as the weights — which is exactly what makes it ride every
+#: existing checkpoint cut and restore untouched.
+GR_STATE_KEY = "_gr"
+
+
+def _active_grad_reduce(config: SGDConfig):
+    """The grad-reduce config IF it changes anything: ``None`` (and
+    ``mode="exact"``) keep the legacy implicit-psum path — the unchanged,
+    bit-identical default."""
+    gr = config.grad_reduce
+    if gr is None or gr.mode == "exact":
+        return None
+    return gr
+
+
+def _grad_reduce_layout(gr, mesh):
+    """(reduction axes, participant count, batch PartitionSpec entry) for
+    a compressed fit on ``mesh`` — the shared
+    :func:`~flink_ml_tpu.parallel.grad_reduce.mesh_layout` validation."""
+    from ...parallel import grad_reduce as GR
+
+    return GR.mesh_layout(gr, mesh)
+
+
+def _linear_update_reduced(loss_fn: LossFn, config: SGDConfig, mesh):
+    """Explicit-reduction twin of :func:`_linear_update` for the dense
+    layout: per-device gradients of the GLOBAL weighted-mean loss are
+    computed inside ``shard_map`` over the reduction axes and summed
+    through :func:`~flink_ml_tpu.parallel.grad_reduce.reduce_gradients`
+    (topk-EF / int8 / hierarchical per ``config.grad_reduce``).  The
+    reducer state travels in ``params[GR_STATE_KEY]`` with a leading
+    participant dim sharded over the reduction axes.
+
+    Same regularization algebra as the exact path: the local weighted
+    mean is re-normalized to the global denominator (the
+    ``_mixed_update_sharded`` stance), the l2 term applies as exact
+    decay on the replicated weight AFTER the reduction (it needs no
+    communication, so it is never compressed), and l1 stays the proximal
+    soft-threshold."""
+    from ...parallel import grad_reduce as GR
+
+    gr = config.grad_reduce
+    lr = config.learning_rate
+    reg, alpha = config.reg, config.elastic_net
+    l2 = reg * (1.0 - alpha)
+    l1 = reg * alpha
+    axes, _, batch_axis = _grad_reduce_layout(gr, mesh)
+    x_spec = P(batch_axis, None)
+    v_spec = P(batch_axis)
+    st_spec = P(batch_axis)
+
+    def device_fn(w, b, gr_state, xb, yb, wb):
+        margin = xb @ w + b
+        value_local, pull = jax.vjp(lambda m: loss_fn(m, yb, wb), margin)
+        (r,) = pull(jnp.ones_like(value_local))
+        # re-normalize the loss_fn's LOCAL weighted mean to the global
+        # denominator so the objective equals the single-program one
+        denom_local = jnp.maximum(jnp.sum(wb), 1e-12)
+        denom = jax.lax.psum(denom_local, axes)
+        value = jax.lax.psum(value_local * denom_local, axes) / denom
+        r = r * (denom_local / denom)
+        grads = {"w": jnp.tensordot(xb, r, axes=((0,), (0,))),
+                 "b": jnp.sum(r, axis=0)}
+        red, new_state = GR.reduce_gradients(
+            grads, GR.squeeze_state(gr_state), gr)
+        if l2 > 0:
+            value = value + 0.5 * l2 * jnp.sum(jnp.square(w))
+            w = w * (1.0 - lr * l2)
+        new_w = w - lr * red["w"]
+        if l1 > 0:
+            new_w = jnp.sign(new_w) * jnp.maximum(
+                jnp.abs(new_w) - lr * l1, 0.0)
+        new_b = b - (lr * red["b"] if config.fit_intercept else 0.0)
+        return new_w, new_b, GR.unsqueeze_state(new_state), value
+
+    fn = _shard_map(
+        device_fn, mesh,
+        in_specs=(P(), P(), st_spec, x_spec, v_spec, v_spec),
+        out_specs=(P(), P(), st_spec, P()))
+
+    def update(params, xb, yb, wb):
+        w, b, st, value = fn(params["w"], params["b"],
+                             params[GR_STATE_KEY], xb, yb, wb)
+        return {"w": w, "b": b, GR_STATE_KEY: st}, value
 
     return update
 
@@ -564,21 +696,13 @@ def _mixed_update_ell(loss_fn: LossFn, config: SGDConfig,
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
-    """jax.shard_map with the repo's compat shims (same dance as
-    ``ops/kmeans_pallas.py``): older-JAX import path and ``check_vma``
-    off because pallas_call out_shapes carry no varying-mesh-axes
-    annotation."""
-    import inspect
+    """jax.shard_map with the repo's compat shims — one shared copy in
+    ``parallel/collectives.py`` (handles the older-JAX import path and
+    turns the replication check off on every version, since pallas_call
+    out_shapes carry no varying-mesh-axes annotation)."""
+    from ...parallel.collectives import shard_map_fn
 
-    sm = getattr(jax, "shard_map", None)
-    if sm is None:  # older JAX
-        from jax.experimental.shard_map import shard_map as sm  # type: ignore
-
-    kwargs = {}
-    if "check_vma" in inspect.signature(sm).parameters:
-        kwargs["check_vma"] = False
-    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-              **kwargs)
+    return shard_map_fn(fn, mesh, in_specs=in_specs, out_specs=out_specs)
 
 
 def _mixed_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
@@ -1319,13 +1443,36 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     # multi-device data axis the decode builds PER-DEVICE shard layouts
     # and the update is the device-local-grid + psum variant (same
     # stance as the fused sgd_fit_mixed, r4).
+    gr = _active_grad_reduce(config)
+    if gr is not None and (mixed or sparse):
+        # categorical/sparse layouts already ship sparse gradients by
+        # construction (scatter supports bounded by the batch's slots);
+        # compressing them again would pay EF state for nothing
+        raise ValueError(
+            "grad_reduce compression applies to the dense streaming "
+            "layout; the sparse/mixed paths' gradients are already "
+            "sparse by construction — drop grad_reduce or use the dense "
+            "features layout")
+    gr_batch_axis = "data"
+    n_dev_red = n_dev
+    if gr is not None:
+        gr_axes, n_dev_red, gr_batch_axis = _grad_reduce_layout(gr, mesh)
+        if gr_axes != ("data",):
+            if procs > 1:
+                raise ValueError(
+                    "hierarchical grad_reduce streaming is single-process "
+                    "for now; multi-host hybrid meshes reduce over the "
+                    "data axis per host")
+            # the batch shards over every reduction axis jointly
+            n_local_dev = n_dev_red
     stream_ell = (mixed and plan_mixed_impl(
         num_features, mesh, allow_sharded=True,
         allow_multiprocess=True) == "ell")
     stream_sharded = stream_ell and n_dev > 1
     stream_impl = ("ell-stream" if stream_ell
                    else ("xla-stream" if (mixed or sparse)
-                         else "dense-stream"))
+                         else ("dense-stream-reduced" if gr is not None
+                               else "dense-stream")))
     if stream_sharded:
         update = _mixed_update_ell_sharded(
             loss_fn, config, mesh, num_features,
@@ -1333,6 +1480,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     elif stream_ell:
         update = _mixed_update_ell(
             loss_fn, config, use_pallas=jax.default_backend() == "tpu")
+    elif gr is not None:
+        update = _linear_update_reduced(loss_fn, config, mesh)
     else:
         update = (_mixed_update(loss_fn, config) if mixed
                   else (_sparse_update if sparse
@@ -1345,8 +1494,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     elif isinstance(checkpoint, CheckpointConfig):
         manager = CheckpointManager(checkpoint)
 
-    x_p = P("data", None)
-    v_p = P("data")
+    x_p = P(gr_batch_axis, None)
+    v_p = P(gr_batch_axis)
     if stream_sharded:
         # layout stacks carry a leading device dim sharded over 'data'
         g3, g2 = P("data", None, None), P("data", None)
@@ -1505,9 +1654,17 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
             return host
         return to_host_batch(item[1])
 
-    params = replicate(
-        {"w": jnp.zeros((num_features,), jnp.float32),
-         "b": jnp.zeros((), jnp.float32)}, mesh)
+    init_params = {"w": jnp.zeros((num_features,), jnp.float32),
+                   "b": jnp.zeros((), jnp.float32)}
+    if gr is not None:
+        from ...parallel import grad_reduce as GR
+
+        # reducer state (EF residual / rounding key) joins the params
+        # carry: every mid-epoch checkpoint cut and restore below
+        # round-trips it with the weights for free
+        init_params[GR_STATE_KEY] = GR.init_state(
+            gr, {"w": init_params["w"], "b": init_params["b"]}, n_dev_red)
+    params = replicate(init_params, mesh)
     loss_log: list = []
     prev_loss = float("inf")
     start_epoch = 0
@@ -1775,6 +1932,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         if stop:
             break
     params = _fetch_replicated(params)
+    params.pop(GR_STATE_KEY, None)
     if stream_info is not None:
         stream_info["impl"] = stream_impl
         stream_info["steps_per_dispatch"] = W

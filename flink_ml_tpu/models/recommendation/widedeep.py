@@ -721,10 +721,27 @@ def assert_sharded_matches_reference(sharded_params, sharded_loss,
 
 
 def build_sharded_train_step(mesh, d_dense: int, vocab_sizes, emb_dim: int,
-                             hidden, lr: float = 1e-2):
+                             hidden, lr: float = 1e-2, grad_reduce=None):
     """A dp x tp training step for the multichip dry run: embeddings and MLP
     hidden dims sharded over 'model', batch over 'data'.  Returns
-    (train_step, sharded_params, opt, sharded_opt_state, shard_batch_fn)."""
+    (train_step, sharded_params, opt, sharded_opt_state, shard_batch_fn).
+
+    ``grad_reduce``
+    (:class:`~flink_ml_tpu.parallel.grad_reduce.GradReduceConfig`):
+    ``None``/``mode="exact"`` keep the implicit-GSPMD step above
+    unchanged.  A compressed mode routes the DENSE-tower gradients
+    (``wide_dense``/``wide_b``/``mlp``) through
+    :func:`~flink_ml_tpu.parallel.grad_reduce.reduce_gradients` — the
+    data axis goes manual (``shard_map``) while the ``model`` axis stays
+    under GSPMD auto partitioning, so Megatron-style tensor parallelism
+    composes untouched.  The embedding/wide-table gradients stay EXACT:
+    their per-step support is the batch's id set, i.e. they are already
+    sparse by construction and top-k would only re-compress a scatter.
+    The step then takes (and returns) the reducer state, and the builder
+    returns a 6-tuple with its initial value appended:
+    ``(train_step, params, opt, opt_state, shard_batch_fn, gr_state0)``
+    with ``train_step(params, opt_state, gr_state, dense, cat_ids,
+    labels, mask) -> (params, opt_state, gr_state, loss)``."""
     rng = np.random.default_rng(0)
     params = init_params(rng, d_dense, vocab_sizes, emb_dim, hidden)
 
@@ -756,6 +773,10 @@ def build_sharded_train_step(mesh, d_dense: int, vocab_sizes, emb_dim: int,
     opt_state = opt.init(sharded_params)
     grad_fn = jax.value_and_grad(bce_loss)
 
+    if grad_reduce is not None and grad_reduce.mode != "exact":
+        return _build_reduced_sharded_step(mesh, grad_reduce, sharded_params,
+                                           opt, opt_state, grad_fn)
+
     @jax.jit
     def train_step(params, opt_state, dense, cat_ids, labels, mask):
         loss, grads = grad_fn(params, dense, cat_ids, labels, mask)
@@ -771,3 +792,91 @@ def build_sharded_train_step(mesh, d_dense: int, vocab_sizes, emb_dim: int,
         )
 
     return train_step, sharded_params, opt, opt_state, shard_batch_fn
+
+
+def _build_reduced_sharded_step(mesh, gr, sharded_params, opt, opt_state,
+                                grad_fn):
+    """The compressed-reduction variant of :func:`build_sharded_train_step`
+    (see its docstring for the contract): manual ``shard_map`` over the
+    reduction axes, every OTHER mesh axis (``model``) left to GSPMD auto
+    partitioning, dense-tower grads through ``reduce_gradients``, table
+    grads exact."""
+    from ...parallel import grad_reduce as GR
+    from ...parallel.collectives import shard_map_fn
+
+    axes, n_red, batch_axis = GR.mesh_layout(gr, mesh)
+    auto_axes = frozenset(n for n in mesh.axis_names if n not in axes)
+
+    def split(tree):
+        tables = {k: tree[k] for k in _LAZY_TABLE_KEYS}
+        rest = {k: v for k, v in tree.items() if k not in _LAZY_TABLE_KEYS}
+        return tables, rest
+
+    _, rest0 = split(sharded_params)
+    gr_state0 = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P(batch_axis))),
+        GR.init_state(gr, jax.tree_util.tree_map(np.asarray, rest0), n_red))
+
+    # Stage 1 — per-device gradients, 'model' under GSPMD auto so the
+    # Megatron sharding composes: table grads reduce EXACTLY here (their
+    # support is the batch's id set — sparse by construction); the dense
+    # tower comes back STACKED per participant for stage 2.
+    def local_grads(params, dense, cat_ids, labels, mask):
+        loss_l, grads = grad_fn(params, dense, cat_ids, labels, mask)
+        # bce_loss is a mask-weighted LOCAL mean; renormalize to the
+        # global denominator so loss and gradient equal the
+        # single-program objective (the _mixed_update_sharded stance)
+        denom_l = jnp.maximum(jnp.sum(mask), 1e-12)
+        denom = jax.lax.psum(denom_l, axes)
+        loss = jax.lax.psum(loss_l * denom_l, axes) / denom
+        grads = jax.tree_util.tree_map(lambda g: g * (denom_l / denom),
+                                       grads)
+        g_tab, g_rest = split(grads)
+        g_tab = jax.tree_util.tree_map(lambda g: jax.lax.psum(g, axes),
+                                       g_tab)
+        return loss, g_tab, jax.tree_util.tree_map(
+            lambda g: g[None], g_rest)
+
+    grads_fn = shard_map_fn(
+        local_grads, mesh,
+        in_specs=(P(), P(batch_axis, None), P(batch_axis, None),
+                  P(batch_axis), P(batch_axis)),
+        out_specs=(P(), P(), P(batch_axis)),
+        auto=auto_axes)
+
+    # Stage 2 — the compressed reduction runs FULLY manual (every mesh
+    # axis bound): this XLA's partitioner aborts on lax.top_k inside a
+    # manual-subgroup (auto) region, and the dense-tower leaves carry no
+    # model sharding anyway, so model peers just replicate the reduce.
+    def reduce_local(g_stacked, gr_state):
+        g_l = jax.tree_util.tree_map(lambda a: a[0], g_stacked)
+        red, new_state = GR.reduce_gradients(
+            g_l, GR.squeeze_state(gr_state), gr)
+        return red, GR.unsqueeze_state(new_state)
+
+    reduce_fn = shard_map_fn(
+        reduce_local, mesh,
+        in_specs=(P(batch_axis), P(batch_axis)),
+        out_specs=(P(), P(batch_axis)))
+
+    @jax.jit
+    def train_step(params, opt_state, gr_state, dense, cat_ids, labels,
+                   mask):
+        loss, g_tab, g_stacked = grads_fn(params, dense, cat_ids, labels,
+                                          mask)
+        g_rest, gr_state = reduce_fn(g_stacked, gr_state)
+        grads = {**g_tab, **g_rest}
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state, gr_state,
+                loss)
+
+    def shard_batch_fn(dense, cat_ids, labels, mask):
+        return (
+            jax.device_put(dense, NamedSharding(mesh, P(batch_axis, None))),
+            jax.device_put(cat_ids, NamedSharding(mesh, P(batch_axis, None))),
+            jax.device_put(labels, NamedSharding(mesh, P(batch_axis))),
+            jax.device_put(mask, NamedSharding(mesh, P(batch_axis))),
+        )
+
+    return (train_step, sharded_params, opt, opt_state, shard_batch_fn,
+            gr_state0)

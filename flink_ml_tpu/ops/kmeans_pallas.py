@@ -289,13 +289,9 @@ def update_stats_sharded(points: jnp.ndarray, centroids: jnp.ndarray,
     partial (k, d)/(k,) results are summed with one ``psum`` over the
     ``data`` axis (the ICI allreduce replacing the reference's keyed network
     shuffle).  Per-shard row count must be a multiple of ``block_n``."""
-    import inspect
-
     from jax.sharding import PartitionSpec as P
 
-    shard_map = getattr(jax, "shard_map", None)
-    if shard_map is None:  # older JAX
-        from jax.experimental.shard_map import shard_map  # type: ignore
+    from ..parallel.collectives import shard_map_fn
 
     def shard_fn(pts, cents):
         sums, counts = kmeans_update_stats(
@@ -303,11 +299,8 @@ def update_stats_sharded(points: jnp.ndarray, centroids: jnp.ndarray,
             compute_dtype=compute_dtype, interpret=interpret)
         return (jax.lax.psum(sums, "data"), jax.lax.psum(counts, "data"))
 
-    kwargs = {}
-    if "check_vma" in inspect.signature(shard_map).parameters:
-        # pallas_call out_shapes carry no varying-mesh-axes annotation
-        kwargs["check_vma"] = False
-    return shard_map(shard_fn, mesh=mesh,
-                     in_specs=(P("data", None), P(None, None)),
-                     out_specs=(P(None, None), P(None)),
-                     **kwargs)(points, centroids)
+    # the shared shim turns the replication check off on every JAX version
+    # (pallas_call out_shapes carry no varying-mesh-axes annotation)
+    return shard_map_fn(shard_fn, mesh=mesh,
+                        in_specs=(P("data", None), P(None, None)),
+                        out_specs=(P(None, None), P(None)))(points, centroids)

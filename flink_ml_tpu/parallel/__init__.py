@@ -11,6 +11,8 @@ from .mesh import (  # noqa: F401
     use_mesh,
 )
 from . import collectives  # noqa: F401
+from . import grad_reduce  # noqa: F401
+from .grad_reduce import GradReduceConfig  # noqa: F401
 from .moe import (  # noqa: F401
     EXPERT_AXIS,
     MoEParams,

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .collectives import ppermute_ring, shard_map_fn
+from .collectives import axis_size, ppermute_ring, shard_map_fn
 
 __all__ = ["PIPE_AXIS", "pipeline_apply", "build_pipeline"]
 
@@ -51,7 +51,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     Returns the ``(n_micro, mb, ...)`` outputs of the LAST stage on every
     device (combined with a masked psum).
     """
-    n_stages = lax.axis_size(axis)
+    n_stages = axis_size(axis)
     idx = lax.axis_index(axis)
     n_micro = xs.shape[0]
     n_steps = n_micro + n_stages - 1

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from .collectives import shard_map_fn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["ring_attention", "attention_reference"]
@@ -119,6 +120,6 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "seq",
         return numer / denom.transpose(0, 2, 1)[..., None]
 
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    fn = shard_map_fn(local, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)
     return fn(q, k, v)

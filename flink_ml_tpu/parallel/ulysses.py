@@ -14,6 +14,7 @@ from typing import Optional
 import jax
 import numpy as np
 from jax import lax
+from .collectives import shard_map_fn
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .ring_attention import attention_reference
@@ -49,6 +50,6 @@ def ulysses_attention(q, k, v, *, mesh: Mesh, axis: str = "seq",
         return heads_to_seq(out)
 
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    fn = shard_map_fn(local, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)
     return fn(q, k, v)

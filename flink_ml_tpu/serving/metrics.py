@@ -47,11 +47,17 @@ class LatencyTracker:
     def quantile(self, q: float) -> float:
         """Latency quantile in SECONDS over the retained window (0.0 when
         nothing recorded yet)."""
+        return self.quantiles((q,))[0]
+
+    def quantiles(self, qs) -> List[float]:
+        """Several quantiles under ONE lock acquisition / ring copy (the
+        p50+p99 publish pair)."""
         with self._lock:
             n = min(self._count, self._buf.shape[0])
             if n == 0:
-                return 0.0
-            return float(np.quantile(self._buf[:n], q))
+                return [0.0 for _ in qs]
+            vals = np.quantile(self._buf[:n], list(qs))
+        return [float(v) for v in vals]
 
 
 class ServingMetrics:
@@ -76,6 +82,7 @@ class ServingMetrics:
         self._rate_lock = threading.Lock()
         self._rate_t: Optional[float] = None
         self._rate_value = 0.0
+        self._published_count = 0    # nothing recorded -> nothing to publish
 
     def on_shed(self, queue_depth: int) -> None:
         self.shed.inc()
@@ -97,8 +104,7 @@ class ServingMetrics:
             self.latency.record(lat)
         self._queue_depth.set(queue_depth)
         self._fill.set(round(rows / max(bucket, 1), 4))
-        self._p50.set(round(1e3 * self.latency.quantile(0.50), 3))
-        self._p99.set(round(1e3 * self.latency.quantile(0.99), 3))
+        self.publish()
         if generation is not None:
             self._generation.set(generation)
         with self._rate_lock:
@@ -111,6 +117,20 @@ class ServingMetrics:
                                     if self._rate_value else inst)
                 self._rate.set(round(self._rate_value, 2))
             self._rate_t = now
+
+    def publish(self) -> None:
+        """Refresh the p50/p99 gauges from the latency ring — ONE
+        np.quantile pass for both, and skipped entirely when no new
+        samples arrived since the last publish (an idle endpoint's metric
+        tick must not pay an O(window) sort under the ring lock every
+        time)."""
+        count = self.latency.count
+        if count == self._published_count:
+            return
+        p50, p99 = self.latency.quantiles((0.50, 0.99))
+        self._p50.set(round(1e3 * p50, 3))
+        self._p99.set(round(1e3 * p99, 3))
+        self._published_count = count
 
     def snapshot(self) -> Dict[str, object]:
         return self.group.snapshot()

@@ -44,7 +44,20 @@ def force_virtual_cpu(n_devices: int, *, verify: bool = True) -> None:
 
         clear_backends()
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n_devices)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        # Older JAX has no jax_num_cpu_devices config; XLA_FLAGS is read
+        # lazily at CPU-client creation, and the backend registry was just
+        # cleared above, so the env route reaches the next client.
+        import os
+        import re
+
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
     if verify and len(jax.devices()) < n_devices:
         raise RuntimeError(
             f"requested {n_devices} virtual CPU devices, "

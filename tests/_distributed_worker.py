@@ -342,8 +342,76 @@ def main() -> None:
         oracle_c = body(oracle_c, 0, (opts, omask)).feedback
     np.testing.assert_allclose(got, np.asarray(oracle_c), atol=1e-4)
 
+    # hybrid dcn x data mesh over the 2 REAL processes: hierarchical
+    # gradient reduction — exact reduce_scatter over each host's local
+    # 'data' axis, the (compressed) all-reduce over the cross-host 'dcn'
+    # axis, gather back — asserted against the single-program oracle
+    # (inputs are deterministic in pid, so every process computes it).
+    from jax.sharding import PartitionSpec as P
+
+    from flink_ml_tpu.parallel import grad_reduce as GR
+    from flink_ml_tpu.parallel.collectives import shard_map_fn
+    from flink_ml_tpu.parallel.grad_reduce import GradReduceConfig
+    from flink_ml_tpu.parallel.mesh import fetch_replicated, put_sharded
+
+    hmesh = dist.hybrid_mesh({"data": 2})     # (dcn=2 hosts, data=2 devs)
+    assert dict(hmesh.shape) == {"dcn": 2, "data": 2}
+    d_red, n_red = 32, 4
+    g_all = np.random.default_rng(900).normal(
+        size=(n_red, d_red)).astype(np.float32)
+    dev_spec = P(("dcn", "data"), None)
+    g_stack = put_sharded(g_all[pid * 2:(pid + 1) * 2], hmesh, dev_spec)
+
+    def run_reduce(cfg_gr):
+        state = GR.init_state(cfg_gr, {"g": np.zeros((d_red,), np.float32)},
+                              n_red)
+        state = jax.tree_util.tree_map(
+            lambda a: put_sharded(np.asarray(a)[pid * 2:(pid + 1) * 2],
+                                  hmesh, dev_spec), state)
+
+        def body(g, st):
+            red, new_st = GR.reduce_gradients(
+                {"g": g[0]}, GR.squeeze_state(st), cfg_gr)
+            return red["g"][None], GR.unsqueeze_state(new_st)
+
+        fn = shard_map_fn(body, hmesh, in_specs=(dev_spec, dev_spec),
+                          out_specs=(dev_spec, dev_spec))
+        red, _ = jax.jit(fn)(g_stack, state)
+        red = fetch_replicated(red)          # (n_red, d) — rows identical
+        np.testing.assert_array_equal(red, np.broadcast_to(red[:1],
+                                                           red.shape))
+        return red[0]
+
+    # exact hierarchical == plain global sum (up to f32 order)
+    np.testing.assert_allclose(
+        run_reduce(GradReduceConfig(mode="exact", axis="data",
+                                    dcn_axis="dcn")),
+        g_all.sum(0), atol=1e-5)
+
+    # topk hierarchical == the shard-domain EF oracle: each dcn member
+    # reduces its host's 2-device group exactly, then sends its per-shard
+    # top-k over the dcn hop
+    density = 0.25
+    shard_len = d_red // 2
+    k = max(1, int(shard_len * density))
+    expected = np.zeros((d_red,), np.float32)
+    for m in range(2):                        # dcn members
+        ici_sum = g_all[m * 2:(m + 1) * 2].sum(0)
+        for i in range(2):                    # data positions -> shards
+            sl = slice(i * shard_len, (i + 1) * shard_len)
+            acc = ici_sum[sl]
+            order = np.argsort(-np.abs(acc), kind="stable")[:k]
+            sent = np.zeros_like(acc)
+            sent[order] = acc[order]
+            expected[sl] += sent
+    np.testing.assert_allclose(
+        run_reduce(GradReduceConfig(mode="topk", density=density,
+                                    axis="data", dcn_axis="dcn")),
+        expected, atol=1e-5)
+
     out = {
         "pid": pid,
+        "grad_reduce_dcn_ok": True,
         "global_devices": info.global_device_count,
         "total": total,
         "final": float(np.asarray(jax.device_get(res.state))),

@@ -1,0 +1,83 @@
+"""Tier-1 wiring for scripts/check_bench_schema.py: the live repo must be
+drift-free, and the checker must actually CATCH the drift modes it exists
+for (a version bumped in bench.py but not BENCH_SCHEMA.md, and an emitted
+key the schema doc never documents)."""
+
+import importlib.util
+import os
+import re
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "check_bench_schema", os.path.join(_REPO, "scripts",
+                                       "check_bench_schema.py"))
+check = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check)
+
+
+def test_repo_bench_schema_is_drift_free():
+    assert check.check_versions() == []
+    assert check.main([]) == 0
+
+
+def test_version_bump_without_doc_update_is_caught(tmp_path, monkeypatch):
+    src = open(check.BENCH).read()
+    bumped = src.replace('"comm_metric_version": 1,',
+                         '"comm_metric_version": 2,')
+    assert bumped != src
+    fake = tmp_path / "bench.py"
+    fake.write_text(bumped)
+    monkeypatch.setattr(check, "BENCH", str(fake))
+    problems = check.check_versions()
+    assert any("comm_metric_version" in p and "bump both" in p
+               for p in problems)
+
+
+def test_new_version_key_without_doc_entry_is_caught(tmp_path, monkeypatch):
+    fake = tmp_path / "bench.py"
+    fake.write_text(open(check.BENCH).read()
+                    + '\nX = {"shiny_metric_version": 1}\n')
+    monkeypatch.setattr(check, "BENCH", str(fake))
+    problems = check.check_versions()
+    assert any("shiny_metric_version" in p for p in problems)
+
+
+def test_undocumented_emitted_key_is_caught(tmp_path):
+    line = ('{"metric": "logreg_epochs_per_sec", "value": 1.0, '
+            '"unit": "epochs/s", "vs_baseline": 1.0, '
+            '"totally_new_series": 7}')
+    path = tmp_path / "BENCH_new.json"
+    path.write_text(line + "\n")
+    documented = check.schema_documented_keys(open(check.SCHEMA).read())
+    problems = check.check_json(str(path), documented)
+    assert any("totally_new_series" in p for p in problems)
+    # documented + summary + *_error keys pass
+    ok = ('{"metric": "m", "value": 1, "rows_per_sec": 2, '
+          '"bench_gbt_error": "x", "notes": {}}')
+    path.write_text(ok + "\n")
+    assert check.check_json(str(path), documented) == []
+
+
+def test_metric_version_regexes_cover_both_assignment_forms():
+    found = check.bench_metric_versions(
+        'a = {"outofcore_metric_version": 4}\n'
+        'results["notes"]["kmeans_metric_version"] = 6\n')
+    assert found == {"outofcore_metric_version": 4,
+                     "kmeans_metric_version": 6}
+
+
+def test_all_bench_version_literals_reach_the_table():
+    """The regex harvest from the real bench.py must be non-trivial (it
+    would silently pass if the patterns rotted)."""
+    found = check.bench_metric_versions(open(check.BENCH).read())
+    assert {"metric_version", "outofcore_metric_version",
+            "kmeans_metric_version", "serving_metric_version",
+            "comm_metric_version"} <= set(found)
+    # and the doc table carries exactly the same names
+    doc = check.schema_metric_versions(open(check.SCHEMA).read())
+    assert set(doc) == set(found)
+
+
+def test_documented_key_extraction_handles_dotted_names():
+    keys = check.schema_documented_keys("see `notes.comm` and `a_b`")
+    assert {"notes.comm", "notes", "a_b"} <= keys

@@ -66,6 +66,13 @@ def test_two_process_distributed_tier(tmp_path):
             # coordination service couldn't bind/connect in this sandbox —
             # attempted, environment forbids it (the VERDICT skip rule)
             pytest.skip(f"jax.distributed unavailable in this env:\n{out}")
+        if (p.returncode != 0
+                and "aren't implemented on the CPU backend" in out):
+            # this jaxlib has no cross-process CPU collectives (they landed
+            # later than this environment's wheel) — attempted, environment
+            # forbids it (same skip rule as above)
+            pytest.skip("multiprocess CPU computations unsupported by this "
+                        f"jaxlib:\n{out[-500:]}")
         assert p.returncode == 0, f"worker failed:\n{out}"
 
     results = {}
@@ -76,6 +83,8 @@ def test_two_process_distributed_tier(tmp_path):
     # both hosts observed the same global computation
     for pid in (0, 1):
         assert results[pid]["global_devices"] == 4
+        # hierarchical dcn-axis gradient reduction matched its oracle
+        assert results[pid]["grad_reduce_dcn_ok"] is True
         assert results[pid]["total"] == float(sum(range(8)))
         # 3 epochs x sum(0..7)=28 -> 84; resumed to 5 epochs -> 140
         assert results[pid]["final"] == 84.0

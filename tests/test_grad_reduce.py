@@ -1,0 +1,592 @@
+"""Gradient-reduction subsystem tests (parallel/grad_reduce.py): every
+mode against a numpy single-program oracle on the 8-device CPU mesh, the
+EF residual recursion, the hierarchical ICI x DCN composition, and the
+bytes-on-wire accounting the bench comm leg reports."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from flink_ml_tpu.parallel import grad_reduce as GR
+from flink_ml_tpu.parallel.collectives import shard_map_fn
+from flink_ml_tpu.parallel.grad_reduce import GradReduceConfig
+from flink_ml_tpu.parallel.mesh import device_mesh
+
+
+def _run_reduce(grads_stack, config, axis_sizes, state=None):
+    """Apply reduce_gradients once over a mesh of ``axis_sizes``;
+    ``grads_stack`` leaves carry a leading participant dim covering every
+    reduction axis.  Returns (reduced, new_state, per_device_reduced)."""
+    mesh = device_mesh(axis_sizes)
+    n_dev = int(np.prod(list(axis_sizes.values())))
+    if state is None:
+        grads_like = jax.tree_util.tree_map(lambda a: a[0], grads_stack)
+        state = GR.init_state(config, grads_like, n_dev)
+    dev_spec = P(tuple(axis_sizes.keys()))
+
+    def body(g, st):
+        g_l = jax.tree_util.tree_map(lambda a: a[0], g)
+        red, new_st = GR.reduce_gradients(g_l, GR.squeeze_state(st), config)
+        return (jax.tree_util.tree_map(lambda a: a[None], red),
+                GR.unsqueeze_state(new_st))
+
+    fn = shard_map_fn(body, mesh, in_specs=(dev_spec, dev_spec),
+                      out_specs=(dev_spec, dev_spec))
+    red, new_state = jax.jit(fn)(grads_stack, state)
+    red = jax.tree_util.tree_map(np.asarray, red)
+    # the reduced gradient must come back replicated: every participant
+    # holds the identical sum
+    for leaf in jax.tree_util.tree_leaves(red):
+        np.testing.assert_array_equal(leaf, np.broadcast_to(leaf[:1],
+                                                            leaf.shape))
+    return (jax.tree_util.tree_map(lambda a: a[0], red), new_state, red)
+
+
+def _grads(n_dev=8, d=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w": jnp.asarray(rng.normal(size=(n_dev, d)).astype(np.float32)),
+            "b": jnp.asarray(rng.normal(size=(n_dev,)).astype(np.float32))}
+
+
+def _np_topk_contrib(acc, k):
+    """One participant's EF top-k contribution: (sent dense, unsent)."""
+    order = np.argsort(-np.abs(acc), kind="stable")[:k]
+    sent = np.zeros_like(acc)
+    sent[order] = acc[order]
+    return sent, acc - sent
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="mode"):
+        GradReduceConfig(mode="fp4")
+    with pytest.raises(ValueError, match="density"):
+        GradReduceConfig(mode="topk", density=0.0)
+    with pytest.raises(ValueError, match="block_size"):
+        GradReduceConfig(mode="int8", block_size=0)
+    with pytest.raises(ValueError, match="single ICI axis"):
+        GradReduceConfig(axis=("a", "b"), dcn_axis="dcn")
+    assert GR.reduction_axes(
+        GradReduceConfig(axis="data", dcn_axis="dcn")) == ("dcn", "data")
+    assert not GR.needs_state(GradReduceConfig())
+    assert GR.needs_state(GradReduceConfig(mode="topk"))
+
+
+def test_exact_matches_sum():
+    g = _grads()
+    red, state, _ = _run_reduce(g, GradReduceConfig(mode="exact"),
+                                {"data": 8})
+    assert state == {}
+    np.testing.assert_allclose(red["w"], np.asarray(g["w"]).sum(0),
+                               atol=1e-5)
+    np.testing.assert_allclose(red["b"], np.asarray(g["b"]).sum(),
+                               atol=1e-5)
+
+
+def test_topk_matches_ef_oracle_over_steps():
+    """Two reduction steps against a numpy EF-SGD oracle: step 1 sends each
+    participant's top-k, step 2's accumulated gradient includes step 1's
+    unsent residual."""
+    cfg = GradReduceConfig(mode="topk", density=0.125)  # k = 8 of 64
+    g1, g2 = _grads(seed=1), _grads(seed=2)
+    n_dev, d = 8, 64
+    k = GR._topk_k(d, cfg.density)
+
+    red1, state1, _ = _run_reduce(g1, cfg, {"data": 8})
+    res_np = np.zeros((n_dev, d), np.float32)
+    exp1 = np.zeros(d, np.float32)
+    for p in range(n_dev):
+        sent, res_np[p] = _np_topk_contrib(np.asarray(g1["w"])[p], k)
+        exp1 += sent
+    np.testing.assert_allclose(red1["w"], exp1, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(state1["ef"]["w"]), res_np,
+                               atol=1e-6)
+    # scalar leaf: k=1 means the bias is effectively exact every step
+    np.testing.assert_allclose(red1["b"], np.asarray(g1["b"]).sum(),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(state1["ef"]["b"]), 0.0,
+                               atol=1e-7)
+
+    red2, state2, _ = _run_reduce(g2, cfg, {"data": 8}, state=state1)
+    exp2 = np.zeros(d, np.float32)
+    for p in range(n_dev):
+        sent, res_np[p] = _np_topk_contrib(
+            np.asarray(g2["w"])[p] + res_np[p], k)
+        exp2 += sent
+    np.testing.assert_allclose(red2["w"], exp2, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(state2["ef"]["w"]), res_np,
+                               atol=1e-6)
+
+
+def test_topk_sent_plus_residual_is_lossless():
+    """EF bookkeeping invariant: per participant, sent + unsent == the
+    accumulated gradient exactly (nothing is dropped, only deferred)."""
+    cfg = GradReduceConfig(mode="topk", density=0.1)
+    g = _grads(seed=3)
+    _, state, per_dev = _run_reduce(g, cfg, {"data": 8})
+    # reconstruct each participant's sent part from the oracle and check
+    # acc == sent + residual
+    k = GR._topk_k(64, cfg.density)
+    for p in range(8):
+        acc = np.asarray(g["w"])[p]
+        sent, _ = _np_topk_contrib(acc, k)
+        np.testing.assert_allclose(
+            sent + np.asarray(state["ef"]["w"])[p], acc, atol=1e-6)
+
+
+def test_int8_bounded_error_and_determinism():
+    cfg = GradReduceConfig(mode="int8", block_size=16, seed=7)
+    g = _grads(seed=4)
+    red, state, _ = _run_reduce(g, cfg, {"data": 8})
+    exact = np.asarray(g["w"]).sum(0)
+    # per participant the stochastic round is off by < 1 quantum
+    # (scale = blockmax/127); the summed error is bounded by the sum of
+    # the participants' block scales
+    scales = (np.abs(np.asarray(g["w"]).reshape(8, -1, 16)).max(axis=2)
+              / 127.0)
+    bound = np.repeat(scales.sum(0), 16) * (1.0 + 1e-6)
+    assert np.all(np.abs(red["w"] - exact) <= bound)
+    # key advanced, and the same inputs + same state reproduce bit-identical
+    red_again, _, _ = _run_reduce(g, cfg, {"data": 8})
+    np.testing.assert_array_equal(red["w"], red_again["w"])
+    assert not np.array_equal(np.asarray(state["key"]),
+                              np.asarray(GR.init_state(cfg, None, 8)["key"]))
+
+
+def test_hierarchical_exact_matches_flat():
+    cfg = GradReduceConfig(mode="exact", axis="data", dcn_axis="dcn")
+    g = _grads(seed=5, d=60)  # 60 does not divide the 4-wide ICI axis: pad
+    red, _, _ = _run_reduce(g, cfg, {"dcn": 2, "data": 4})
+    np.testing.assert_allclose(red["w"], np.asarray(g["w"]).sum(0),
+                               atol=1e-5)
+
+
+def test_hierarchical_topk_matches_shard_oracle():
+    """Hierarchical EF top-k: the DCN hop compresses the ICI-summed shard;
+    the oracle reduces each dcn member's 4-device ICI group exactly, then
+    applies per-member top-k with shard-domain residuals."""
+    cfg = GradReduceConfig(mode="topk", density=0.25, axis="data",
+                           dcn_axis="dcn")
+    D, I, d = 2, 4, 64
+    shard_len = d // I
+    k = GR._topk_k(shard_len, cfg.density)
+    g1, g2 = _grads(n_dev=D * I, seed=6), _grads(n_dev=D * I, seed=7)
+
+    res = np.zeros((D, d), np.float32)  # per-dcn-member shard residuals
+
+    def oracle(g_np):
+        out = np.zeros(d, np.float32)
+        for m in range(D):
+            ici_sum = g_np[m * I:(m + 1) * I].sum(0)
+            for i in range(I):
+                sl = slice(i * shard_len, (i + 1) * shard_len)
+                acc = ici_sum[sl] + res[m, sl]
+                sent, unsent = _np_topk_contrib(acc, k)
+                out[sl] += sent
+                res[m, sl] = unsent
+        return out
+
+    red1, state1, _ = _run_reduce(g1, cfg, {"dcn": 2, "data": 4})
+    np.testing.assert_allclose(red1["w"], oracle(np.asarray(g1["w"])),
+                               atol=1e-5)
+    # the carried residual embeds each device's shard at its own slice
+    ef = np.asarray(state1["ef"]["w"]).reshape(D, I, d)
+    for m in range(D):
+        for i in range(I):
+            sl = slice(i * shard_len, (i + 1) * shard_len)
+            np.testing.assert_allclose(ef[m, i][sl], res[m, sl], atol=1e-6)
+            outside = np.delete(ef[m, i], np.r_[sl])
+            np.testing.assert_allclose(outside, 0.0, atol=1e-7)
+
+    red2, _, _ = _run_reduce(g2, cfg, {"dcn": 2, "data": 4}, state=state1)
+    np.testing.assert_allclose(red2["w"], oracle(np.asarray(g2["w"])),
+                               atol=1e-5)
+
+
+def test_hierarchical_int8_bounded_error():
+    cfg = GradReduceConfig(mode="int8", block_size=8, axis="data",
+                           dcn_axis="dcn")
+    g = _grads(seed=8)
+    red, _, _ = _run_reduce(g, cfg, {"dcn": 2, "data": 4})
+    exact = np.asarray(g["w"]).sum(0)
+    # only the 2-member DCN hop quantizes (the ICI reduce is exact), so
+    # the error is bounded by 2 quanta of the shard block scales; bound
+    # loosely by 2 * max|exact ici sum| / 127 per element
+    ici = np.asarray(g["w"]).reshape(2, 4, -1).sum(1)
+    bound = 2 * np.abs(ici).max() / 127.0 + 1e-6
+    assert np.abs(red["w"] - exact).max() <= bound
+
+
+def test_payload_bytes_accounting():
+    like = {"w": np.zeros((1 << 20,), np.float32),
+            "b": np.zeros((), np.float32)}
+    exact = GR.payload_bytes(like, GradReduceConfig())
+    assert exact["dense_bytes"] == exact["compressed_bytes"] == \
+        4 * ((1 << 20) + 1)
+    topk = GR.payload_bytes(like, GradReduceConfig(mode="topk", density=0.1))
+    # floor(k) makes 5x the LOWER bound at density 0.1 (idx + val = 8 B)
+    assert topk["compression_ratio"] >= 5.0
+    assert topk["compressed_bytes"] == 8 * ((1 << 20) // 10 + 1)
+    q = GR.payload_bytes(like, GradReduceConfig(mode="int8", block_size=256))
+    assert 3.5 <= q["compression_ratio"] <= 4.0
+    hier = GR.payload_bytes(
+        like, GradReduceConfig(mode="topk", density=0.1, dcn_axis="dcn"),
+        ici_size=4)
+    # the compressed hop is the 1/4-sized ICI shard; the exact ICI bytes
+    # ride separately
+    assert hier["dense_bytes"] == 4 * ((1 << 20) // 4 + 1)
+    assert hier["compression_ratio"] >= 5.0
+    assert hier["ici_bytes"] > 0
+
+
+# ------------------------------------------------------------- sgd adoption
+
+
+def _lr_problem(n=512, d=64, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ rng.normal(size=d) > 0).astype(np.float64)
+    return X, y
+
+
+def test_sgd_exact_mode_bit_identical():
+    """Acceptance: mode='exact' (and config=None) keep the pre-reducer
+    lax.psum path bit-for-bit — no behavior change unless opted in."""
+    from flink_ml_tpu.models.common.losses import LOSSES
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit
+
+    X, y = _lr_problem()
+    mesh = device_mesh({"data": 8})
+    kw = dict(learning_rate=0.5, max_epochs=20, tol=0, global_batch_size=64)
+    s0, log0 = sgd_fit(LOSSES["logistic"], X, y, None, SGDConfig(**kw), mesh)
+    s1, log1 = sgd_fit(LOSSES["logistic"], X, y, None,
+                       SGDConfig(**kw, grad_reduce=GradReduceConfig()), mesh)
+    np.testing.assert_array_equal(s0.coefficients, s1.coefficients)
+    assert s0.intercept == s1.intercept
+    np.testing.assert_array_equal(log0, log1)
+
+
+def test_sgd_topk_ef_density01_converges_to_dense():
+    """Acceptance: EF top-k at density 0.1 lands within 1e-3 of the dense
+    loss on a convex logistic problem over the 8-device mesh."""
+    from flink_ml_tpu.models.common.losses import LOSSES
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit
+
+    X, y = _lr_problem()
+    mesh = device_mesh({"data": 8})
+    kw = dict(learning_rate=0.2, max_epochs=200, tol=0,
+              global_batch_size=64)
+    _, log_dense = sgd_fit(LOSSES["logistic"], X, y, None, SGDConfig(**kw),
+                           mesh)
+    state, log_topk = sgd_fit(
+        LOSSES["logistic"], X, y, None,
+        SGDConfig(**kw, grad_reduce=GradReduceConfig(mode="topk",
+                                                     density=0.1)), mesh)
+    assert abs(log_dense[-1] - log_topk[-1]) < 1e-3, (
+        f"dense {log_dense[-1]} vs topk {log_topk[-1]}")
+    assert np.isfinite(state.coefficients).all()
+
+
+def test_sgd_int8_close_to_dense():
+    from flink_ml_tpu.models.common.losses import LOSSES
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit
+
+    X, y = _lr_problem()
+    mesh = device_mesh({"data": 8})
+    kw = dict(learning_rate=0.5, max_epochs=40, tol=0, global_batch_size=64)
+    _, log_dense = sgd_fit(LOSSES["logistic"], X, y, None, SGDConfig(**kw),
+                           mesh)
+    _, log_q = sgd_fit(
+        LOSSES["logistic"], X, y, None,
+        SGDConfig(**kw, grad_reduce=GradReduceConfig(mode="int8",
+                                                     block_size=32)), mesh)
+    assert abs(log_dense[-1] - log_q[-1]) < 1e-3
+
+
+def test_sgd_hierarchical_on_hybrid_mesh():
+    """The fused fit runs the two-tier reduce on a hybrid mesh: batch
+    sharded over dcn x data, compression only on the dcn hop."""
+    from flink_ml_tpu.models.common.losses import LOSSES
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit
+    from flink_ml_tpu.parallel import distributed as dist
+
+    X, y = _lr_problem()
+    hmesh = dist.hybrid_mesh({"data": 8})
+    kw = dict(learning_rate=0.5, max_epochs=40, tol=0, global_batch_size=64)
+    _, log_dense = sgd_fit(LOSSES["logistic"], X, y, None, SGDConfig(**kw))
+    state, log_h = sgd_fit(
+        LOSSES["logistic"], X, y, None,
+        SGDConfig(**kw, grad_reduce=GradReduceConfig(
+            mode="topk", density=0.1, axis="data", dcn_axis="dcn")), hmesh)
+    assert np.isfinite(state.coefficients).all()
+    assert log_h[-1] < log_h[0]
+    assert abs(log_dense[-1] - log_h[-1]) < 5e-2
+
+
+def test_sgd_params_matrix_weight_compressed():
+    """sgd_fit_params with a (d, C) weight (the softmax family's shape)
+    routes through the same compressed update."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_params
+
+    rng = np.random.default_rng(3)
+    n, d, C = 256, 16, 3
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    labels = rng.integers(0, C, size=n).astype(np.float64)
+
+    def softmax_loss(scores, yb, wb):
+        y = jax.nn.one_hot(yb.astype(jnp.int32), C)
+        logp = jax.nn.log_softmax(scores, axis=-1)
+        per_row = -jnp.sum(y * logp, axis=-1)
+        return jnp.sum(per_row * wb) / jnp.maximum(jnp.sum(wb), 1e-12)
+
+    mesh = device_mesh({"data": 8})
+    init = {"w": jnp.zeros((d, C), jnp.float32),
+            "b": jnp.zeros((C,), jnp.float32)}
+    kw = dict(learning_rate=0.5, max_epochs=30, tol=0, global_batch_size=64)
+    p_dense, log_dense = sgd_fit_params(
+        softmax_loss, X, labels, None, SGDConfig(**kw), mesh,
+        init_params=dict(init))
+    p_topk, log_topk = sgd_fit_params(
+        softmax_loss, X, labels, None,
+        SGDConfig(**kw, grad_reduce=GradReduceConfig(mode="topk",
+                                                     density=0.25)),
+        mesh, init_params=dict(init))
+    assert "_gr" not in p_topk
+    assert log_topk[-1] < log_topk[0]
+    assert abs(log_dense[-1] - log_topk[-1]) < 5e-2
+
+
+# -------------------------------------------------------- out-of-core + EF
+
+
+def _stream_cache(tmp_path, n_seg=3, d=8, seed=7):
+    from flink_ml_tpu.data.datacache import DataCacheWriter
+
+    rng = np.random.default_rng(seed)
+    true_w = rng.normal(size=(d,))
+    cache = str(tmp_path / "cache")
+    writer = DataCacheWriter(cache, segment_rows=512)
+    for _ in range(n_seg):
+        X = rng.normal(size=(512, d)).astype(np.float32)
+        writer.append({"features": X,
+                       "label": (X @ true_w > 0).astype(np.float32)})
+    writer.finish()
+    return cache
+
+
+class _FailAfter:
+    """Reader wrapper that dies after N read_batch calls across the run."""
+
+    counter = 0
+
+    def __init__(self, inner, fail_after):
+        self._inner = inner
+        self._fail_after = fail_after
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __iter__(self):
+        while True:
+            _FailAfter.counter += 1
+            if _FailAfter.counter > self._fail_after:
+                raise RuntimeError("injected mid-epoch failure")
+            b = self._inner.read_batch()
+            if b is None:
+                return
+            yield b
+
+
+def test_outofcore_ef_residual_checkpoint_roundtrip_exact(tmp_path):
+    """Acceptance: the EF residual rides the donated scan carry AND the
+    mid-epoch checkpoint — crash + resume reproduces the uninterrupted
+    compressed run bit-for-bit (impossible if the residual were dropped
+    or re-zeroed on restore)."""
+    from flink_ml_tpu.data.datacache import DataCacheReader
+    from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    cache = _stream_cache(tmp_path)
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=4, tol=0.0,
+                    grad_reduce=GradReduceConfig(mode="topk", density=0.1))
+
+    def reader():
+        return DataCacheReader(cache, batch_rows=256)
+
+    ref_state, ref_log = sgd_fit_outofcore(
+        logistic_loss, reader, num_features=8, config=cfg)
+    assert ref_state.planned_impl == "dense-stream-reduced"
+
+    ck = CheckpointConfig(str(tmp_path / "ck"), max_to_keep=3)
+    _FailAfter.counter = 0
+    with pytest.raises(RuntimeError, match="injected"):
+        sgd_fit_outofcore(
+            logistic_loss, lambda: _FailAfter(reader(), 15),
+            num_features=8, config=cfg, cache_decoded=False,
+            checkpoint=ck, checkpoint_every_steps=2)
+    resumed_state, resumed_log = sgd_fit_outofcore(
+        logistic_loss, reader, num_features=8, config=cfg,
+        checkpoint=ck, checkpoint_every_steps=2, resume=True)
+    np.testing.assert_array_equal(resumed_state.coefficients,
+                                  ref_state.coefficients)
+    assert resumed_state.intercept == ref_state.intercept
+    np.testing.assert_array_equal(resumed_log, ref_log)
+
+
+def test_outofcore_reduced_chunked_bit_exact_vs_w1(tmp_path):
+    """steps_per_dispatch W=1 vs W=8 stay bit-exact with the reducer state
+    in the carry (the masked dead steps must freeze the residual too)."""
+    from flink_ml_tpu.data.datacache import DataCacheReader
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    cache = _stream_cache(tmp_path)
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=2, tol=0.0,
+                    grad_reduce=GradReduceConfig(mode="topk", density=0.1))
+
+    def reader():
+        return DataCacheReader(cache, batch_rows=256)
+
+    s1, log1 = sgd_fit_outofcore(logistic_loss, reader, num_features=8,
+                                 config=cfg, steps_per_dispatch=1)
+    s8, log8 = sgd_fit_outofcore(logistic_loss, reader, num_features=8,
+                                 config=cfg, steps_per_dispatch=8)
+    np.testing.assert_array_equal(s1.coefficients, s8.coefficients)
+    np.testing.assert_array_equal(log1, log8)
+
+
+def test_outofcore_rejects_compressed_sparse_layouts(tmp_path):
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    cfg = SGDConfig(grad_reduce=GradReduceConfig(mode="topk"))
+    with pytest.raises(ValueError, match="sparse by construction"):
+        sgd_fit_outofcore(
+            logistic_loss, lambda: iter([]), num_features=8, config=cfg,
+            dense_key="fd", indices_key="fi")
+
+
+# ------------------------------------------------------- widedeep adoption
+
+
+def test_widedeep_sharded_compressed_matches_exact_at_density_1():
+    """density=1.0 sends every entry, so the compressed dp x tp step must
+    reproduce the implicit-GSPMD step allclose — a full-model oracle for
+    the manual data axis + auto model axis wiring."""
+    from flink_ml_tpu.models.recommendation.widedeep import (
+        build_sharded_train_step)
+
+    mesh = device_mesh({"data": 4, "model": 2})
+    vocab = [16, 12]
+    rng = np.random.default_rng(0)
+    B = 32
+    dense = rng.normal(size=(B, 3)).astype(np.float32)
+    cat = (np.stack([rng.integers(0, v, size=B) for v in vocab], 1)
+           + np.asarray([0, 16])).astype(np.int32)
+    labels = rng.integers(0, 2, size=B).astype(np.float32)
+    mask = np.ones(B, np.float32)
+
+    step_e, p_e, _, os_e, shard_e = build_sharded_train_step(
+        mesh, 3, vocab, 8, (16, 8))
+    batch = shard_e(dense, cat, labels, mask)
+    for _ in range(3):
+        p_e, os_e, loss_e = step_e(p_e, os_e, *batch)
+
+    step_c, p_c, _, os_c, shard_c, grs = build_sharded_train_step(
+        mesh, 3, vocab, 8, (16, 8),
+        grad_reduce=GradReduceConfig(mode="topk", density=1.0))
+    batch_c = shard_c(dense, cat, labels, mask)
+    for _ in range(3):
+        p_c, os_c, grs, loss_c = step_c(p_c, os_c, grs, *batch_c)
+    np.testing.assert_allclose(float(loss_e), float(loss_c), rtol=1e-5,
+                               atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(p_e)),
+                    jax.tree_util.tree_leaves(jax.device_get(p_c))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_widedeep_sharded_topk_trains():
+    from flink_ml_tpu.models.recommendation.widedeep import (
+        build_sharded_train_step)
+
+    mesh = device_mesh({"data": 4, "model": 2})
+    vocab = [16, 12]
+    rng = np.random.default_rng(1)
+    B = 32
+    dense = rng.normal(size=(B, 3)).astype(np.float32)
+    cat = (np.stack([rng.integers(0, v, size=B) for v in vocab], 1)
+           + np.asarray([0, 16])).astype(np.int32)
+    labels = rng.integers(0, 2, size=B).astype(np.float32)
+    mask = np.ones(B, np.float32)
+
+    step, p, _, os_, shard, grs = build_sharded_train_step(
+        mesh, 3, vocab, 8, (16, 8),
+        grad_reduce=GradReduceConfig(mode="topk", density=0.1))
+    batch = shard(dense, cat, labels, mask)
+    losses = []
+    for _ in range(10):
+        p, os_, grs, loss = step(p, os_, grs, *batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # the EF residual is live: after a compressed step some mass is
+    # carried instead of applied
+    assert any(float(np.abs(np.asarray(leaf)).max()) > 0
+               for leaf in jax.tree_util.tree_leaves(
+                   jax.device_get(grs)["ef"]))
+
+
+# ---------------------------------------------------------- hosted iterate
+
+
+def test_hosted_iterate_carries_reducer_state(tmp_path):
+    """A hosted-iterate body using reduce_gradients keeps its reducer
+    state in the iterate state pytree: per-epoch checkpoints round-trip
+    the residual, so crash + resume equals the uninterrupted run exactly."""
+    from flink_ml_tpu.iteration import (
+        IterationBodyResult,
+        IterationConfig,
+        iterate,
+    )
+    from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+
+    mesh = device_mesh({"data": 8})
+    cfg = GradReduceConfig(mode="topk", density=0.25)
+    d = 32
+    rng = np.random.default_rng(5)
+    data = jnp.asarray(rng.normal(size=(8, d)).astype(np.float32))
+    target = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
+    dev_spec = P("data")
+
+    def reduce_fn(w, st, x):
+        def body(w, st, x):
+            g = {"w": x[0] * (w - target)}
+            red, new_st = GR.reduce_gradients(g, GR.squeeze_state(st), cfg)
+            return red["w"], GR.unsqueeze_state(new_st)
+
+        return shard_map_fn(body, mesh,
+                            in_specs=(P(), dev_spec, P("data", None)),
+                            out_specs=(P(), dev_spec))(w, st, x)
+
+    def epoch_body(state, epoch, x):
+        w, st = state["w"], state["gr"]
+        g, st = reduce_fn(w, st, x)
+        return IterationBodyResult({"w": w - 0.05 * g, "gr": st})
+
+    init = {"w": jnp.zeros((d,), jnp.float32),
+            "gr": GR.init_state(cfg, {"w": jnp.zeros((d,))}, 8)}
+    ck = str(tmp_path / "ck")
+    full = iterate(epoch_body, init, data, max_epochs=8,
+                   config=IterationConfig(mode="hosted"),
+                   checkpoint=CheckpointConfig(ck))
+    # resume from the epoch-5 cut and run to 8: must equal the full run
+    resumed = iterate(epoch_body, init, data, max_epochs=8,
+                      config=IterationConfig(mode="hosted"),
+                      checkpoint=CheckpointConfig(ck), resume=True)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(full.state["w"])),
+        np.asarray(jax.device_get(resumed.state["w"])))

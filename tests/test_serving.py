@@ -494,3 +494,32 @@ def test_serving_concurrency_sweep():
         assert endpoint.metrics.shed.value == 0
     finally:
         endpoint.close()
+
+
+def test_metrics_publish_skips_quantiles_when_no_new_samples():
+    """The p50/p99 recompute is an O(window) np.quantile pass under the
+    ring lock — a metric tick with no new samples must skip it, and the
+    pair must come from ONE quantiles() call, not two ring passes."""
+    from flink_ml_tpu.serving.metrics import LatencyTracker, ServingMetrics
+
+    m = ServingMetrics()
+    calls = []
+    real = LatencyTracker.quantiles
+    m.latency.quantiles = lambda qs: (calls.append(tuple(qs)) or
+                                      real(m.latency, qs))
+
+    m.publish()                       # nothing recorded yet: no pass
+    assert calls == []
+    m.latency.record(0.010)
+    m.publish()
+    assert calls == [(0.50, 0.99)]    # one pass for both quantiles
+    snap = m.snapshot()
+    assert snap["latency_p50_ms"] == pytest.approx(10.0, abs=0.1)
+
+    m.publish()                       # no new samples: skipped
+    m.publish()
+    assert len(calls) == 1
+
+    m.latency.record(0.030)
+    m.publish()                       # new sample: recomputed
+    assert len(calls) == 2
