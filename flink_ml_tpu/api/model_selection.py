@@ -236,6 +236,14 @@ class _SelectorBase(HasSeed, Estimator["CrossValidatorModel"]):
             for train, val in splits:
                 candidate = _clone_with(self._estimator, param_map)
                 model = candidate.fit(train)
+                # Pipeline candidates score through the fused chain
+                # (api/chain.py): every fold's model has the same stage
+                # types / column names / shapes, so the plan-static
+                # segment jit compiles ONCE for the whole grid x fold
+                # sweep — fold params ride as runtime device args.
+                # (tests/test_model_selection.py asserts zero new XLA
+                # lowerings after the first fold and fold metrics
+                # identical to the stagewise path.)
                 (pred,) = model.transform(val)
                 scores.append(_score(self._evaluator, pred, metric))
             avg_metrics.append(float(np.mean(scores)))

@@ -10,12 +10,31 @@ a ``PipelineModel`` chaining ``transform`` across all resulting stages
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import List, Optional, Sequence
+
+import numpy as np
 
 from ..utils import persist
 from .stage import AlgoOperator, Estimator, Model, Stage
 
 __all__ = ["Pipeline", "PipelineModel"]
+
+
+def _stagewise(stages, tables: List) -> List:
+    """The classic per-stage path.  A multi-output stage (RandomSplitter)
+    fans the flow out; single-input stages then map over every table
+    independently — the columnar-batch extension of
+    ``PipelineModel.java:58-64`` (previously a >1-table flow had no
+    defined semantics here)."""
+    for stage in stages:
+        if len(tables) == 1:
+            tables = list(stage.transform(*tables))
+        else:
+            fanned: List = []
+            for t in tables:
+                fanned.extend(stage.transform(t))
+            tables = fanned
+    return tables
 
 
 class Pipeline(Estimator["PipelineModel"]):
@@ -72,11 +91,60 @@ class PipelineModel(Model):
 
     def transform(self, *inputs) -> List:
         """Sequentially feed outputs of stage i into stage i+1
-        (``PipelineModel.java:58-64``)."""
+        (``PipelineModel.java:58-64``).
+
+        When every stage in a run is chainable (``api/chain.py`` kernel
+        protocol), the run executes as ONE fused jitted program instead
+        of per-stage dispatch+transfer — bit-exact with the stagewise
+        path, auto-selected, cached per input schema (and per row bucket
+        through the shared segment jit)."""
         tables = list(inputs)
-        for stage in self._stages:
-            tables = list(stage.transform(*tables))
-        return tables
+        plan = self._chain_plan(tables)
+        if plan is not None:
+            return plan.transform(*tables)
+        return _stagewise(self._stages, tables)
+
+    def _chain_plan(self, tables) -> Optional[object]:
+        """The cached fused plan for this input schema, or None when the
+        chain is disabled, no segment merges >= 2 stages, or plan build
+        fails (every fallback is the stagewise path).
+
+        The cache key includes every stage's live param values, so a
+        post-build ``set_threshold(...)`` / ``set_prediction_col(...)``
+        builds a fresh plan instead of serving the stale kernels the old
+        values were baked into.  (Mutating fitted MODEL DATA in place via
+        ``set_model_data`` after a transform is not fingerprinted —
+        reload or rebuild the PipelineModel for that.)"""
+        from ..data.table import Table
+        from . import chain
+
+        if not chain._enabled() or not self._stages or not tables:
+            return None
+        if not all(isinstance(t, Table) for t in tables):
+            return None
+        keys = {chain.raw_schema(t) for t in tables}
+        if len(keys) != 1:
+            return None          # mixed-schema flows stay stagewise
+        params_key = tuple(
+            tuple(sorted((p.name, repr(v))
+                         for p, v in s._ensure_param_map().items()))
+            if hasattr(s, "_ensure_param_map") else id(s)
+            for s in self._stages)
+        (schema_key,) = keys
+        key = (schema_key, params_key)
+        cache = self.__dict__.setdefault("_chain_plans", {})
+        if key in cache:
+            return cache[key]
+        if len(cache) > 32:      # param-churn guard: plans are rebuildable
+            cache.clear()
+        example = tables[0].take(min(tables[0].num_rows, 8))
+        try:
+            plan = chain.compile_pipeline(self, example)
+            plan = plan if plan.worthwhile else None
+        except Exception:        # unported config/schema: stagewise
+            plan = None
+        cache[key] = plan
+        return plan
 
     def save(self, path: str) -> None:
         persist.save_pipeline(self, self._stages, path)

@@ -157,6 +157,17 @@ def _predict(measure: DistanceMeasure, pts, centroids):
     return jnp.argmin(measure.pairwise(pts, centroids), axis=1)
 
 
+def _kmeans_chain_kernel(static, params, cols):
+    """Chain-fused nearest-centroid assign (same expression as
+    ``_predict``; the measure singleton rides the plan-static tuple)."""
+    from ...api.chain import as_matrix
+
+    (fcol, acol, measure) = static
+    pts = as_matrix(cols[fcol])
+    dists = measure.pairwise(pts.astype(jnp.float32), params["centroids"])
+    return {acol: jnp.argmin(dists, axis=1)}
+
+
 def select_random_centroids(points: np.ndarray, k: int, seed: int) -> np.ndarray:
     """Semantics of ``KMeans.selectRandomCentroids`` (``KMeans.java:317-336``):
     shuffle all points with the seed, take k."""
@@ -543,6 +554,30 @@ class KMeansModel(KMeansModelParams, Model):
             raise RuntimeError(
                 "KMeansModel has no model data; fit a KMeans or call "
                 "set_model_data first")
+
+    def transform_kernel(self, schema):
+        """Chain TERMINAL: the in-segment assign is expression-identical
+        to ``_predict`` (pairwise + per-row argmin — pad rows inert), the
+        host ``post`` applies the same int64 cast; bit-exact with the
+        stagewise transform."""
+        from ...api.chain import StageKernel, numeric_entry
+
+        self._require_model()
+        fcol = self.get_features_col()
+        if numeric_entry(schema, fcol) is None:
+            return None
+        measure = DistanceMeasure.get_instance(self.get_distance_measure())
+        pred_col = self.get_prediction_col()
+        assign_col = f"__chain_assign__{pred_col}"
+
+        def post(host):
+            return {pred_col: host[assign_col].astype(np.int64)}
+
+        return StageKernel(
+            fn=_kmeans_chain_kernel,
+            static=(fcol, assign_col, measure),
+            params={"centroids": np.asarray(self._centroids, np.float32)},
+            consumes=(fcol,), produces=(assign_col,), post=post)
 
     # -- inference ----------------------------------------------------------
     def transform(self, *inputs) -> List[Table]:

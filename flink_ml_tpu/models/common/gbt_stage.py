@@ -64,7 +64,14 @@ class GBTParams(GBTModelParams, HasLabelCol, HasMaxIter, HasLearningRate):
 
 
 class GBTModelBase(GBTModelParams, Model):
-    """Holds the Forest arrays; subclasses map margins to predictions."""
+    """Holds the Forest arrays; subclasses map margins to predictions.
+
+    Deliberately NOT chainable (no ``transform_kernel``): the shared
+    predict entry points (``predict_forest[_softmax]``) accumulate tree
+    margins in float64 on HOST across per-tree dispatches — an in-segment
+    f32 accumulation could not stay bit-exact with them, so in a fused
+    pipeline GBT breaks the chain and scores through its existing
+    (bucket-padded, retrace-free) entry points.  ``api/chain.py``."""
 
     def __init__(self):
         super().__init__()

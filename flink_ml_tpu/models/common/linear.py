@@ -56,10 +56,43 @@ def check_sparse_indices(idx: np.ndarray, num_features: int) -> None:
             "the hasher and the model disagree on the hash-space size")
 
 
+def _stable_margins(X, w, b):
+    """``X @ w + b`` with a context-stable contraction for vector ``w``.
+
+    An ``(n, d) @ (d,)`` matvec (and a k=1 GEMM) lowers to a LOOP FUSION
+    whose accumulation order depends on whether the lhs is a program
+    parameter or a fused producer — so the same values score to
+    different last-ulp margins standalone vs inside a fused chain
+    segment (``api/chain.py``).  A k>=2 GEMM materializes its operands
+    and accumulates identically in every context (verified across
+    d 8..512 / n 8..1024), so the binary case pads ``w`` with one zero
+    column and takes column 0: bit-identical margins whether the
+    features are a parameter (stagewise/serving) or produced mid-segment
+    (fused chain).  Matrix ``w`` (multiclass) is already a k>=2 GEMM."""
+    if w.ndim == 1:
+        w2 = jnp.stack([w, jnp.zeros_like(w)], axis=-1)
+        return (X @ w2)[:, 0] + b
+    return X @ w + b
+
+
 @jax.jit
 def _jit_margins(X, w, b):
     """Module-level jit: repeated transform() calls are cache hits."""
-    return X @ w + b
+    return _stable_margins(X, w, b)
+
+
+def _linear_chain_kernel(static, params, cols):
+    """Chain-terminal margins — expression-identical to ``_jit_margins``
+    (the shared predict entry point), staged under a private column the
+    host ``post`` maps to prediction/raw columns."""
+    import jax.numpy as jnp
+
+    from ...api.chain import as_matrix
+
+    (fcol, mcol) = static
+    X = as_matrix(cols[fcol])
+    return {mcol: _stable_margins(X.astype(jnp.float32),
+                                  params["w"], params["b"])}
 
 
 @jax.jit
@@ -205,6 +238,37 @@ class LinearModelBase(LinearModelParams, Model):
 
     def _raw(self, margins: np.ndarray) -> np.ndarray:
         return margins
+
+    def transform_kernel(self, schema):
+        """Chain TERMINAL for dense features: the in-segment kernel is
+        expression-identical to the shared ``_margins`` predict entry
+        point (one f32 matmul at the same padded bucket), and the host
+        ``post`` applies the same f64 ``_decision``/``_raw`` mapping —
+        fused output is bit-exact with stagewise ``transform``.  Sparse
+        pair/mixed feature conventions stay on their own entry points
+        (the chain substrate is dense column dicts)."""
+        from ...api.chain import StageKernel, numeric_entry
+
+        self._require_model()
+        fcol = self.get_features_col()
+        if numeric_entry(schema, fcol) is None:
+            return None
+        pred_col = self.get_prediction_col()
+        raw_col = self.get_raw_prediction_col()
+        margin_col = f"__chain_margins__{pred_col}"
+
+        def post(host):
+            m = host[margin_col].astype(np.float64)
+            out = {pred_col: self._decision(m)}
+            if raw_col:
+                out[raw_col] = self._raw(m)
+            return out
+
+        return StageKernel(
+            fn=_linear_chain_kernel, static=(fcol, margin_col),
+            params={"w": np.asarray(self._state.coefficients, np.float32),
+                    "b": np.float32(self._state.intercept)},
+            consumes=(fcol,), produces=(margin_col,), post=post)
 
     def transform(self, *inputs) -> List[Table]:
         (table,) = inputs

@@ -4,10 +4,13 @@ Wide&Deep (string -> index -> one-hot / stacked cat ids)."""
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Dict, List, Optional
 
+import jax.numpy as jnp
 import numpy as np
 
+from ...api.chain import StageKernel, numeric_entry
 from ...api.stage import Estimator, Model, Transformer
 from ...data.table import Table
 from ...params.param import BoolParam, StringParam
@@ -85,6 +88,45 @@ class StringIndexerModel(_ColsParams, Model):
             out = out.with_column(oc, ids)
         return [out]
 
+    def transform_kernel(self, schema):
+        """Chain kernel for NUMERIC vocabularies (the post-discretization
+        re-indexing case): the sorted-vocab searchsorted lookup runs
+        in-device with the fitted-order id mapping precomputed at chain
+        build.  String/object domains and the ``error`` policy stay
+        stagewise (string columns cannot live on device; the raise is
+        host control flow).  f64 columns decline (``exact_compare``):
+        the lookup is a vocabulary-equality decision, and segment-entry
+        rounding could land an unseen f64 value exactly on a vocab entry
+        the host-f64 compare rejects."""
+        if self.get(StringIndexerModel.HANDLE_INVALID) != "keep":
+            return None
+        in_cols, out_cols = _check_cols(self)
+        vals_list, fid_list, exact_list, unseen = [], [], [], []
+        for ic in in_cols:
+            entry = numeric_entry(schema, ic, exact_compare=True)
+            if entry is None or entry[0]:
+                return None          # non-numeric/f64 or non-scalar column
+            vocab = np.asarray(self._vocab[ic])
+            if vocab.dtype.kind not in "fiub":
+                return None          # string-domain vocabulary
+            vocab = vocab.astype(np.float64)
+            order = np.argsort(vocab, kind="stable")
+            sorted_vals = vocab[order]
+            v32 = sorted_vals.astype(np.float32)
+            if len(v32) > 1 and np.any(np.diff(v32) <= 0):
+                return None          # f32 collision: ambiguous lookup
+            vals_list.append(v32)
+            fid_list.append(order.astype(np.int32))
+            exact_list.append(
+                (v32.astype(np.float64) == sorted_vals).astype(np.float32))
+            unseen.append(np.int32(len(vocab)))
+        return StageKernel(
+            fn=_string_indexer_kernel,
+            static=(tuple(zip(in_cols, out_cols)),),
+            params={"vals": vals_list, "fid": fid_list,
+                    "exact": exact_list, "unseen": unseen},
+            consumes=tuple(in_cols), produces=tuple(out_cols))
+
     def save(self, path: str) -> None:
         persist.save_metadata(self, path)
         persist.save_model_arrays(
@@ -96,6 +138,20 @@ class StringIndexerModel(_ColsParams, Model):
         data = persist.load_model_arrays(path, "model")
         model._vocab = {k: list(v) for k, v in data.items()}
         return model
+
+
+def _string_indexer_kernel(static, params, cols):
+    (pairs,) = static
+    out = {}
+    for i, (ic, oc) in enumerate(pairs):
+        x = cols[ic].astype(jnp.float32)
+        vals, fid = params["vals"][i], params["fid"][i]
+        pos = jnp.sum(x[:, None] >= vals[None, :], axis=-1) - 1
+        pos_c = jnp.clip(pos, 0, vals.shape[0] - 1)
+        hit = (vals[pos_c] == x) & (params["exact"][i][pos_c] > 0)
+        out[oc] = jnp.where(hit, fid[pos_c], params["unseen"][i]
+                            ).astype(jnp.int32)
+    return out
 
 
 class StringIndexer(_ColsParams, Estimator[StringIndexerModel]):
@@ -180,6 +236,29 @@ class OneHotEncoderModel(OneHotEncoderParams, Model):
             out = out.with_column(oc, hot)
         return [out]
 
+    def transform_kernel(self, schema):
+        """Chainable under ``handleInvalid="keep"``: too-LARGE ids one-hot
+        to all-zero rows in-device, exactly the stagewise keep semantics.
+        Negative ids raise on host even under keep, so a ``pre`` hook
+        carries that check into the segment (the ``error`` policy's
+        any-out-of-range raise stays host control flow — non-chainable)."""
+        if self.get(OneHotEncoderParams.HANDLE_INVALID) != "keep":
+            return None
+        in_cols, out_cols = _check_cols(self)
+        drop = self.get(OneHotEncoderParams.DROP_LAST)
+        specs = []
+        for ic, oc in zip(in_cols, out_cols):
+            entry = schema.get(ic)
+            if entry is None or entry[1].kind not in "iub" or entry[0]:
+                return None          # ids must be scalar integer columns
+            size = self._sizes[ic]
+            specs.append((ic, oc, size - 1 if drop else size))
+        sizes = tuple((ic, self._sizes[ic]) for ic in in_cols)
+        return StageKernel(
+            fn=_onehot_kernel, static=(tuple(specs),), params={},
+            consumes=tuple(in_cols), produces=tuple(out_cols),
+            pre=partial(_onehot_pre, sizes), pre_cols=tuple(in_cols))
+
     def save(self, path: str) -> None:
         persist.save_metadata(self, path, {"sizes": self._sizes})
 
@@ -189,6 +268,35 @@ class OneHotEncoderModel(OneHotEncoderParams, Model):
         meta = persist.load_metadata(path)
         model._sizes = {k: int(v) for k, v in meta["sizes"].items()}
         return model
+
+
+def _onehot_pre(col_sizes, host):
+    """Host entry validation: the stagewise keep path still raises on a
+    NEGATIVE id (only too-large ids zero out) — the fused path must too,
+    not silently emit a zero row."""
+    for ic, size in col_sizes:
+        ids = host[ic]
+        if ids.size and int(ids.min()) < 0:
+            raise ValueError(f"id out of range [0, {size}) in {ic!r}")
+
+
+def _onehot_kernel(static, params, cols):
+    (specs,) = static
+    out = {}
+    for ic, oc, width in specs:
+        ids = cols[ic]
+        out[oc] = (ids[:, None] == jnp.arange(width)[None, :]
+                   ).astype(jnp.float32)
+    return out
+
+
+def _assembler_kernel(static, params, cols):
+    in_cols, ocol = static
+    parts = []
+    for name in in_cols:
+        arr = cols[name].astype(jnp.float32)
+        parts.append(arr[:, None] if arr.ndim == 1 else arr)
+    return {ocol: jnp.concatenate(parts, axis=1)}
 
 
 class OneHotEncoder(OneHotEncoderParams, Estimator[OneHotEncoderModel]):
@@ -222,3 +330,19 @@ class VectorAssembler(_ColsParams, HasFeaturesCol, Transformer):
             parts.append(arr[:, None] if arr.ndim == 1 else arr)
         stacked = np.concatenate(parts, axis=1)
         return [table.with_column(self.get_features_col(), stacked)]
+
+    def transform_kernel(self, schema):
+        """Chain kernel: concatenation is value-exact at f32 for every
+        f32-exact input, so the fused path matches stagewise bit-exactly."""
+        in_cols = self.get_input_cols()
+        if not in_cols:
+            return None      # stagewise raises the diagnostic error
+        for name in in_cols:
+            if numeric_entry(schema, name) is None:
+                return None
+        return StageKernel(
+            fn=_assembler_kernel,
+            static=(tuple(in_cols), self.get_features_col()),
+            params={},
+            consumes=tuple(in_cols),
+            produces=(self.get_features_col(),))

@@ -65,6 +65,17 @@ def _project(X, mean, components):
     return (X - mean[None, :]) @ components.T
 
 
+def _pca_chain_kernel(static, params, cols):
+    """Chain-fused projection — the same expression as ``_project`` (one
+    centered matmul; per-row dot products are unaffected by the segment's
+    row padding, so fused output is bit-exact with the stagewise call)."""
+    from ...api.chain import as_matrix
+
+    (fcol, ocol) = static
+    X = as_matrix(cols[fcol])
+    return {ocol: (X - params["mean"][None, :]) @ params["components"].T}
+
+
 class PCAModel(PCAParams, Model):
     """Holds (mean, components (k, d), explained variance [ratio])."""
 
@@ -104,6 +115,20 @@ class PCAModel(PCAParams, Model):
         if self._components is None:
             raise RuntimeError("PCAModel has no model data; fit a PCA or "
                                "call set_model_data first")
+
+    def transform_kernel(self, schema):
+        from ...api.chain import StageKernel, numeric_entry
+
+        self._require_model()
+        fcol = self.get_features_col()
+        if numeric_entry(schema, fcol) is None:
+            return None
+        return StageKernel(
+            fn=_pca_chain_kernel,
+            static=(fcol, self.get_output_col()),
+            params={"mean": np.asarray(self._mean, np.float32),
+                    "components": np.asarray(self._components, np.float32)},
+            consumes=(fcol,), produces=(self.get_output_col(),))
 
     def transform(self, *inputs) -> List[Table]:
         (table,) = inputs

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...api.chain import StageKernel, as_matrix as _as_matrix, numeric_entry
 from ...api.stage import Estimator, Model
 from ...data.table import Table
 from ...linalg import stack_vectors
@@ -30,6 +31,47 @@ class _HasOutputCol(HasFeaturesCol, HasOutputCol):
     """features-in / output-out mixin for the scalers."""
 
 
+def _numeric_feature(schema, col: str) -> bool:
+    """Chainable only when the features column is a plain numeric array
+    (object/string columns — DenseVector lists etc. — stay stagewise)."""
+    return numeric_entry(schema, col) is not None
+
+
+def _affine_kernel(static, params, cols):
+    (fcol, ocol) = static
+    X = _as_matrix(cols[fcol])
+    return {ocol: (X - params["shift"]) * params["scale"]}
+
+
+def _div_affine_kernel(static, params, cols):
+    """Division-form affine: mirrors the stagewise ``(X - lo) / span``
+    expression ORDER so range boundaries stay exact (x/x == 1.0; a
+    reciprocal-multiply would round)."""
+    (fcol, ocol) = static
+    X = _as_matrix(cols[fcol])
+    return {ocol: (X - params["shift"]) / params["div"] * params["mul"]
+            + params["add"]}
+
+
+class _ScalerChainMixin:
+    """Shared ``transform_kernel`` plumbing: subclasses provide
+    ``_kernel_fn`` + ``_kernel_params`` (f32 arrays precomputed from the
+    fitted state — the WITH_* flags fold into the params, so one shared
+    fn serves every configuration and CrossValidator folds share its
+    compile)."""
+
+    _kernel_fn = staticmethod(_affine_kernel)
+
+    def transform_kernel(self, schema):
+        fcol, ocol = self.get_features_col(), self.get_output_col()
+        if not _numeric_feature(schema, fcol):
+            return None
+        return StageKernel(
+            fn=self._kernel_fn, static=(fcol, ocol),
+            params=self._kernel_params(),
+            consumes=(fcol,), produces=(ocol,))
+
+
 class StandardScalerParams(_HasOutputCol):
     WITH_MEAN = BoolParam("withMean", "Center to zero mean.", default=True)
     WITH_STD = BoolParam("withStd", "Scale to unit variance.", default=True)
@@ -40,11 +82,21 @@ def _standardize(X, mean, scale):
     return (X - mean) * scale
 
 
-class StandardScalerModel(StandardScalerParams, Model):
+class StandardScalerModel(StandardScalerParams, _ScalerChainMixin, Model):
     def __init__(self):
         super().__init__()
         self._mean: Optional[np.ndarray] = None
         self._std: Optional[np.ndarray] = None
+
+    def _kernel_params(self):
+        # identical precompute to transform(): f64 statistics, cast f32
+        mean = (self._mean if self.get(StandardScalerParams.WITH_MEAN)
+                else np.zeros_like(self._mean))
+        scale = (1.0 / np.maximum(self._std, 1e-12)
+                 if self.get(StandardScalerParams.WITH_STD)
+                 else np.ones_like(self._std))
+        return {"shift": np.asarray(mean, np.float32),
+                "scale": np.asarray(scale, np.float32)}
 
     def set_model_data(self, *inputs) -> "StandardScalerModel":
         (t,) = inputs
@@ -97,11 +149,23 @@ class MinMaxScalerParams(_HasOutputCol):
     MAX = FloatParam("max", "Upper bound of the output range.", default=1.0)
 
 
-class MinMaxScalerModel(MinMaxScalerParams, Model):
+class MinMaxScalerModel(MinMaxScalerParams, _ScalerChainMixin, Model):
+    _kernel_fn = staticmethod(_div_affine_kernel)
+
     def __init__(self):
         super().__init__()
         self._data_min: Optional[np.ndarray] = None
         self._data_max: Optional[np.ndarray] = None
+
+    def _kernel_params(self):
+        lo = self.get(MinMaxScalerParams.MIN)
+        hi = self.get(MinMaxScalerParams.MAX)
+        if hi <= lo:
+            raise ValueError(f"min {lo} must be < max {hi}")
+        span = np.maximum(self._data_max - self._data_min, 1e-12)
+        return {"shift": np.asarray(self._data_min, np.float32),
+                "div": np.asarray(span, np.float32),
+                "mul": np.float32(hi - lo), "add": np.float32(lo)}
 
     def set_model_data(self, *inputs) -> "MinMaxScalerModel":
         (t,) = inputs
@@ -115,12 +179,20 @@ class MinMaxScalerModel(MinMaxScalerParams, Model):
 
     def transform(self, *inputs) -> List[Table]:
         (table,) = inputs
-        lo, hi = self.get(MinMaxScalerParams.MIN), self.get(MinMaxScalerParams.MAX)
-        if hi <= lo:
-            raise ValueError(f"min {lo} must be < max {hi}")
-        X = stack_vectors(table[self.get_features_col()])
-        span = np.maximum(self._data_max - self._data_min, 1e-12)
-        out = (X - self._data_min) / span * (hi - lo) + lo
+        from ...api.chain import apply_kernel_or_none
+
+        kernel = self.transform_kernel(table.schema())
+        fetched = apply_kernel_or_none(kernel, table)
+        if fetched is None:     # object dtype / f32-unsafe ints: host path
+            lo = self.get(MinMaxScalerParams.MIN)
+            hi = self.get(MinMaxScalerParams.MAX)
+            if hi <= lo:
+                raise ValueError(f"min {lo} must be < max {hi}")
+            X = stack_vectors(table[self.get_features_col()])
+            span = np.maximum(self._data_max - self._data_min, 1e-12)
+            out = (X - self._data_min) / span * (hi - lo) + lo
+        else:                   # device kernel: shared with the fused chain
+            out = fetched[self.get_output_col()]
         return [table.with_column(self.get_output_col(), out)]
 
     def save(self, path: str) -> None:
@@ -148,13 +220,21 @@ class MinMaxScaler(MinMaxScalerParams, Estimator[MinMaxScalerModel]):
         return model
 
 
-class MaxAbsScalerModel(_HasOutputCol, Model):
+class MaxAbsScalerModel(_HasOutputCol, _ScalerChainMixin, Model):
     """Scale columns into [-1, 1] by the per-column max absolute value
     (preserves sparsity/sign; Flink ML 2.x feature surface)."""
+
+    _kernel_fn = staticmethod(_div_affine_kernel)
 
     def __init__(self):
         super().__init__()
         self._max_abs: Optional[np.ndarray] = None
+
+    def _kernel_params(self):
+        return {"shift": np.float32(0.0),
+                "div": np.asarray(np.maximum(self._max_abs, 1e-12),
+                                  np.float32),
+                "mul": np.float32(1.0), "add": np.float32(0.0)}
 
     def set_model_data(self, *inputs) -> "MaxAbsScalerModel":
         (t,) = inputs
@@ -166,8 +246,15 @@ class MaxAbsScalerModel(_HasOutputCol, Model):
 
     def transform(self, *inputs) -> List[Table]:
         (table,) = inputs
-        X = stack_vectors(table[self.get_features_col()])
-        out = X / np.maximum(self._max_abs, 1e-12)
+        from ...api.chain import apply_kernel_or_none
+
+        fetched = apply_kernel_or_none(
+            self.transform_kernel(table.schema()), table)
+        if fetched is None:     # object dtype / f32-unsafe ints: host path
+            X = stack_vectors(table[self.get_features_col()])
+            out = X / np.maximum(self._max_abs, 1e-12)
+        else:                   # device kernel: shared with the fused chain
+            out = fetched[self.get_output_col()]
         return [table.with_column(self.get_output_col(), out)]
 
     def save(self, path: str) -> None:
@@ -203,13 +290,26 @@ class RobustScalerParams(_HasOutputCol):
                              default=True)
 
 
-class RobustScalerModel(RobustScalerParams, Model):
+class RobustScalerModel(RobustScalerParams, _ScalerChainMixin, Model):
     """Median/IQR scaling — outlier-robust standardization."""
+
+    _kernel_fn = staticmethod(_div_affine_kernel)
 
     def __init__(self):
         super().__init__()
         self._median: Optional[np.ndarray] = None
         self._range: Optional[np.ndarray] = None
+
+    def _kernel_params(self):
+        center = (self._median
+                  if self.get(RobustScalerParams.WITH_CENTERING)
+                  else np.zeros_like(self._median))
+        div = (np.maximum(self._range, 1e-12)
+               if self.get(RobustScalerParams.WITH_SCALING)
+               else np.ones_like(self._range))
+        return {"shift": np.asarray(center, np.float32),
+                "div": np.asarray(div, np.float32),
+                "mul": np.float32(1.0), "add": np.float32(0.0)}
 
     def set_model_data(self, *inputs) -> "RobustScalerModel":
         (t,) = inputs
@@ -223,12 +323,21 @@ class RobustScalerModel(RobustScalerParams, Model):
 
     def transform(self, *inputs) -> List[Table]:
         (table,) = inputs
-        X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
-        if self.get(RobustScalerParams.WITH_CENTERING):
-            X = X - self._median
-        if self.get(RobustScalerParams.WITH_SCALING):
-            X = X / np.maximum(self._range, 1e-12)
-        return [table.with_column(self.get_output_col(), X)]
+        from ...api.chain import apply_kernel_or_none
+
+        fetched = apply_kernel_or_none(
+            self.transform_kernel(table.schema()), table)
+        if fetched is None:     # object dtype / f32-unsafe ints: host path
+            X = stack_vectors(
+                table[self.get_features_col()]).astype(np.float64)
+            if self.get(RobustScalerParams.WITH_CENTERING):
+                X = X - self._median
+            if self.get(RobustScalerParams.WITH_SCALING):
+                X = X / np.maximum(self._range, 1e-12)
+            out = X
+        else:                   # device kernel: shared with the fused chain
+            out = fetched[self.get_output_col()]
+        return [table.with_column(self.get_output_col(), out)]
 
     def save(self, path: str) -> None:
         persist.save_metadata(self, path)

@@ -74,6 +74,26 @@ class _IndexSelectingModel(Model):
         return [table.with_column(self.get_output_col(),
                                   X[:, self._indices])]
 
+    def transform_kernel(self, schema):
+        """Chain kernel: the transform is one gather by fitted indices —
+        value-exact at any dtype, so the fused path is bit-exact."""
+        from ...api.chain import StageKernel, numeric_entry
+        from .vector_ops import _gather_cols_kernel
+
+        self._require_model()
+        entry = numeric_entry(schema, self.get_features_col())
+        if entry is None:
+            return None
+        d = int(entry[0][0]) if entry[0] else 1
+        if self._indices.size and self._indices.max() >= d:
+            return None      # stagewise raises the diagnostic error
+        return StageKernel(
+            fn=_gather_cols_kernel,
+            static=(self.get_features_col(), self.get_output_col()),
+            params={"idx": self._indices.astype(np.int32)},
+            consumes=(self.get_features_col(),),
+            produces=(self.get_output_col(),))
+
     def save(self, path: str) -> None:
         self._require_model()
         persist.save_metadata(self, path)

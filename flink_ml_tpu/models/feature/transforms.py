@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...api.chain import (StageKernel, as_matrix as _as_mat,
+                          f32_ceil, f32_floor, numeric_entry)
 from ...api.stage import Estimator, Model, Transformer
 from ...data.table import Table
 from ...linalg import stack_vectors
@@ -55,6 +57,14 @@ class _SimpleTransformer(_InOutParams, Transformer):
     def _apply(self, X: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
+    #: exact-compare transforms (threshold / bucket index outputs) set
+    #: this True: their kernels decline f64 columns (chain.numeric_entry)
+    _exact_compare = False
+
+    def _numeric_feature(self, schema) -> bool:
+        return numeric_entry(schema, self.get_features_col(),
+                             exact_compare=self._exact_compare) is not None
+
     def transform(self, *inputs) -> List[Table]:
         (table,) = inputs
         X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
@@ -63,6 +73,8 @@ class _SimpleTransformer(_InOutParams, Transformer):
 
 class Binarizer(_SimpleTransformer):
     """x -> 1.0 if x > threshold else 0.0, elementwise."""
+
+    _exact_compare = True
 
     THRESHOLD = FloatParam("threshold", "Binarization threshold.",
                            default=0.0)
@@ -77,6 +89,27 @@ class Binarizer(_SimpleTransformer):
         # pure host comparison: full float64 precision for the threshold
         return (X > self.get_threshold()).astype(np.float64)
 
+    def transform_kernel(self, schema):
+        """Chain kernel with the f32_floor SURROGATE threshold: for any
+        f32 value ``v``, ``v > t ⟺ v > f32_floor(t)`` — the in-segment
+        compare is bit-exact with the host-f64 stagewise compare on the
+        segment's f32 columns."""
+        if not self._numeric_feature(schema):
+            return None
+        thr = f32_floor(np.asarray([self.get_threshold()]))[0]
+        return StageKernel(
+            fn=_binarizer_kernel,
+            static=(self.get_features_col(), self.get_output_col()),
+            params={"threshold": np.float32(thr)},
+            consumes=(self.get_features_col(),),
+            produces=(self.get_output_col(),))
+
+
+def _binarizer_kernel(static, params, cols):
+    (fcol, ocol) = static
+    X = _as_mat(cols[fcol])
+    return {ocol: (X > params["threshold"]).astype(jnp.float32)}
+
 
 class Bucketizer(_SimpleTransformer):
     """Map each value to the index of its half-open split interval
@@ -86,6 +119,8 @@ class Bucketizer(_SimpleTransformer):
     into a dedicated extra bucket ``len(splits) - 1``, ``"clip"`` clamps
     into the first/last regular bucket (NaN still errors — it has no nearest
     bucket).  One ``searchsorted`` per column batch."""
+
+    _exact_compare = True
 
     SPLITS = DoubleArrayParam(
         "splits", "Strictly increasing bucket boundaries (>= 3 values).",
@@ -134,6 +169,38 @@ class Bucketizer(_SimpleTransformer):
             idx = np.where(invalid, n_buckets, idx)
         return idx.astype(np.float64)
 
+    def transform_kernel(self, schema):
+        """Chainable only under ``handleInvalid="keep"`` — the other
+        policies raise on data the kernel would have to detect in-device.
+        The splits carry f32_ceil/f32_floor surrogates so the searchsorted
+        semantics (``#{splits[j] <= v}``) are bit-exact on f32 columns."""
+        if self.get_handle_invalid() != "keep" \
+                or not self._numeric_feature(schema):
+            return None
+        splits = np.asarray(self.get_splits(), np.float64)
+        if len(splits) < 3 or not np.all(np.diff(splits) > 0):
+            return None      # stagewise raises the diagnostic error
+        return StageKernel(
+            fn=_bucketizer_kernel,
+            static=(self.get_features_col(), self.get_output_col()),
+            params={"ceil_splits": f32_ceil(splits),
+                    "lower": np.float32(f32_ceil(splits[:1])[0]),
+                    "upper": np.float32(f32_floor(splits[-1:])[0]),
+                    "n_buckets": np.int32(len(splits) - 1)},
+            consumes=(self.get_features_col(),),
+            produces=(self.get_output_col(),))
+
+
+def _bucketizer_kernel(static, params, cols):
+    (fcol, ocol) = static
+    X = _as_mat(cols[fcol])
+    nb = params["n_buckets"]
+    # searchsorted(splits, X, "right") == #{j: splits[j] <= X}
+    idx = jnp.sum(X[..., None] >= params["ceil_splits"], axis=-1) - 1
+    idx = jnp.clip(idx, 0, nb - 1)
+    invalid = jnp.isnan(X) | (X < params["lower"]) | (X > params["upper"])
+    return {ocol: jnp.where(invalid, nb, idx).astype(jnp.float32)}
+
 
 class Normalizer(_SimpleTransformer):
     """Scale each row to unit p-norm."""
@@ -151,6 +218,28 @@ class Normalizer(_SimpleTransformer):
         return np.asarray(_normalize(jnp.asarray(X, jnp.float32),
                                      self.get_p()))
 
+    def transform_kernel(self, schema):
+        if not self._numeric_feature(schema):
+            return None
+        return StageKernel(
+            fn=_normalizer_kernel,
+            static=(self.get_features_col(), self.get_output_col(),
+                    float(self.get_p())),
+            params={},
+            consumes=(self.get_features_col(),),
+            produces=(self.get_output_col(),))
+
+
+def _normalizer_kernel(static, params, cols):
+    (fcol, ocol, p) = static
+    X = _as_mat(cols[fcol])
+    # expression-identical to _normalize (p is plan-static)
+    if np.isinf(p):
+        norm = jnp.max(jnp.abs(X), axis=-1, keepdims=True)
+    else:
+        norm = jnp.sum(jnp.abs(X) ** p, axis=-1, keepdims=True) ** (1.0 / p)
+    return {ocol: X / jnp.maximum(norm, 1e-12)}
+
 
 @partial(jax.jit, static_argnums=(1,))
 def _normalize(X, p):
@@ -161,6 +250,24 @@ def _normalize(X, p):
     else:
         norm = jnp.sum(jnp.abs(X) ** p, axis=-1, keepdims=True) ** (1.0 / p)
     return X / jnp.maximum(norm, 1e-12)
+
+
+def _poly_exponents(d: int, degree: int) -> np.ndarray:
+    """(n_terms, d) monomial exponent rows, in the expansion order BOTH
+    the stagewise and fused paths share — the ordering is the
+    bit-exactness contract between them, so it lives in one place."""
+    exponents: List[np.ndarray] = []
+
+    def expand(prefix, remaining, start):
+        for j in range(start, d):
+            e = prefix.copy()
+            e[j] += 1
+            exponents.append(e.copy())
+            if remaining > 1:
+                expand(e, remaining - 1, j)
+
+    expand(np.zeros(d, np.int64), degree, 0)
+    return np.stack(exponents)
 
 
 class PolynomialExpansion(_SimpleTransformer):
@@ -178,22 +285,30 @@ class PolynomialExpansion(_SimpleTransformer):
         return self.set(PolynomialExpansion.DEGREE, value)
 
     def _apply(self, X: np.ndarray) -> np.ndarray:
-        degree = self.get_degree()
-        d = X.shape[1]
-        exponents: List[np.ndarray] = []
-
-        def expand(prefix, remaining, start):
-            for j in range(start, d):
-                e = prefix.copy()
-                e[j] += 1
-                exponents.append(e.copy())
-                if remaining > 1:
-                    expand(e, remaining - 1, j)
-
-        expand(np.zeros(d, np.int64), degree, 0)
-        expo = np.stack(exponents)                      # (n_terms, d)
+        expo = _poly_exponents(X.shape[1], self.get_degree())
         return np.asarray(_poly_apply(jnp.asarray(X, jnp.float32),
                                       jnp.asarray(expo, jnp.float32)))
+
+    def transform_kernel(self, schema):
+        entry = numeric_entry(schema, self.get_features_col())
+        if entry is None:
+            return None
+        shape = entry[0]
+        d = int(shape[0]) if shape else 1
+        expo = _poly_exponents(d, self.get_degree())
+        return StageKernel(
+            fn=_poly_chain_kernel,
+            static=(self.get_features_col(), self.get_output_col()),
+            params={"expo": expo.astype(np.float32)},
+            consumes=(self.get_features_col(),),
+            produces=(self.get_output_col(),))
+
+
+def _poly_chain_kernel(static, params, cols):
+    (fcol, ocol) = static
+    X = _as_mat(cols[fcol])
+    expo = params["expo"]
+    return {ocol: jnp.prod(X[:, None, :] ** expo[None, :, :], axis=-1)}
 
 
 @jax.jit
@@ -249,12 +364,46 @@ class ImputerModel(ImputerParams, Model):
         self._require_model()
         return [Table({"fill": self._fill[None]})]
 
+    def transform_kernel(self, schema):
+        self._require_model()
+        missing = self.get_missing_value()
+        # equality only fires for f32-exact placeholders (+-inf included:
+        # both are exact in f32): a non-exact placeholder can never equal
+        # an f32 column value (the host path widens f32 exactly), so the
+        # kernel drops the compare instead of matching the ROUNDED
+        # placeholder against real values
+        use_eq = (not np.isnan(missing)
+                  and float(np.float32(missing)) == float(missing))
+        # ANY non-NaN placeholder is an exact decision over the column
+        # values, so f64 columns decline even when use_eq is False: f64
+        # data can carry the placeholder exactly (host path fills it)
+        # while entry rounding makes it unmatchable — only the NaN
+        # placeholder survives rounding unchanged
+        if numeric_entry(schema, self.get_features_col(),
+                         exact_compare=not np.isnan(missing)) is None:
+            return None
+        return StageKernel(
+            fn=_imputer_kernel,
+            static=(self.get_features_col(), self.get_output_col(),
+                    float(np.float32(missing)) if use_eq else None),
+            params={"fill": np.asarray(self._fill, np.float32)},
+            consumes=(self.get_features_col(),),
+            produces=(self.get_output_col(),))
+
     def transform(self, *inputs) -> List[Table]:
         (table,) = inputs
         self._require_model()
-        X = stack_vectors(table[self.get_features_col()]).astype(np.float64)
-        mask = _missing_mask(X, self.get_missing_value())
-        out = np.where(mask, self._fill[None, :], X)
+        from ...api.chain import apply_kernel_or_none
+
+        fetched = apply_kernel_or_none(
+            self.transform_kernel(table.schema()), table)
+        if fetched is None:     # object dtype / f32-unsafe ints: host path
+            X = stack_vectors(
+                table[self.get_features_col()]).astype(np.float64)
+            mask = _missing_mask(X, self.get_missing_value())
+            out = np.where(mask, self._fill[None, :], X)
+        else:                   # device kernel: shared with the fused chain
+            out = fetched[self.get_output_col()]
         return [table.with_column(self.get_output_col(), out)]
 
     def save(self, path: str) -> None:
@@ -268,6 +417,15 @@ class ImputerModel(ImputerParams, Model):
         model._fill = persist.load_model_arrays(
             path, "model")["fill"].astype(np.float64)
         return model
+
+
+def _imputer_kernel(static, params, cols):
+    (fcol, ocol, missing) = static
+    X = _as_mat(cols[fcol])
+    mask = jnp.isnan(X)
+    if missing is not None:
+        mask = mask | (X == missing)
+    return {ocol: jnp.where(mask, params["fill"][None, :], X)}
 
 
 class Imputer(ImputerParams, Estimator[ImputerModel]):

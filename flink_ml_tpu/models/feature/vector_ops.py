@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...api.chain import (StageKernel, as_matrix as _mat, f32_ceil,
+                          numeric_entry)
 from ...api.stage import Estimator, Model, Transformer
 from ...data.table import Table
 from ...linalg import stack_vectors
@@ -73,6 +75,20 @@ class VectorSlicer(_SimpleTransformer):
                 f"{idx[(idx < 0) | (idx >= X.shape[1])][0]}")
         return X[:, idx]
 
+    def transform_kernel(self, schema):
+        entry = numeric_entry(schema, self.get_features_col())
+        if entry is None or not entry[0]:
+            return None
+        idx = np.asarray(self.get_indices() or (), np.int64)
+        if idx.size == 0 or np.any(idx < 0) or np.any(idx >= entry[0][0]):
+            return None      # stagewise raises the diagnostic error
+        return StageKernel(
+            fn=_gather_cols_kernel,
+            static=(self.get_features_col(), self.get_output_col()),
+            params={"idx": idx.astype(np.int32)},
+            consumes=(self.get_features_col(),),
+            produces=(self.get_output_col(),))
+
 
 class ElementwiseProduct(_SimpleTransformer):
     """Hadamard product of each row with a fixed scaling vector."""
@@ -97,6 +113,42 @@ class ElementwiseProduct(_SimpleTransformer):
                 f"scalingVec has dim {scale.shape[0]}, input rows have "
                 f"dim {X.shape[1]}")
         return X * scale[None, :]
+
+    def transform_kernel(self, schema):
+        entry = numeric_entry(schema, self.get_features_col())
+        if entry is None:
+            return None
+        scale = np.asarray(self.get_scaling_vec() or (), np.float64)
+        d = int(entry[0][0]) if entry[0] else 1
+        if scale.shape[0] != d:
+            return None      # stagewise raises the diagnostic error
+        return StageKernel(
+            fn=_elementwise_product_kernel,
+            static=(self.get_features_col(), self.get_output_col()),
+            params={"scale": scale.astype(np.float32)},
+            consumes=(self.get_features_col(),),
+            produces=(self.get_output_col(),))
+
+    def transform(self, *inputs) -> List[Table]:
+        (table,) = inputs
+        from ...api.chain import apply_kernel_or_none
+
+        fetched = apply_kernel_or_none(
+            self.transform_kernel(table.schema()), table)
+        if fetched is None:     # object/mismatched/f32-unsafe: host path
+            return super().transform(*inputs)
+        out = fetched[self.get_output_col()]
+        return [table.with_column(self.get_output_col(), out)]
+
+
+def _gather_cols_kernel(static, params, cols):
+    (fcol, ocol) = static
+    return {ocol: _mat(cols[fcol])[:, params["idx"]]}
+
+
+def _elementwise_product_kernel(static, params, cols):
+    (fcol, ocol) = static
+    return {ocol: _mat(cols[fcol]) * params["scale"][None, :]}
 
 
 class Interaction(HasInputCols, HasOutputCol, Transformer):
@@ -123,6 +175,20 @@ class Interaction(HasInputCols, HasOutputCol, Transformer):
         out = np.asarray(_interact(tuple(mats)))
         return [table.with_column(self.get_output_col(), out)]
 
+    def transform_kernel(self, schema):
+        in_cols = self.get_input_cols()
+        if not in_cols or len(in_cols) < 2:
+            return None      # stagewise raises the diagnostic error
+        for name in in_cols:
+            if numeric_entry(schema, name) is None:
+                return None
+        return StageKernel(
+            fn=_interaction_kernel,
+            static=(tuple(in_cols), self.get_output_col()),
+            params={},
+            consumes=tuple(in_cols),
+            produces=(self.get_output_col(),))
+
 
 @jax.jit
 def _interact(mats):
@@ -131,6 +197,15 @@ def _interact(mats):
         # (n, da, 1) * (n, 1, db) -> (n, da, db) -> (n, da*db)
         acc = (acc[:, :, None] * m[:, None, :]).reshape(acc.shape[0], -1)
     return acc
+
+
+def _interaction_kernel(static, params, cols):
+    in_cols, ocol = static
+    acc = _mat(cols[in_cols[0]]).astype(jnp.float32)
+    for name in in_cols[1:]:
+        m = _mat(cols[name]).astype(jnp.float32)
+        acc = (acc[:, :, None] * m[:, None, :]).reshape(acc.shape[0], -1)
+    return {ocol: acc}
 
 
 class DCT(_SimpleTransformer):
@@ -164,6 +239,27 @@ class DCT(_SimpleTransformer):
         return np.asarray(_dct_apply(jnp.asarray(X, jnp.float32),
                                      jnp.asarray(C, jnp.float32),
                                      self.get_inverse()))
+
+    def transform_kernel(self, schema):
+        entry = numeric_entry(schema, self.get_features_col())
+        if entry is None:
+            return None
+        d = int(entry[0][0]) if entry[0] else 1
+        C = self._matrix(d).astype(np.float32)
+        return StageKernel(
+            fn=_dct_chain_kernel,
+            static=(self.get_features_col(), self.get_output_col(),
+                    bool(self.get_inverse())),
+            params={"C": C},
+            consumes=(self.get_features_col(),),
+            produces=(self.get_output_col(),))
+
+
+def _dct_chain_kernel(static, params, cols):
+    (fcol, ocol, inverse) = static
+    X = _mat(cols[fcol]).astype(jnp.float32)
+    C = params["C"]
+    return {ocol: X @ (C if inverse else C.T)}
 
 
 @partial(jax.jit, static_argnums=(2,))
@@ -267,6 +363,34 @@ class KBinsDiscretizerModel(KBinsDiscretizerParams, Model):
             out[:, j] = idx
         return [table.with_column(self.get_output_col(), out)]
 
+    def transform_kernel(self, schema):
+        """Learned edges are arbitrary f64 quantiles, so the kernel binning
+        uses f32_ceil surrogates per interior edge: ``#{e <= v}`` counted
+        against the surrogates is bit-exact with the host-f64 searchsorted
+        for every f32 value ``v`` — which is why f64 columns decline
+        (``exact_compare``): segment-entry rounding could carry a value
+        across an edge the host-f64 compare respects."""
+        self._require_model()
+        entry = numeric_entry(schema, self.get_features_col(),
+                              exact_compare=True)
+        if entry is None:
+            return None
+        d = int(entry[0][0]) if entry[0] else 1
+        if d != self._edges.shape[0]:
+            return None
+        width = max(int(self._n_edges.max()) - 2, 1)
+        ceil_edges = np.full((d, width), np.inf, np.float32)
+        for j in range(d):
+            interior = self._edges[j, 1: self._n_edges[j] - 1]
+            ceil_edges[j, : len(interior)] = f32_ceil(interior)
+        n_interior = np.maximum(self._n_edges - 2, 0).astype(np.int32)
+        return StageKernel(
+            fn=_kbins_kernel,
+            static=(self.get_features_col(), self.get_output_col()),
+            params={"ceil_edges": ceil_edges, "n_interior": n_interior},
+            consumes=(self.get_features_col(),),
+            produces=(self.get_output_col(),))
+
     def save(self, path: str) -> None:
         self._require_model()
         persist.save_metadata(self, path)
@@ -280,6 +404,17 @@ class KBinsDiscretizerModel(KBinsDiscretizerParams, Model):
         model._edges = data["edges"].astype(np.float64)
         model._n_edges = data["n_edges"].astype(np.int64)
         return model
+
+
+def _kbins_kernel(static, params, cols):
+    (fcol, ocol) = static
+    X = _mat(cols[fcol])
+    # searchsorted(interior, x, "right") == #{e: e <= x}; +inf pads never hit
+    idx = jnp.sum(X[:, :, None] >= params["ceil_edges"][None, :, :], axis=-1)
+    # NaN compares false against every edge (bin 0 here), but the host
+    # searchsorted sorts NaN AFTER everything -> last bin
+    idx = jnp.where(jnp.isnan(X), params["n_interior"][None, :], idx)
+    return {ocol: idx.astype(jnp.float32)}
 
 
 class KBinsDiscretizer(KBinsDiscretizerParams,
@@ -410,6 +545,48 @@ class VectorIndexerModel(VectorIndexerParams, Model):
             result = result.select_rows(np.flatnonzero(~invalid_rows))
         return [result]
 
+    def transform_kernel(self, schema):
+        """Chainable only under ``handleInvalid="keep"`` (error raises,
+        skip drops rows — both host control flow).  Vocab values carry
+        their f32 casts plus an exactness mask: a fitted value that is
+        not f32-representable can never equal an f32 column value, so it
+        is simply unmatchable (bit-exact with the host-f64 compare on
+        f32 columns); two values colliding in f32 make the lookup
+        ambiguous, and the stage falls back stagewise.  f64 columns
+        decline (``exact_compare``): entry rounding could land an unseen
+        f64 value exactly on a vocab entry the host-f64 compare rejects."""
+        self._require_model()
+        if self.get_handle_invalid() != "keep":
+            return None
+        entry = numeric_entry(schema, self.get_features_col(),
+                              exact_compare=True)
+        if entry is None:
+            return None
+        d = int(entry[0][0]) if entry[0] else 1
+        if d != self._values.shape[0]:
+            return None
+        m = max(int(self._n_values.max()), 1)
+        vals32 = np.full((d, m), np.inf, np.float32)
+        exact = np.zeros((d, m), np.float32)
+        for j in range(d):
+            n = self._n_values[j]
+            if n < 0:
+                continue
+            v = self._values[j, :n]
+            v32 = v.astype(np.float32)
+            if np.any(np.diff(v32) <= 0):
+                return None       # f32 collision: lookup would be ambiguous
+            vals32[j, :n] = v32
+            exact[j, :n] = (v32.astype(np.float64) == v)
+        return StageKernel(
+            fn=_vector_indexer_kernel,
+            static=(self.get_features_col(), self.get_output_col()),
+            params={"vals": vals32, "exact": exact,
+                    "unseen": self._n_values.astype(np.float32),
+                    "is_cat": (self._n_values >= 0).astype(np.float32)},
+            consumes=(self.get_features_col(),),
+            produces=(self.get_output_col(),))
+
     def save(self, path: str) -> None:
         self._require_model()
         persist.save_metadata(self, path)
@@ -423,6 +600,22 @@ class VectorIndexerModel(VectorIndexerParams, Model):
         model._values = data["values"].astype(np.float64)
         model._n_values = data["n_values"].astype(np.int64)
         return model
+
+
+def _vector_indexer_kernel(static, params, cols):
+    (fcol, ocol) = static
+    X = _mat(cols[fcol]).astype(jnp.float32)
+    vals = params["vals"]                               # (d, m), +inf pad
+    d = vals.shape[0]
+    col_ids = jnp.arange(d)[None, :]
+    # last index with vals <= x (unique vocab => same index searchsorted
+    # side="left" lands on when x matches)
+    pos = jnp.sum(X[:, :, None] >= vals[None, :, :], axis=-1) - 1
+    pos_c = jnp.clip(pos, 0, vals.shape[1] - 1)
+    hit = (vals[col_ids, pos_c] == X) & (params["exact"][col_ids, pos_c] > 0)
+    out_cat = jnp.where(hit, pos_c.astype(jnp.float32),
+                        params["unseen"][None, :])
+    return {ocol: jnp.where(params["is_cat"][None, :] > 0, out_cat, X)}
 
 
 class VectorIndexer(VectorIndexerParams, Estimator[VectorIndexerModel]):

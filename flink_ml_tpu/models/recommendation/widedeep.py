@@ -149,13 +149,24 @@ def forward_from_rows(params: Dict[str, Any], dense: jnp.ndarray,
     differentiates THROUGH the rows (treating the gathers as inputs) so
     it can route the table gradients itself; ``params`` needs only the
     non-table leaves here."""
-    wide = (dense @ params["wide_dense"]
+    from ..common.linear import _stable_margins
+
+    # k=1 contractions (the wide matvec, the final (h, 1) layer) go
+    # through the context-stable GEMM form: their loop-fusion
+    # accumulation order otherwise differs between the standalone score
+    # program and a fused chain segment (see _stable_margins), breaking
+    # the fused pipeline's bit-exactness at d >= 8.
+    wide = (_stable_margins(dense, params["wide_dense"], 0.0)
             + jnp.sum(wide_rows, axis=1)
             + params["wide_b"])
     deep = jnp.concatenate(
         [dense, emb_rows.reshape(emb_rows.shape[0], -1)], axis=1)
     for i, layer in enumerate(params["mlp"]):
-        deep = deep @ layer["w"] + layer["b"]
+        if layer["w"].shape[1] == 1:
+            deep = _stable_margins(deep, layer["w"][:, 0],
+                                   layer["b"][0])[:, None]
+        else:
+            deep = deep @ layer["w"] + layer["b"]
         if i + 1 < len(params["mlp"]):
             deep = jax.nn.relu(deep)
     return wide + deep[:, 0]
@@ -472,6 +483,17 @@ def _jit_scores(params, dense, cat_ids):
     return jax.nn.sigmoid(forward(params, dense, cat_ids))
 
 
+def _widedeep_chain_kernel(static, params, cols):
+    """Chain-terminal scores — expression-identical to ``_jit_scores``;
+    the raw per-field ids offset into the stacked vocab in-device (an
+    exact int add; the range check runs host-side as the kernel's
+    ``pre``)."""
+    (dcol, ccol, scol) = static
+    dense = cols[dcol].astype(jnp.float32)
+    cat = cols[ccol] + params["offsets"][None, :]
+    return {scol: jax.nn.sigmoid(forward(params["net"], dense, cat))}
+
+
 class WideDeepModel(WideDeepParams, Model):
     def __init__(self):
         super().__init__()
@@ -487,6 +509,42 @@ class WideDeepModel(WideDeepParams, Model):
     def _require_model(self):
         if self._params is None:
             raise RuntimeError("WideDeepModel has no model data")
+
+    def transform_kernel(self, schema):
+        """Chain TERMINAL: one fused sigmoid(forward) over the segment's
+        device columns.  The categorical id range check (host control
+        flow) runs as the kernel's ``pre`` on the segment's entry
+        columns, so the stage only chains while catFeatures passes
+        through from the segment input untouched."""
+        from ...api.chain import StageKernel, numeric_entry
+
+        self._require_model()
+        dcol, ccol = self.DENSE_FEATURES_COL, self.CAT_FEATURES_COL
+        cat_entry = schema.get(ccol)
+        if numeric_entry(schema, dcol) is None \
+                or cat_entry is None or cat_entry[1].kind not in "iu" \
+                or len(cat_entry[0]) != 1 \
+                or cat_entry[0][0] != len(self._vocab_sizes):
+            return None
+        raw_col = self.get_raw_prediction_col()
+        pred_col = self.get_prediction_col()
+        score_col = f"__chain_scores__{pred_col}"
+        vocab_sizes = self._vocab_sizes
+
+        def pre(host):
+            _validate_cat_ids(np.asarray(host[ccol]), vocab_sizes)
+
+        def post(host):
+            scores = host[score_col].astype(np.float64)
+            return {raw_col: scores,
+                    pred_col: (scores > 0.5).astype(np.int64)}
+
+        return StageKernel(
+            fn=_widedeep_chain_kernel, static=(dcol, ccol, score_col),
+            params={"net": self._params,
+                    "offsets": _field_offsets(vocab_sizes)},
+            consumes=(dcol, ccol), produces=(score_col,),
+            post=post, pre=pre, pre_cols=(ccol,))
 
     def transform(self, *inputs) -> List[Table]:
         (table,) = inputs

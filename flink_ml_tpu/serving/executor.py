@@ -151,7 +151,9 @@ class ServableModel:
 # -- specialized executors ---------------------------------------------------
 
 def _linear_margins(X, w, b):
-    return X @ w + b
+    from ..models.common.linear import _stable_margins
+
+    return _stable_margins(X, w, b)
 
 
 class _LinearServable(ServableModel):
@@ -233,17 +235,60 @@ class _WideDeepServable(ServableModel):
                                (scores > 0.5).astype(np.int64))
 
 
+class _PipelineServable(ServableModel):
+    """PipelineModel: the whole chain (preprocess + score) compiles into
+    fused segments (``api/chain.py``) at deploy time — a fully-chainable
+    pipeline serves every micro-batch in ONE jitted dispatch.  ``warm_up``
+    (inherited) tiles the example through every bucket, so each segment
+    compiles per bucket OFF the serving path; plans with the same stage
+    types share compiled executables across hot-swapped generations via
+    the plan-static segment jit."""
+
+    def __init__(self, model, example: Table, **kwargs: Any):
+        super().__init__(model, example, **kwargs)
+        from ..api.chain import compile_pipeline, raw_schema
+
+        self._plan_schema = raw_schema(example)
+        try:
+            # the plan must pad with THIS servable's bucket floor —
+            # warm_up tiles buckets from self.min_bucket, and a plan
+            # padding to a different ladder would compile on the serving
+            # path after the endpoint reported ready
+            plan = compile_pipeline(model, example,
+                                    min_bucket=self.min_bucket)
+            self._plan = plan if plan.worthwhile else None
+        except Exception:           # unported stage mix: stagewise serve
+            self._plan = None
+
+    def _run(self, table: Table) -> Table:
+        # the plan's kernel admissibility was decided on the EXAMPLE's
+        # raw dtypes (exact-compare stages decline f64); a request with
+        # a different raw schema routes through model.transform, whose
+        # own plan cache keys on the request schema
+        if self._plan is not None:
+            from ..api.chain import raw_schema
+
+            if raw_schema(table) == self._plan_schema:
+                return self._plan.transform(table)[0]
+        return self.model.transform(table)[0]
+
+
 def make_servable(model, example: Table, **kwargs: Any) -> ServableModel:
     """Adapt a fitted Model for serving, picking the specialized executor
-    for the covered families (linear / KMeans / Wide&Deep; GBT and every
-    other row-independent transform serve through the generic adapter,
-    whose predict entry points are bucket-routed since this PR)."""
+    for the covered families (linear / KMeans / Wide&Deep; whole
+    PipelineModels fuse their chainable stage runs into single-dispatch
+    segments; GBT and every other row-independent transform serve through
+    the generic adapter, whose predict entry points are bucket-routed
+    since this PR)."""
+    from ..api.pipeline import PipelineModel
     from ..models.clustering.kmeans import KMeansModel
     from ..models.common.linear import LinearModelBase
     from ..models.recommendation.widedeep import WideDeepModel
 
-    if isinstance(model, LinearModelBase):
-        cls: type = _LinearServable
+    if isinstance(model, PipelineModel):
+        cls: type = _PipelineServable
+    elif isinstance(model, LinearModelBase):
+        cls = _LinearServable
     elif isinstance(model, KMeansModel):
         cls = _KMeansServable
     elif isinstance(model, WideDeepModel):

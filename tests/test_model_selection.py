@@ -278,3 +278,56 @@ def test_cv_pipeline_transformer_grid_param_does_not_mutate_original():
     assert norm.get_p() == 2.0
     assert pipe.stages[1].get_max_iter() == 15
     assert model.best_params[Normalizer.P] in (1.0, 3.0)
+
+
+def test_cv_pipeline_fused_scoring_reuses_compiled_segments():
+    """Pipeline candidates score through the fused chain (`api/chain.py`):
+    fold metrics are identical to the stagewise path, and because the
+    segment jit is plan-static with fold params as runtime device args,
+    a whole repeat grid x fold sweep at the same shapes adds ZERO new
+    XLA lowerings — fold models share one compiled program per
+    (schema, bucket) instead of recompiling per fold."""
+    from jax._src import test_util as jtu
+
+    from flink_ml_tpu import Pipeline
+    from flink_ml_tpu.api import chain
+    from flink_ml_tpu.models.feature.scalers import StandardScaler
+
+    t = _data(n=400)
+    grid = (ParamGridBuilder()
+            .add_grid(LogisticRegression.MAX_ITER, [2, 8]).build())
+
+    def _cv():
+        pipe = Pipeline([StandardScaler().set_output_col("features"),
+                         _lr()])
+        return (CrossValidator(pipe, _auc_eval(), grid)
+                .set_num_folds(4).set_seed(6))
+
+    with chain.chain_disabled():
+        ref = _cv().fit(t)
+    fused = _cv().fit(t)
+    assert fused.avg_metrics == ref.avg_metrics   # fold metrics unchanged
+    assert fused.best_index == ref.best_index
+
+    # scoring-side compile reuse: one fitted pipeline per fold (distinct
+    # fitted arrays, identical stage types / columns / shapes), fold 1
+    # warms the (schema, bucket) segment compiles, every later fold's
+    # scoring transform must hit them — the fit-side `sgd` compiles stay
+    # outside the counter (they are per-fit and predate the chain)
+    folds = []
+    for train, val in _cv()._splits(t):
+        pipe = Pipeline([StandardScaler().set_output_col("features"),
+                         _lr().set_max_iter(2)])
+        folds.append((pipe.fit(train), val))
+    m0, v0 = folds[0]
+    m0.transform(v0)                        # warm fold
+    with jtu.count_jit_and_pmap_lowerings() as count:
+        preds = [m.transform(v)[0] for m, v in folds]
+    assert count[0] == 0, (
+        f"{count[0]} new XLA lowerings across fold scoring — fold "
+        "models are not sharing the plan-static segment compiles")
+    for (m, v), pred in zip(folds, preds):
+        with chain.chain_disabled():
+            (sw,) = m.transform(v)
+        for c in sw.column_names:
+            assert np.array_equal(np.asarray(sw[c]), np.asarray(pred[c]))
