@@ -200,7 +200,8 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
                        stats: Optional[PrefetchStats] = None,
                        put_fn: Optional[Callable[[Any, Any], Any]] = None,
                        chunks: Optional[int] = None,
-                       metric_group: Optional[Any] = None
+                       metric_group: Optional[Any] = None,
+                       retry_policy: Optional[Any] = None
                        ) -> Iterator[Any]:
     """Iterate device-resident copies of ``batches``, staying ``depth``
     UNITS OF WORK ahead of the consumer — a unit is one batch, or one
@@ -246,6 +247,19 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
     fraction, put-overlap time, per-stage seconds — refreshed at every
     yielded item and once more at stream end, so a fit's ingest pipeline
     is observable through the same registry as its epoch metrics.
+
+    ``retry_policy`` (a ``robustness.retry.RetryPolicy``) retries the
+    SOURCE pull on classified-transient errors with exponential backoff
+    — a flaky read costs a sleep on the reader thread (overlapped by
+    whatever is already staged), not the fit.  ``batches`` is wrapped in
+    a ``RetryingIterator`` at the raw-source level (below chunk
+    grouping), so object-shaped sources retry in place and cursor-backed
+    generator sources re-iterate at their cursor; a bare generator that
+    dies on a transient fails LOUDLY (``StreamRetryUnsupported``) rather
+    than truncating silently.  The source must not consume an item on a
+    failed pull (raise-before-read, the ``FaultPlan.wrap_source``
+    contract) or be idempotent at the failed position; fatal errors
+    still propagate in stream order.
     """
     if depth < 1:
         raise ValueError(f"depth must be >= 1, got {depth}")
@@ -263,6 +277,15 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
     st = stats or PrefetchStats()
     if chunks is not None:
         st.chunk_size = chunks
+    if retry_policy is not None:
+        # wrap the RAW source, below the chunk grouping: retrying above
+        # a generator adapter would read StopIteration off its dead
+        # frame and silently truncate (robustness.retry.RetryingIterator
+        # docs); StopIteration itself is never classified retryable, so
+        # end-of-stream passes through the policy untouched
+        from ..robustness.retry import RetryingIterator
+
+        batches = RetryingIterator(batches, retry_policy)
 
     if chunks is not None:
         item_transform = transform

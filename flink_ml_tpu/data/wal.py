@@ -40,16 +40,22 @@ the per-window fsync stays; batching the dirfsync would only matter past
 
 from __future__ import annotations
 
+import logging
 import os
 import tempfile
+import zipfile
 
-from typing import Any, Dict, Iterator, List
+from typing import Any, Dict, Iterator, List, Optional
 
 import numpy as np
 
 from .table import Table
+from ..robustness.durability import CorruptStateError
+from ..robustness.faults import fault_point
 
 __all__ = ["WindowLog"]
+
+log = logging.getLogger("flink_ml_tpu.robustness")
 
 
 def _win_name(i: int) -> str:
@@ -65,12 +71,15 @@ class WindowLog:
     """
 
     def __init__(self, source: Any, directory: str, *,
-                 keep_snapshots: int = 2):
+                 keep_snapshots: int = 2, retry_policy: Optional[Any] = None):
         if keep_snapshots < 1:
             raise ValueError("keep_snapshots must be >= 1")
         self._source = source
         self._dir = directory
         self._keep = keep_snapshots
+        #: a robustness.retry.RetryPolicy: transient append failures
+        #: (flaky NFS, injected faults) cost a backoff sleep, not the run
+        self._retry = retry_policy
         os.makedirs(directory, exist_ok=True)
         self._consumed = 0           # windows handed to the consumer
         self._start = 0              # restore position
@@ -93,14 +102,41 @@ class WindowLog:
                     f"window {i} missing from log {self._dir!r}: the "
                     "restore cursor predates the truncation horizon "
                     "(keep_snapshots too small for this checkpoint lag)")
-            with np.load(path, allow_pickle=True) as data:
-                window = Table({k: data[k] for k in data.files})
+            try:
+                with np.load(path, allow_pickle=True) as data:
+                    window = Table({k: data[k] for k in data.files})
+            except (zipfile.BadZipFile, EOFError, OSError,
+                    ValueError, KeyError) as exc:
+                if i == self._next_log - 1:
+                    # torn TAIL entry: the crash hit mid-append, so this
+                    # window never reached the consumer — drop it and
+                    # resume live exactly where the log truly ends (the
+                    # same few-microsecond exposure as the module doc's
+                    # pull-to-rename race, now detected instead of fatal)
+                    log.warning(
+                        "window log %s: truncating torn tail entry %d "
+                        "(%r)", self._dir, i, exc)
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    self._next_log = i
+                    break
+                raise CorruptStateError(
+                    f"window {i} of log {self._dir!r} is corrupt ({exc!r}) "
+                    "but is NOT the tail — windows beyond it were already "
+                    "consumed, so truncating would silently drop data; "
+                    "restore from a checkpoint past this window or start "
+                    "a fresh log directory") from exc
             i += 1
             self._consumed = i
             yield window
         # live phase: write-ahead, then hand over
         for window in self._source:
-            self._persist(self._next_log, window)
+            if self._retry is not None:
+                self._retry.call(self._persist, self._next_log, window)
+            else:
+                self._persist(self._next_log, window)
             self._next_log += 1
             self._consumed = self._next_log
             yield window
@@ -113,6 +149,11 @@ class WindowLog:
                 np.savez(f, **cols)
                 f.flush()
                 os.fsync(f.fileno())   # durable BEFORE the consumer sees it
+            # fault seam: control faults (transient -> retried by the
+            # policy above, ENOSPC -> fatal) raise here; data faults
+            # damage tmp so the rename commits a torn tail entry — the
+            # case the replay-side truncation above exists for
+            fault_point("wal.append", tmp)
             os.replace(tmp, os.path.join(self._dir, _win_name(i)))
             dirfd = os.open(self._dir, os.O_RDONLY)
             try:

@@ -11,6 +11,15 @@ no in-flight records: an epoch boundary is a consistent cut by construction
 written atomically between epochs.  Exactly-once equivalence becomes
 *deterministic replay*: state + epoch + cursor + RNG key fully determine the
 rest of training (tested, not assumed — see tests/test_checkpoint.py).
+
+Durability is VALIDATED (robustness PR): every checkpoint directory
+carries a per-file CRC32 manifest and an atomic commit marker
+(``robustness/durability.py`` — write payload -> manifest -> marker ->
+rename), so a torn write, a bit flip, or a crash mid-save is *detected*
+at restore time.  ``CheckpointManager.latest()`` scans newest->oldest,
+quarantines invalid cuts (``<dir>.corrupt``) and returns the newest
+VALID one instead of crashing on — or worse, silently restoring — bad
+state.
 """
 
 from __future__ import annotations
@@ -18,11 +27,21 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import time
+import zipfile
 
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+from ..robustness.durability import (
+    CorruptStateError,
+    commit_dir,
+    quarantine,
+    verify_dir,
+)
+from ..robustness.faults import fault_point
 
 __all__ = ["save_pytree", "load_pytree", "CheckpointManager", "CheckpointConfig"]
 
@@ -148,6 +167,12 @@ def save_pytree(path: str, tree: Any,
              **{f"leaf_{i}": leaf for i, leaf in enumerate(leaves)})
     with open(os.path.join(tmp, "structure.json"), "w") as f:
         json.dump({"skeleton": skeleton, "meta": meta or {}}, f)
+    # commit protocol: CRC manifest -> (fault seam) -> COMMITTED marker,
+    # all BEFORE the rename publishes the directory.  An injected crash
+    # here leaves an uncommitted tmp (never trusted); an injected
+    # torn/flip fault leaves a committed-but-invalid checkpoint that
+    # verify_dir catches at restore (robustness/durability.py).
+    commit_dir(tmp, fault_scope="checkpoint.write")
     if os.path.exists(path):
         # Overwrite dance keeping a valid copy at every instant: demote the
         # old checkpoint to .old, promote tmp, then drop .old.  A crash in
@@ -168,14 +193,30 @@ def save_pytree(path: str, tree: Any,
 
 
 def load_pytree(path: str) -> Tuple[Any, Dict[str, Any]]:
+    """Validate (manifest CRCs + commit marker — legacy pre-manifest
+    saves pass through) then decode.  Decode-time corruption that slips
+    past a legacy save's missing manifest still surfaces as a
+    diagnosable :class:`~..robustness.durability.CorruptStateError`
+    naming the path, never as silently wrong state."""
     if not os.path.exists(os.path.join(path, "structure.json")) \
             and os.path.exists(os.path.join(path + ".old", "structure.json")):
         path = path + ".old"  # crashed mid-overwrite; previous copy is valid
-    with open(os.path.join(path, "structure.json")) as f:
-        doc = json.load(f)
-    with np.load(os.path.join(path, "leaves.npz")) as data:
-        leaves = {int(k.split("_", 1)[1]): data[k] for k in data.files}
-    return _decode_structure(doc["skeleton"], leaves), doc.get("meta", {})
+    verify_dir(path)
+    try:
+        with open(os.path.join(path, "structure.json")) as f:
+            doc = json.load(f)
+        with np.load(os.path.join(path, "leaves.npz")) as data:
+            leaves = {int(k.split("_", 1)[1]): data[k] for k in data.files}
+        return _decode_structure(doc["skeleton"], leaves), doc.get("meta", {})
+    except (json.JSONDecodeError, zipfile.BadZipFile, KeyError, EOFError,
+            ValueError, FileNotFoundError) as exc:
+        # FileNotFoundError: a legacy (pre-manifest) dir can pass
+        # verify_dir yet be missing a payload file — a partial save,
+        # quarantinable like any other corruption
+        raise CorruptStateError(
+            f"checkpoint at {path} failed to decode ({exc!r}); the save "
+            "is truncated or corrupted — restore from an earlier "
+            "checkpoint") from exc
 
 
 class CheckpointConfig:
@@ -204,6 +245,13 @@ class CheckpointManager:
         os.makedirs(config.directory, exist_ok=True)
         self._pending: Optional["threading.Thread"] = None
         self._pending_error: Optional[BaseException] = None
+        #: set by :meth:`latest` — the supervisor/bench read these to
+        #: compute MTTR (detect -> restore complete) and steps replayed
+        self.last_restore_at: Optional[float] = None
+        self.last_restored_step: Optional[int] = None
+        #: timestamp source for ``last_restore_at``; resilient_fit
+        #: overwrites it with ITS clock so MTTR never mixes clock domains
+        self.clock: Callable[[], float] = time.perf_counter
 
     def _ckpt_path(self, epoch: int) -> str:
         return os.path.join(self.config.directory, f"ckpt-{epoch:08d}")
@@ -259,13 +307,30 @@ class CheckpointManager:
             error, self._pending_error = self._pending_error, None
             raise error
 
-    def restore_latest(self) -> Optional[Tuple[int, Any, Dict[str, Any]]]:
+    def latest(self) -> Optional[Tuple[int, Any, Dict[str, Any]]]:
+        """The newest VALID checkpoint, scanning newest->oldest.  A cut
+        that fails validation/decoding (torn write, bit flip, crash
+        mid-commit) is quarantined (``<dir>.corrupt`` — kept for
+        forensics, invisible to future scans) and the scan falls back to
+        the previous one; only when NO valid checkpoint exists does this
+        return None.  The self-healing contract resilient_fit rides: a
+        corrupted newest checkpoint costs replayed steps, never the
+        run."""
         self.wait()
-        epochs = self.list_epochs()
-        if not epochs:
-            return None
-        state, meta = load_pytree(self._ckpt_path(epochs[-1]))
-        return int(meta["epoch"]), state, meta
+        for epoch in reversed(self.list_epochs()):
+            path = self._ckpt_path(epoch)
+            try:
+                state, meta = load_pytree(path)
+            except CorruptStateError:
+                quarantine(path)
+                continue
+            self.last_restore_at = self.clock()
+            self.last_restored_step = int(meta["epoch"])
+            return int(meta["epoch"]), state, meta
+        return None
+
+    def restore_latest(self) -> Optional[Tuple[int, Any, Dict[str, Any]]]:
+        return self.latest()
 
     def _gc(self) -> None:
         keep = self.config.max_to_keep

@@ -502,8 +502,15 @@ def _iterate_hosted(body: BodyFn, initial_state, provider: _DataProvider,
     side: dict = {}
     epoch = start_epoch
     terminated_reason = "max_epochs"
+    from ..robustness.faults import fault_point
+
     try:
         while config.max_epochs is None or epoch < config.max_epochs:
+            # fault seam: lets the chaos suite kill a hosted iteration
+            # mid-run at a chosen epoch even when the data is static
+            # (stream sources are instead wrapped at the pull —
+            # robustness.FaultPlan.wrap_source)
+            fault_point("iterate.epoch")
             epoch_data = provider(epoch)
             if provider.exhausted:
                 terminated_reason = "stream_end"

@@ -1211,27 +1211,44 @@ def sgd_fit_mixed(loss_fn: LossFn, dense_features: np.ndarray,
                        float(params["b"]), planned_impl=impl), loss_log
 
 
-def _reader_for_epoch(make_reader: Callable, epoch: int):
+def _reader_for_epoch(make_reader: Callable, epoch: int,
+                      retry_policy=None):
     """Call the per-epoch reader factory, passing ``epoch=`` when the
     factory accepts it.  Per-epoch shuffled readers
     (``data.datacache.ShuffledCacheReader``) need the ACTUAL epoch number
     — a call-counting closure would desynchronize on checkpoint resume,
     which restarts mid-training at an arbitrary epoch.  Zero-arg
-    factories keep working unchanged."""
-    try:
-        sig = inspect.signature(make_reader)
-    except (TypeError, ValueError):
+    factories keep working unchanged.
+
+    ``retry_policy`` wraps the returned reader so transient pull
+    failures retry with backoff.  The wrap happens HERE — at the raw
+    reader, below the fit's generator adapters — because a generator
+    that propagates an exception is dead forever: retrying above one
+    would turn a healed transient into a silently truncated epoch
+    (``robustness.retry.RetryingIterator``)."""
+
+    def build():
+        try:
+            sig = inspect.signature(make_reader)
+        except (TypeError, ValueError):
+            return make_reader()
+        for p in sig.parameters.values():
+            # only an explicitly named, keyword-passable `epoch` opts in:
+            # a bare **kwargs factory must NOT be force-fed an argument it
+            # merely forwards, and a positional-only `epoch` cannot take
+            # the keyword call
+            if p.name == "epoch" and p.kind in (
+                    inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                    inspect.Parameter.KEYWORD_ONLY):
+                return make_reader(epoch=epoch)
         return make_reader()
-    for p in sig.parameters.values():
-        # only an explicitly named, keyword-passable `epoch` opts in:
-        # a bare **kwargs factory must NOT be force-fed an argument it
-        # merely forwards, and a positional-only `epoch` cannot take
-        # the keyword call
-        if p.name == "epoch" and p.kind in (
-                inspect.Parameter.POSITIONAL_OR_KEYWORD,
-                inspect.Parameter.KEYWORD_ONLY):
-            return make_reader(epoch=epoch)
-    return make_reader()
+
+    reader = build()
+    if retry_policy is None:
+        return reader
+    from ...robustness.retry import RetryingIterator
+
+    return RetryingIterator(reader, retry_policy)
 
 
 def _has_cursor(reader) -> bool:
@@ -1279,7 +1296,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                       ell_heavy_cap: int = 16,
                       checkpoint=None,
                       checkpoint_every_steps: int = 0,
-                      resume: bool = False
+                      resume: bool = False,
+                      retry_policy=None
                       ) -> Tuple[LinearState, list]:
     """Out-of-core variant of :func:`sgd_fit`: the dataset never has to fit
     in host RAM or HBM (the Criteo-1TB shape, BASELINE.md north star).
@@ -1414,7 +1432,21 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     batch: the reader is re-seeked (``seek``/``batch_rows`` protocol — the
     ``DataCacheReader`` surface — or by skipping batches) and the epoch
     continues as if never interrupted — deterministic-replay exactness is
-    asserted in tests/test_checkpoint.py.
+    asserted in tests/test_checkpoint.py.  Checkpoint cuts are validated
+    (CRC manifest + commit marker): on resume a torn/corrupt newest cut
+    is quarantined and the fit falls back to the previous valid one
+    (``CheckpointManager.latest()``); ``robustness.resilient_fit`` wraps
+    this fit to make the whole crash->restore->replay loop automatic.
+
+    **Retry** (``retry_policy``, a ``robustness.retry.RetryPolicy``):
+    each epoch's reader is wrapped in a ``RetryingIterator`` — the wrap
+    sits at the RAW reader, below the fit's generator adapters, so a
+    healed transient can never kill the stream — and classified-
+    transient pull failures cost a backoff sleep on the prefetch reader
+    thread instead of the epoch; fatal errors still propagate (and then
+    checkpoint-based recovery is the healing layer, not retry).  The
+    reader must not consume a batch on a failed pull, or be idempotent
+    at the failed position (seekable readers are).
     """
     from ...parallel.mesh import local_axis_multiple
 
@@ -1718,13 +1750,13 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         reader = None
         if block_mode is None and cache_decoded in (True, "auto") \
                 and config.max_epochs > 1:
-            reader = _reader_for_epoch(make_reader, epoch)
+            reader = _reader_for_epoch(make_reader, epoch, retry_policy)
             block_mode = (getattr(reader, "epoch_varying", False)
                           and hasattr(reader, "block_order")
                           and hasattr(reader, "batch_rows"))
         if block_mode and cache_decoded in (True, "auto"):
             if reader is None:
-                reader = _reader_for_epoch(make_reader, epoch)
+                reader = _reader_for_epoch(make_reader, epoch, retry_policy)
             if block_cache is None:
                 block_cache = DecodedReplayCache(
                     decoded_ram_budget if decoded_ram_budget is not None
@@ -1786,7 +1818,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                 # recorded epoch's; on mismatch drop the cache and decode
                 # normally.  (``cache_decoded=True`` skips the probe — the
                 # caller owns the determinism guarantee.)
-                reader = _reader_for_epoch(make_reader, epoch)
+                reader = _reader_for_epoch(make_reader, epoch, retry_policy)
                 probe_it = iter(reader)
                 probe_first = next(probe_it, None)
                 probe_mismatch = False
@@ -1831,7 +1863,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                 source = (("dec", t) for t in replay_cache.replay())
             else:
                 if reader is None:
-                    reader = _reader_for_epoch(make_reader, epoch)
+                    reader = _reader_for_epoch(make_reader, epoch, retry_policy)
                 if epoch == start_epoch and skip_steps:
                     # fast-forward to the checkpointed cursor
                     reader = _seek_or_skip(reader, skip_steps)
@@ -1882,6 +1914,10 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                     workers=prefetch_workers,
                     put_workers=prefetch_put_workers, stats=prefetch_stats,
                     chunks=W):
+                # (retry_policy wraps the READER, not this pipeline: the
+                # source here is a generator chain, which dies on a
+                # propagated exception — a pipeline-level retry of it
+                # would read StopIteration and silently truncate)
                 if loss_sum is None:
                     loss_sum = jnp.zeros((), jnp.float32)
                 params, loss_sum = chunk_step(params, loss_sum, chunk, mask)

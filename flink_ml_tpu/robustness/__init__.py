@@ -1,0 +1,58 @@
+"""Fault-injection harness + self-healing training/serving.
+
+The reference's fault-tolerance story is exactly-once over a cyclic
+dataflow — coordinator-aligned checkpoints plus a log of in-flight
+feedback records (``checkpoint/Checkpoints.java:43-211``).  Our
+TPU-native equivalents (epoch-cut checkpoints, the window log,
+chunk-boundary cuts) assumed clean I/O; this package makes failure a
+first-class, *injectable*, tested input to the whole stack:
+
+- :mod:`.faults` — a seedable, deterministic :class:`FaultPlan` that
+  injects transient read errors, torn/corrupted writes, ENOSPC, and
+  simulated crashes at chosen invocation indices (same seed, same
+  faults — every recovery test is reproducible);
+- :mod:`.durability` — per-file CRC32 manifests + an atomic commit
+  marker for checkpoint/stage directories, so a torn or bit-flipped
+  save is *detected* instead of silently restored;
+- :mod:`.retry` — exponential-backoff :class:`RetryPolicy` with
+  retryable-vs-fatal classification (deterministic schedule under an
+  injected clock), adopted by prefetch source pulls, registry loads,
+  and WAL appends;
+- :mod:`.supervisor` — :func:`resilient_fit`, the self-healing driver:
+  on a (injected or real) recoverable failure it restores from the
+  newest *valid* checkpoint (corrupt ones are quarantined), replays
+  the source/WAL past the cursor, and continues — final params
+  bit-exact vs the uninterrupted run (tests/test_faults.py).
+"""
+
+from .faults import (
+    FaultPlan,
+    InjectedCrash,
+    InjectedDiskFullError,
+    InjectedTransientError,
+    corrupt_file,
+    fault_point,
+)
+from .durability import (
+    COMMIT_MARKER,
+    MANIFEST_NAME,
+    CorruptStateError,
+    commit_dir,
+    is_committed,
+    quarantine,
+    verify_dir,
+    write_commit_marker,
+    write_manifest,
+)
+from .retry import RetryPolicy, default_classify, retry_call
+from .supervisor import RecoveryEvent, RecoveryReport, resilient_fit
+
+__all__ = [
+    "FaultPlan", "InjectedCrash", "InjectedDiskFullError",
+    "InjectedTransientError", "corrupt_file", "fault_point",
+    "COMMIT_MARKER", "MANIFEST_NAME", "CorruptStateError", "commit_dir",
+    "is_committed",
+    "quarantine", "verify_dir", "write_commit_marker", "write_manifest",
+    "RetryPolicy", "default_classify", "retry_call",
+    "RecoveryEvent", "RecoveryReport", "resilient_fit",
+]

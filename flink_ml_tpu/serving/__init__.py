@@ -16,7 +16,11 @@ requests while bounding tail latency:
 - :mod:`.endpoint` — the serve loop wiring them together, with
   per-endpoint ``MetricGroup`` gauges (queue depth, fill ratio, p50/p99
   latency, requests/sec, shed count),
-- :mod:`.metrics` — the latency/throughput instrumentation.
+- :mod:`.metrics` — the latency/throughput instrumentation, plus the
+  endpoint ``health`` gauge (SERVING/DEGRADED) and rollback counter the
+  self-healing hot-swap drives (``endpoint.hot_swap(path)`` — a deploy
+  that fails load/warm-up rolls back to the live generation and keeps
+  serving; see ``flink_ml_tpu/robustness/``).
 
 Quick start::
 
@@ -31,7 +35,8 @@ Quick start::
 from .batcher import MicroBatcher, ServingOverloadedError, ServingRequest
 from .endpoint import ServingEndpoint, serve_model
 from .executor import ServableModel, make_servable
-from .metrics import LatencyTracker, ServingMetrics
+from .metrics import (HEALTH_DEGRADED, HEALTH_SERVING, LatencyTracker,
+                      ServingMetrics)
 from .registry import DeployedModel, ModelRegistry
 
 __all__ = [
@@ -39,5 +44,6 @@ __all__ = [
     "ServingEndpoint", "serve_model",
     "ServableModel", "make_servable",
     "LatencyTracker", "ServingMetrics",
+    "HEALTH_SERVING", "HEALTH_DEGRADED",
     "DeployedModel", "ModelRegistry",
 ]

@@ -62,6 +62,17 @@ class ServingEndpoint:
         ``endpoint.registry.deploy(name, new_version)``."""
         return self._registry
 
+    def hot_swap(self, model, **deploy_kwargs):
+        """Self-healing hot-swap: deploy ``model`` as the next generation
+        with ``rollback=True`` — a failed load/warm-up (corrupt
+        directory, injected fault) keeps the live generation serving,
+        flips THIS endpoint's health gauge to DEGRADED and bumps its
+        rollback counter, and returns the incumbent.  In-flight and
+        concurrent requests are untouched either way (the publish point
+        is one reference assignment that never happens on failure)."""
+        return self._registry.deploy(self._name, model, rollback=True,
+                                     metrics=self.metrics, **deploy_kwargs)
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ServingEndpoint":
         deployed = self._registry.current(self._name)   # raises if absent
@@ -165,11 +176,13 @@ def serve_model(model: Any, example: Table, *, name: str = "default",
     """One-call serving for a single fitted model: build a registry,
     deploy + warm the model, start the endpoint.  Hot-swap later versions
     with ``endpoint.registry.deploy(name, new_model)``."""
-    registry = ModelRegistry()
+    metrics = ServingMetrics()
+    registry = ModelRegistry(metrics=metrics)
     registry.deploy(name, model, example,
                     max_batch_rows=max_batch_rows, **servable_kwargs)
     endpoint = ServingEndpoint(registry, name,
                                max_batch_rows=max_batch_rows,
                                max_wait_ms=max_wait_ms,
-                               queue_capacity=queue_capacity)
+                               queue_capacity=queue_capacity,
+                               metrics=metrics)
     return endpoint.start()

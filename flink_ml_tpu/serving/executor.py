@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data.table import Table
+from ..robustness.faults import fault_point
 from ..utils.padding import (
     DEFAULT_BUCKET_CAP,
     DEFAULT_MIN_BUCKET,
@@ -114,6 +115,7 @@ class ServableModel:
     def predict(self, table: Table) -> Table:
         """Serve one (micro-)batch: returns the transform output for
         exactly ``table``'s rows, computed at the padded bucket shape."""
+        fault_point("serving.predict")
         out = self._run(table)
         if self.output_cols:
             out = out.select(*self.output_cols)
@@ -138,6 +140,7 @@ class ServableModel:
         the endpoint only reports ready once steady state is retrace-free.
         Runs on the deploying thread — OFF the serving path, so a hot-swap
         warms the incoming version while the old one keeps serving."""
+        fault_point("serving.warm_up")
         for bucket in self.buckets:
             self._run(self._tiled_example(bucket))
         self._ready = True

@@ -19,7 +19,15 @@ import numpy as np
 
 from ..utils.metrics import MetricGroup
 
-__all__ = ["LatencyTracker", "ServingMetrics"]
+__all__ = ["LatencyTracker", "ServingMetrics", "HEALTH_SERVING",
+           "HEALTH_DEGRADED"]
+
+#: Endpoint health states (the ``health`` gauge).  SERVING = the live
+#: generation is the intended one; DEGRADED = the newest deploy failed
+#: and traffic is riding the rolled-back previous generation — correct
+#: answers, stale model, page the operator.
+HEALTH_SERVING = "SERVING"
+HEALTH_DEGRADED = "DEGRADED"
 
 
 class LatencyTracker:
@@ -72,6 +80,10 @@ class ServingMetrics:
         self.requests = self.group.counter("requests")
         self.batches = self.group.counter("batches")
         self.shed = self.group.counter("shed")
+        #: failed hot-swaps healed by rolling back to the live generation
+        self.rollbacks = self.group.counter("rollbacks")
+        self._health = self.group.gauge("health")
+        self._health.set(HEALTH_SERVING)
         self._queue_depth = self.group.gauge("queue_depth")
         self._fill = self.group.gauge("batch_fill_ratio")
         self._p50 = self.group.gauge("latency_p50_ms")
@@ -87,6 +99,24 @@ class ServingMetrics:
     def on_shed(self, queue_depth: int) -> None:
         self.shed.inc()
         self._queue_depth.set(queue_depth)
+
+    @property
+    def health(self) -> str:
+        return self._health.value
+
+    def on_rollback(self) -> None:
+        """A hot-swap failed load/warm-up and the registry rolled back:
+        the endpoint keeps serving the previous generation (no dropped
+        requests) but the intended model never went live — DEGRADED
+        until a deploy succeeds."""
+        self.rollbacks.inc()
+        self._health.set(HEALTH_DEGRADED)
+
+    def on_deploy(self, generation: int) -> None:
+        """A deploy published: record the live generation and (re)assert
+        SERVING — a successful swap heals a DEGRADED endpoint."""
+        self._generation.set(generation)
+        self._health.set(HEALTH_SERVING)
 
     def on_submit(self, queue_depth: int) -> None:
         self._queue_depth.set(queue_depth)

@@ -15,10 +15,21 @@ batch runs on one fully-warmed version; in-flight batches keep their
 (old) servable alive by plain reference and finish on it.  No request can
 ever observe a half-loaded model, because nothing is published before
 ``warm_up`` returns.
+
+Self-healing (robustness PR): ``deploy(..., rollback=True)`` turns a
+failed load/warm-up — corrupt model directory, injected fault, any
+exception before the publish point — into a ROLLBACK: the incumbent
+generation stays live (it was never unpublished, so zero requests are
+dropped), the health gauge flips SERVING -> DEGRADED and the rollback
+counter increments (``serving/metrics.py``), and the incumbent is
+returned so callers observe which generation is actually serving.  A
+``retry_policy`` additionally retries classified-transient *load*
+failures before declaring the deploy failed.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
@@ -26,10 +37,13 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 from ..data.table import Table
+from ..robustness.faults import fault_point
 from ..utils import persist
 from .executor import ServableModel, make_servable
 
 __all__ = ["DeployedModel", "ModelRegistry"]
+
+log = logging.getLogger("flink_ml_tpu.robustness")
 
 
 @dataclass(frozen=True)
@@ -46,38 +60,79 @@ class DeployedModel:
 class ModelRegistry:
     """name -> live :class:`DeployedModel`, swapped atomically."""
 
-    def __init__(self, servable_factory: Optional[Callable] = None):
+    def __init__(self, servable_factory: Optional[Callable] = None,
+                 metrics: Optional[Any] = None,
+                 retry_policy: Optional[Any] = None):
         self._factory = servable_factory or make_servable
         self._live: Dict[str, DeployedModel] = {}
         self._lock = threading.Lock()
+        #: a serving.metrics.ServingMetrics — health/rollback accounting
+        self.metrics = metrics
+        #: a robustness.retry.RetryPolicy for transient LOAD failures
+        self._retry = retry_policy
+
+    def _load(self, path: str):
+        fault_point("serving.load")
+        return persist.load_stage(path)
 
     def deploy(self, name: str, model: Any,
                example: Optional[Table] = None,
+               rollback: bool = False,
+               metrics: Optional[Any] = None,
                **servable_kwargs: Any) -> DeployedModel:
         """Load (if ``model`` is a saved-stage path), adapt, warm up, then
         atomically publish as the next generation of ``name``.  On a
         re-deploy, ``example`` (and servable config) may be omitted to
-        inherit the incumbent's."""
-        if isinstance(model, str):
-            source = model
-            model = persist.load_stage(model)
-        else:
-            source = f"<memory:{type(model).__name__}>"
-        incumbent = self._live.get(name)
-        if example is None:
-            if incumbent is None:
-                raise ValueError(
-                    f"first deploy of {name!r} needs an example Table "
-                    "(the request schema warm-up tiles over)")
-            example = incumbent.servable.example
-            if not servable_kwargs:
-                servable_kwargs = {
-                    "max_batch_rows": incumbent.servable.max_batch_rows,
-                    "min_bucket": incumbent.servable.min_bucket,
-                    "output_cols": incumbent.servable.output_cols,
-                }
-        servable = self._factory(model, example, **servable_kwargs)
-        servable.warm_up()   # off the serving path: old version still live
+        inherit the incumbent's.
+
+        ``rollback=True``: a failure anywhere before the publish point
+        (unloadable/corrupt directory, warm-up crash) keeps the incumbent
+        generation live and RETURNS it instead of raising — health flips
+        to DEGRADED and the rollback counter increments when a
+        ``ServingMetrics`` is attached.  With no incumbent there is
+        nothing to roll back to, so the failure raises either way.
+
+        ``metrics`` overrides the registry-level ``ServingMetrics`` for
+        THIS deploy — with several endpoints sharing one registry, each
+        hot-swap accounts health/rollback on the endpoint that asked for
+        it, not on whichever endpoint touched the registry first."""
+        metrics = metrics if metrics is not None else self.metrics
+        try:
+            if isinstance(model, str):
+                source = model
+                model = (self._retry.call(self._load, model)
+                         if self._retry is not None else self._load(model))
+            else:
+                source = f"<memory:{type(model).__name__}>"
+            incumbent = self._live.get(name)
+            if example is None:
+                if incumbent is None:
+                    raise ValueError(
+                        f"first deploy of {name!r} needs an example Table "
+                        "(the request schema warm-up tiles over)")
+                example = incumbent.servable.example
+                if not servable_kwargs:
+                    servable_kwargs = {
+                        "max_batch_rows": incumbent.servable.max_batch_rows,
+                        "min_bucket": incumbent.servable.min_bucket,
+                        "output_cols": incumbent.servable.output_cols,
+                    }
+            servable = self._factory(model, example, **servable_kwargs)
+            servable.warm_up()   # off the serving path: old version live
+        except Exception as exc:  # noqa: BLE001 — rollback decision below
+            with self._lock:
+                incumbent = self._live.get(name)
+            if not rollback or incumbent is None:
+                raise
+            # ROLLBACK: nothing was ever published, so the incumbent kept
+            # serving throughout — zero dropped requests by construction.
+            log.warning(
+                "hot-swap of %r failed (%r); rolled back to generation "
+                "%d (%s)", name, exc, incumbent.generation,
+                incumbent.source)
+            if metrics is not None:
+                metrics.on_rollback()
+            return incumbent
         with self._lock:
             previous = self._live.get(name)
             generation = (previous.generation + 1) if previous else 1
@@ -85,6 +140,8 @@ class ModelRegistry:
                                      generation=generation, source=source,
                                      deployed_at=time.time())
             self._live[name] = deployed   # THE swap: one dict assignment
+        if metrics is not None:
+            metrics.on_deploy(generation)
         return deployed
 
     def current(self, name: str) -> DeployedModel:

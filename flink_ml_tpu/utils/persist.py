@@ -18,10 +18,13 @@ import importlib
 import json
 import os
 import time
+import zipfile
 
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
+
+from ..robustness.faults import fault_point
 
 __all__ = [
     "save_metadata",
@@ -89,9 +92,22 @@ def save_metadata(stage, path: str, extra: Optional[Dict[str, Any]] = None) -> N
 
 
 def load_metadata(path: str, expected_class: Optional[type] = None) -> Dict[str, Any]:
-    """Mirror of ``ReadWriteUtils.loadMetadata`` (``ReadWriteUtils.java:139-166``)."""
-    with open(os.path.join(path, "metadata")) as f:
-        meta = json.load(f)
+    """Mirror of ``ReadWriteUtils.loadMetadata`` (``ReadWriteUtils.java:139-166``).
+
+    A truncated/corrupted ``metadata`` file surfaces as the same
+    diagnosable ``IOError`` (path + hint) that ``_resolve_saved_class``
+    established — never a raw ``json.JSONDecodeError`` the registry's
+    hot-load path can't act on."""
+    meta_path = os.path.join(path, "metadata")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except json.JSONDecodeError as exc:
+        raise IOError(
+            f"Metadata at {meta_path} is not valid JSON ({exc}); the "
+            "file is truncated or corrupted — the save was interrupted "
+            "or the bytes were damaged; re-save the stage or restore "
+            "from a valid copy") from exc
     if expected_class is not None:
         expected = _class_name(expected_class)
         if meta.get("className") != expected:
@@ -150,16 +166,37 @@ def get_data_path(path: str) -> str:
 
 def save_model_arrays(path: str, name: str, arrays: Dict[str, np.ndarray]) -> str:
     """Write model data as a compressed npz under ``{path}/data/{name}.npz``
-    (replaces the reference's Kryo FileSink, ``KMeansModel.java:184-199``)."""
+    (replaces the reference's Kryo FileSink, ``KMeansModel.java:184-199``).
+
+    Atomic like :func:`save_metadata` (write tmp -> ``os.replace``): a
+    crash mid-save can never leave a half-written model the serving
+    registry would try to load."""
     data_dir = get_data_path(path)
     os.makedirs(data_dir, exist_ok=True)
     out = os.path.join(data_dir, f"{name}.npz")
-    np.savez(out, **{k: np.asarray(v) for k, v in arrays.items()})
+    tmp = os.path.join(data_dir, f".{name}.npz.tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, **{k: np.asarray(v) for k, v in arrays.items()})
+        f.flush()
+    fault_point("persist.write", tmp)
+    os.replace(tmp, out)
     return out
 
 
 def load_model_arrays(path: str, name: str) -> Dict[str, np.ndarray]:
     """Inverse of :func:`save_model_arrays`
-    (replaces ``KMeansModel.load``'s Kryo FileSource, ``KMeansModel.java:202-213``)."""
-    with np.load(os.path.join(get_data_path(path), f"{name}.npz")) as data:
-        return {k: data[k] for k in data.files}
+    (replaces ``KMeansModel.load``'s Kryo FileSource, ``KMeansModel.java:202-213``).
+
+    The npz's zip CRCs are a free integrity check: truncated or
+    bit-flipped model data raises a diagnosable ``IOError`` naming the
+    file — never silently-wrong params."""
+    npz = os.path.join(get_data_path(path), f"{name}.npz")
+    try:
+        with np.load(npz) as data:
+            return {k: data[k] for k in data.files}
+    except (zipfile.BadZipFile, EOFError, ValueError, KeyError) as exc:
+        raise IOError(
+            f"Model data at {npz} failed to load ({exc!r}); the file is "
+            "truncated or corrupted — the save was interrupted or the "
+            "bytes were damaged; re-save the model or restore from a "
+            "valid copy") from exc

@@ -1,0 +1,60 @@
+"""Tier-1 wiring for scripts/check_atomic_writes.py (ISSUE 5 satellite):
+the durable modules' open-for-write sites must all be write-tmp ->
+os.replace atomic, and the checker itself must actually catch the
+violation pattern (a guard that can't fail guards nothing)."""
+
+import importlib.util
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "check_atomic_writes",
+    os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                 "check_atomic_writes.py"))
+caw = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(caw)
+
+
+def test_durable_modules_are_atomic():
+    problems = []
+    for module in caw.DURABLE_MODULES:
+        problems += caw.check_file(os.path.join(caw.REPO, module))
+    assert problems == []
+
+
+def test_checker_flags_naked_write(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import os\n"
+        "def save(path, data):\n"
+        "    with open(path, 'wb') as f:\n"
+        "        f.write(data)\n")
+    problems = caw.check_file(str(bad))
+    assert len(problems) == 1 and "half-written" in problems[0]
+
+
+def test_checker_accepts_tmp_then_replace(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text(
+        "import os\n"
+        "def save(path, data):\n"
+        "    tmp = path + '.tmp'\n"
+        "    with open(tmp, 'wb') as f:\n"
+        "        f.write(data)\n"
+        "    os.replace(tmp, path)\n"
+        "def save_into_dir(dirpath, data):\n"
+        "    tmp = dirpath + '.tmp'\n"
+        "    with open(os.path.join(tmp, 'part'), 'w') as f:\n"
+        "        f.write(data)\n"
+        "    os.replace(tmp, dirpath)\n")
+    assert caw.check_file(str(good)) == []
+
+
+def test_checker_ignores_reads(tmp_path):
+    src = tmp_path / "reads.py"
+    src.write_text(
+        "def load(path):\n"
+        "    with open(path) as f:\n"
+        "        a = f.read()\n"
+        "    with open(path, 'rb') as f:\n"
+        "        return a, f.read()\n")
+    assert caw.check_file(str(src)) == []

@@ -11,6 +11,10 @@ coupled pytree exercised by tests/test_widedeep.py's own save/load) and
 the pure-function parallel primitives (no Stage surface).
 """
 
+import glob
+import os
+import shutil
+
 import numpy as np
 import pytest
 
@@ -340,3 +344,71 @@ def test_algo_operator_save_load_roundtrip(name, factory, table_fn,
     op.save(path)
     loaded = type(op).load(path)
     _tables_equal(before, loaded.transform(table)[0])
+
+
+# -- corruption sweep (robustness PR): a damaged save must raise a
+#    DIAGNOSABLE IOError naming the file — never silently-wrong params.
+#    One representative estimator per stage family (the data layouts all
+#    funnel through persist.load_model_arrays/load_metadata, so one case
+#    per family covers the family's load path).
+
+_CORRUPTION_FAMILIES = [
+    c for c in ESTIMATOR_CASES
+    if c[0] in ("LogisticRegression",   # linear family
+                "KMeans",               # clustering
+                "GBTClassifier",        # tree ensembles
+                "StandardScaler",       # feature/scaler
+                "StringIndexer",        # string-domain
+                "PCA",                  # decomposition
+                "ALS")                  # recommendation
+]
+
+_CORRUPTIONS = ["truncate_npz", "flip_npz", "missing_metadata",
+                "truncated_metadata"]
+
+_fitted_saves = {}   # name -> pristine saved dir (fit once per family)
+
+
+def _pristine_save(name, factory, table_fn, tmp_path_factory):
+    if name not in _fitted_saves:
+        base = tmp_path_factory.mktemp(f"corrupt_{name}")
+        model = factory().fit(table_fn())
+        path = str(base / "model")
+        model.save(path)
+        _fitted_saves[name] = path
+    return _fitted_saves[name]
+
+
+def _apply_corruption(path, mode):
+    from flink_ml_tpu.robustness import corrupt_file
+
+    if mode in ("truncate_npz", "flip_npz"):
+        npzs = sorted(glob.glob(os.path.join(path, "data", "*.npz")))
+        assert npzs, f"{path} has no model data to corrupt"
+        corrupt_file(npzs[0],
+                     mode="torn" if mode == "truncate_npz" else "flip")
+    elif mode == "missing_metadata":
+        os.unlink(os.path.join(path, "metadata"))
+    elif mode == "truncated_metadata":
+        meta = os.path.join(path, "metadata")
+        data = open(meta, "rb").read()
+        open(meta, "wb").write(data[:len(data) // 2])
+    else:  # pragma: no cover
+        raise AssertionError(mode)
+
+
+@pytest.mark.parametrize("mode", _CORRUPTIONS)
+@pytest.mark.parametrize("name,factory,table_fn,model_cls",
+                         _CORRUPTION_FAMILIES,
+                         ids=[c[0] for c in _CORRUPTION_FAMILIES])
+def test_corrupted_save_raises_diagnosable_ioerror(
+        name, factory, table_fn, model_cls, mode, tmp_path,
+        tmp_path_factory):
+    pristine = _pristine_save(name, factory, table_fn, tmp_path_factory)
+    path = str(tmp_path / "model")
+    shutil.copytree(pristine, path)
+    _apply_corruption(path, mode)
+    with pytest.raises(IOError) as ei:
+        model_cls.load(path)
+    # diagnosable: the error names the offending path (or file inside it)
+    assert path.split(os.sep)[-2] in str(ei.value) or path in str(ei.value)
