@@ -1511,42 +1511,89 @@ def bench_serving(results: dict) -> None:
 
 
 def bench_comm(results: dict) -> None:
-    """Gradient-reduction comm leg (comm_metric_version 1): per-step
-    gradient bytes-on-wire, compression ratio, and the exact-vs-topk
-    step-time A/B at the bench LR gradient shape (2^20 f32 weights),
-    through the SAME ``parallel/grad_reduce.py`` reducer the trainers
-    adopt.  On a single-device run there IS no gradient reduction, so the
-    measured fields are nulled, not faked (the ``gap_closed_fraction``
-    convention from the chunked-dispatch leg); the analytic payload
-    accounting — pure shape math, device-independent — still reports
-    under ``accounting`` so the compression ratio the wire format implies
-    is always on record (indices + values for topk, int8 payload + f32
-    scales for int8, counted honestly by ``payload_bytes``)."""
+    """Gradient-reduction comm leg (comm_metric_version 2): per-step
+    gradient bytes-on-wire, compression ratio, the exact-vs-topk
+    step-time A/B, the **adaptive step-time vs bytes-on-wire Pareto**
+    (>= 3 operating points, bytes computed from each run's REALIZED
+    per-leaf rungs), and the **overlap A/B** — blocking vs one-step-stale
+    bucketed reduction at equal density through the SAME
+    ``_linear_update_reduced`` scan the trainers run — at the bench LR
+    gradient shape (2^20 f32 weights), through the SAME
+    ``parallel/grad_reduce.py`` reducer the trainers adopt.
+
+    On a single-device run there IS no gradient reduction, so every
+    measured field is nulled, not faked (the ``gap_closed_fraction``
+    convention); the analytic artifacts — payload accounting with the
+    hierarchical leg's ICI/DCN fabric split, and the ``bucket_plan``
+    (bucket count, bytes per bucket, per-leaf chosen density) — are pure
+    shape math and always report, so CPU smoke runs still validate the
+    schedule.  Pareto points on single-device runs keep their analytic
+    ``bytes_on_wire`` (initial-rung accounting) with ``step_ms`` null.
+    Both variants of every A/B are compiled AND warmed before either is
+    timed — first-call compile/collective-channel setup used to pollute
+    whichever variant ran first."""
     import jax
     import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from flink_ml_tpu.parallel import grad_reduce as GR
     from flink_ml_tpu.parallel.collectives import shard_map_fn
     from flink_ml_tpu.parallel.grad_reduce import GradReduceConfig
-    from flink_ml_tpu.parallel.mesh import device_mesh
+    from flink_ml_tpu.parallel.mesh import device_mesh, replicate
 
     d = 1 << 16 if _smoke() else 1 << 20
     density = 0.1
+    buckets = 8
     like = {"w": np.zeros((d,), np.float32)}
+    ladder = (0.01, 0.05, density, "exact")
+    adaptive_points = {
+        # target = tolerated residual/grad norm ratio: thrifty tolerates a
+        # hot residual (descends the ladder), faithful pushes toward exact
+        "adaptive_thrifty": GradReduceConfig(
+            mode="topk", density=density, bucket_count=buckets,
+            adaptive=True, adaptive_target=4.0, density_ladder=ladder),
+        "adaptive_balanced": GradReduceConfig(
+            mode="topk", density=density, bucket_count=buckets,
+            adaptive=True, adaptive_target=1.0, density_ladder=ladder),
+        "adaptive_faithful": GradReduceConfig(
+            mode="topk", density=density, bucket_count=buckets,
+            adaptive=True, adaptive_target=0.25, density_ladder=ladder),
+    }
+    overlap_cfg = GradReduceConfig(mode="topk", density=density,
+                                   bucket_count=buckets, overlap=True)
     comm: dict = {
-        "comm_metric_version": 1,
+        "comm_metric_version": 2,
         "config": f"dense LR grad d={d}, topk density={density}, "
-                  "int8 block 256",
+                  f"int8 block 256, {buckets} buckets, ladder {ladder}",
         "accounting": {
             "topk": GR.payload_bytes(
                 like, GradReduceConfig(mode="topk", density=density)),
             "int8": GR.payload_bytes(
                 like, GradReduceConfig(mode="int8", block_size=256)),
+            # hierarchical: the two fabrics report separately — the
+            # compressed DCN hop vs the exact ICI scatter/gather bytes
+            "hier_topk": GR.payload_bytes(
+                like, GradReduceConfig(mode="topk", density=density,
+                                       dcn_axis="dcn"), ici_size=4),
         },
+        # the analytic schedule, published even when timing legs skip
+        "bucket_plan": GR.bucket_report(like, overlap_cfg),
     }
     n_dev = jax.device_count()
     comm["devices"] = n_dev
+
+    def pareto_point(label, cfg, step_ms, rungs):
+        acc = GR.payload_bytes(like, cfg, rungs=rungs)
+        point = {"label": label, "step_ms": step_ms,
+                 "bytes_on_wire": acc["total_wire_bytes"],
+                 "compression_ratio": acc["compression_ratio"]}
+        if cfg.adaptive:
+            point["per_leaf_density"] = [
+                e["density"] for e in
+                GR.bucket_report(like, cfg, rungs=rungs)["per_leaf"]]
+        return point
+
     if n_dev < 2:
         # no reduction happens on one device — null, don't fake
         comm["grad_bytes_on_wire_exact"] = None
@@ -1554,6 +1601,20 @@ def bench_comm(results: dict) -> None:
         comm["compression_ratio"] = None
         comm["step_ms_exact"] = None
         comm["step_ms_topk"] = None
+        comm["overlap_step_ms_blocking"] = None
+        comm["overlap_step_ms_overlapped"] = None
+        comm["overlap_speedup"] = None
+        # analytic bytes still publish for every point (step_ms null),
+        # exact/topk references included so smoke output keeps the
+        # baselines the adaptive points compare against
+        comm["pareto"] = [
+            pareto_point("exact", GradReduceConfig(mode="exact"),
+                         None, None),
+            pareto_point("topk",
+                         GradReduceConfig(mode="topk", density=density),
+                         None, None),
+        ] + [pareto_point(label, cfg, None, None)
+             for label, cfg in adaptive_points.items()]
         results["notes"]["comm"] = comm
         return
 
@@ -1574,28 +1635,116 @@ def bench_comm(results: dict) -> None:
     def gen(key):
         return jax.random.normal(key, (n_dev, d), jnp.float32)
 
-    def time_mode(cfg, trials=8):
+    # compile + warm EVERY variant before timing ANY (satellite fix:
+    # first-call compile and collective-channel setup polluted whichever
+    # variant ran first)
+    reduce_cfgs = {"exact": GradReduceConfig(mode="exact"),
+                   "topk": GradReduceConfig(mode="topk", density=density),
+                   **adaptive_points}
+    warmed, states = {}, {}
+    for label, cfg in reduce_cfgs.items():
         fn = build(cfg)
         state = GR.init_state(cfg, {"w": jnp.zeros((d,), jnp.float32)},
                               n_dev)
-        # warm the compile, then time distinct inputs (relay-cache rule)
-        g0 = gen(jax.random.PRNGKey(0))
-        red, state = fn(g0, state)
+        red, state = fn(gen(jax.random.PRNGKey(0)), state)
         np.asarray(red)  # completion fence
+        warmed[label], states[label] = fn, state
+
+    def time_mode(label, trials=8):
+        fn, state = warmed[label], states[label]
         t0 = time.perf_counter()
         for i in range(1, trials + 1):
             red, state = fn(gen(jax.random.PRNGKey(i)), state)
         np.asarray(red)
+        states[label] = state
         return 1e3 * (time.perf_counter() - t0) / trials
 
-    exact_cfg = GradReduceConfig(mode="exact")
-    topk_cfg = GradReduceConfig(mode="topk", density=density)
-    comm["step_ms_exact"] = round(time_mode(exact_cfg), 3)
-    comm["step_ms_topk"] = round(time_mode(topk_cfg), 3)
+    comm["step_ms_exact"] = round(time_mode("exact"), 3)
+    comm["step_ms_topk"] = round(time_mode("topk"), 3)
     acc = comm["accounting"]["topk"]
     comm["grad_bytes_on_wire_exact"] = acc["dense_bytes"]
     comm["grad_bytes_on_wire_topk"] = acc["compressed_bytes"]
     comm["compression_ratio"] = acc["compression_ratio"]
+
+    # ---- adaptive Pareto: measured step time vs analytic bytes at the
+    # run's REALIZED rungs (fetched from the evolved reducer state)
+    pareto = [pareto_point("exact", reduce_cfgs["exact"],
+                           comm["step_ms_exact"], None),
+              pareto_point("topk", reduce_cfgs["topk"],
+                           comm["step_ms_topk"], None)]
+    for label, cfg in adaptive_points.items():
+        ms = round(time_mode(label, trials=16), 3)
+        rungs = np.asarray(states[label]["rung"])[0]
+        pareto.append(pareto_point(label, cfg, ms, rungs))
+    comm["pareto"] = pareto
+
+    # ---- overlap A/B: blocking vs one-step-stale bucketed reduction at
+    # EQUAL density, through the real _linear_update_reduced scan (the
+    # program every dense data-parallel fit runs)
+    from flink_ml_tpu.models.common.losses import LOSSES
+    from flink_ml_tpu.models.common.sgd import (
+        GR_STATE_KEY,
+        SGDConfig,
+        _linear_update_reduced,
+    )
+
+    steps = 8
+    batch = n_dev * (64 if _smoke() else 256)
+    d_ov = 1 << 12 if _smoke() else 1 << 14
+    rng = np.random.default_rng(11)
+    Xw = jax.device_put(
+        rng.normal(size=(steps, batch, d_ov)).astype(np.float32) / 16.0,
+        NamedSharding(mesh, P(None, "data", None)))
+    yv = jax.device_put(
+        (rng.random(size=(steps, batch)) > 0.5).astype(np.float32),
+        NamedSharding(mesh, P(None, "data")))
+    wv = jax.device_put(np.ones((steps, batch), np.float32),
+                        NamedSharding(mesh, P(None, "data")))
+
+    def build_loop(gr_cfg):
+        scfg = SGDConfig(learning_rate=0.1, grad_reduce=gr_cfg)
+        update = _linear_update_reduced(LOSSES["logistic"], scfg, mesh)
+
+        def run(params):
+            def step(p, i):
+                return update(p, Xw[i], yv[i], wv[i])
+
+            return lax.scan(step, params,
+                            jnp.arange(steps, dtype=jnp.int32))
+
+        init = replicate({
+            "w": jnp.zeros((d_ov,), jnp.float32),
+            "b": jnp.zeros((), jnp.float32),
+            GR_STATE_KEY: GR.init_state(
+                gr_cfg, {"w": jnp.zeros((d_ov,), jnp.float32),
+                         "b": jnp.zeros((), jnp.float32)}, n_dev),
+        }, mesh)
+        return jax.jit(run), init
+
+    blocking_cfg = GradReduceConfig(mode="topk", density=density,
+                                    bucket_count=buckets)
+    loops = {}
+    for label, cfg in (("blocking", blocking_cfg),
+                       ("overlapped", overlap_cfg)):
+        run, init = build_loop(cfg)
+        params, losses = run(init)       # compile + warm both first
+        np.asarray(losses)
+        loops[label] = (run, init)
+
+    def time_loop(label, trials=4):
+        run, init = loops[label]
+        t0 = time.perf_counter()
+        for _ in range(trials):
+            params, losses = run(init)
+        np.asarray(losses)
+        return 1e3 * (time.perf_counter() - t0) / (trials * steps)
+
+    comm["overlap_step_ms_blocking"] = round(time_loop("blocking"), 3)
+    comm["overlap_step_ms_overlapped"] = round(time_loop("overlapped"), 3)
+    comm["overlap_speedup"] = (
+        round(comm["overlap_step_ms_blocking"]
+              / comm["overlap_step_ms_overlapped"], 3)
+        if comm["overlap_step_ms_overlapped"] else None)
     results["notes"]["comm"] = comm
 
 

@@ -265,7 +265,9 @@ def sgd_fit_params(loss_fn: LossFn, features: np.ndarray, labels: np.ndarray,
         k: init_params[k] for k in ("w", "b")}, n_dev_red)
     params, loss_log = _run_minibatch_epochs(update, (X, y, w), init_params,
                                              steps, config, mesh)
-    params.pop(GR_STATE_KEY, None)
+    gr_state = params.pop(GR_STATE_KEY, None)
+    if gr_state is not None and GR.wants_overlap(gr):
+        params = _apply_drain(params, gr_state, config)
     return params, loss_log
 
 
@@ -386,7 +388,16 @@ def _linear_update_reduced(loss_fn: LossFn, config: SGDConfig, mesh):
     ``_mixed_update_sharded`` stance), the l2 term applies as exact
     decay on the replicated weight AFTER the reduction (it needs no
     communication, so it is never compressed), and l1 stays the proximal
-    soft-threshold."""
+    soft-threshold.
+
+    ``config.grad_reduce.overlap`` swaps in the one-step-stale pipelined
+    schedule (:func:`~flink_ml_tpu.parallel.grad_reduce.pipelined_reduce`):
+    this step's gradient goes into the carried ``pending`` buffer and the
+    PREVIOUS step's pending is reduced and applied — the reduction's
+    bucket collectives have no data dependence on this step's
+    forward/backward, so XLA's scheduler overlaps them.  The fit-end
+    drain of the last pending gradient (+ EF residual) is the adopting
+    fits' job (:func:`_apply_drain`)."""
     from ...parallel import grad_reduce as GR
 
     gr = config.grad_reduce
@@ -394,6 +405,7 @@ def _linear_update_reduced(loss_fn: LossFn, config: SGDConfig, mesh):
     reg, alpha = config.reg, config.elastic_net
     l2 = reg * (1.0 - alpha)
     l1 = reg * alpha
+    overlap = GR.wants_overlap(gr)
     axes, _, batch_axis = _grad_reduce_layout(gr, mesh)
     x_spec = P(batch_axis, None)
     v_spec = P(batch_axis)
@@ -411,8 +423,12 @@ def _linear_update_reduced(loss_fn: LossFn, config: SGDConfig, mesh):
         r = r * (denom_local / denom)
         grads = {"w": jnp.tensordot(xb, r, axes=((0,), (0,))),
                  "b": jnp.sum(r, axis=0)}
-        red, new_state = GR.reduce_gradients(
-            grads, GR.squeeze_state(gr_state), gr)
+        if overlap:
+            red, new_state = GR.pipelined_reduce(
+                grads, GR.squeeze_state(gr_state), gr)
+        else:
+            red, new_state = GR.reduce_gradients(
+                grads, GR.squeeze_state(gr_state), gr)
         if l2 > 0:
             value = value + 0.5 * l2 * jnp.sum(jnp.square(w))
             w = w * (1.0 - lr * l2)
@@ -434,6 +450,34 @@ def _linear_update_reduced(loss_fn: LossFn, config: SGDConfig, mesh):
         return {"w": w, "b": b, GR_STATE_KEY: st}, value
 
     return update
+
+
+def _apply_drain(params: dict, gr_state: dict, config: SGDConfig) -> dict:
+    """Fit-end drain of an overlapped run: one exact host-side apply of
+    the participant-summed ``pending`` gradient plus the EF residual
+    (:func:`~flink_ml_tpu.parallel.grad_reduce.drain_pending`) — the
+    same decay / step / prox / bias tail as one in-loop update, so the
+    overlapped trajectory ends with zero unsent mass instead of dropping
+    its last gradient.  Runs AFTER the final loss log entry; checkpoint
+    cuts never include it (resume re-runs the fit and drains at ITS
+    end, which is what keeps crash+resume bit-exact vs uninterrupted)."""
+    from ...parallel import grad_reduce as GR
+
+    drain = GR.drain_pending(gr_state)
+    lr = config.learning_rate
+    reg, alpha = config.reg, config.elastic_net
+    l2 = reg * (1.0 - alpha)
+    l1 = reg * alpha
+    w = np.asarray(params["w"], np.float32)
+    if l2 > 0:
+        w = w * np.float32(1.0 - lr * l2)
+    w = w - np.float32(lr) * drain["w"]
+    if l1 > 0:
+        w = np.sign(w) * np.maximum(np.abs(w) - lr * l1, 0.0)
+    b = np.asarray(params["b"], np.float32)
+    if config.fit_intercept:
+        b = b - np.float32(lr) * drain["b"]
+    return {**params, "w": jnp.asarray(w), "b": jnp.asarray(b)}
 
 
 # TPU random access is per-DMA-transaction bound, not bandwidth bound:
@@ -1727,6 +1771,14 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                 # The checkpointed run had already hit the tol stop:
                 # continuing would train past the converged answer.
                 host = jax.device_get(saved["params"])
+                host_gr = host.pop(GR_STATE_KEY, None)
+                if gr is not None and host_gr is not None:
+                    from ...parallel import grad_reduce as GR
+
+                    if GR.wants_overlap(gr):
+                        # the original run drained at ITS return; a
+                        # converged resume must reproduce that return
+                        host = _apply_drain(host, host_gr, config)
                 return LinearState(np.asarray(host["w"], np.float64),
                                    float(host["b"]),
                                    planned_impl=stream_impl), loss_log
@@ -1968,7 +2020,12 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         if stop:
             break
     params = _fetch_replicated(params)
-    params.pop(GR_STATE_KEY, None)
+    final_gr_state = params.pop(GR_STATE_KEY, None)
+    if gr is not None and final_gr_state is not None:
+        from ...parallel import grad_reduce as GR
+
+        if GR.wants_overlap(gr):
+            params = _apply_drain(params, final_gr_state, config)
     if stream_info is not None:
         stream_info["impl"] = stream_impl
         stream_info["steps_per_dispatch"] = W

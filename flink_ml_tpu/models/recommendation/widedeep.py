@@ -799,7 +799,14 @@ def build_sharded_train_step(mesh, d_dense: int, vocab_sizes, emb_dim: int,
     returns a 6-tuple with its initial value appended:
     ``(train_step, params, opt, opt_state, shard_batch_fn, gr_state0)``
     with ``train_step(params, opt_state, gr_state, dense, cat_ids,
-    labels, mask) -> (params, opt_state, gr_state, loss)``."""
+    labels, mask) -> (params, opt_state, gr_state, loss)``.
+
+    ``grad_reduce.bucket_count`` / ``adaptive`` route the dense-tower
+    reduce through the bucketed transport and the per-leaf density
+    ladder; ``overlap=True`` makes the dense-tower grads one-step stale
+    (the pending buffer rides ``gr_state``) while table grads stay
+    fresh — callers that want the final pending applied run one extra
+    step on a zero-mask batch."""
     rng = np.random.default_rng(0)
     params = init_params(rng, d_dense, vocab_sizes, emb_dim, hidden)
 
@@ -906,10 +913,19 @@ def _build_reduced_sharded_step(mesh, gr, sharded_params, opt, opt_state,
     # axis bound): this XLA's partitioner aborts on lax.top_k inside a
     # manual-subgroup (auto) region, and the dense-tower leaves carry no
     # model sharding anyway, so model peers just replicate the reduce.
+    # With overlap the PREVIOUS step's pending dense-tower grads are
+    # reduced (their bucket collectives carry no dependence on this
+    # step's forward/backward) and this step's land in the pending
+    # buffer; table grads stay fresh — mixing a one-step-stale dense
+    # tower with fresh tables is absorbed by the EF residual like the
+    # sparsification itself.
     def reduce_local(g_stacked, gr_state):
         g_l = jax.tree_util.tree_map(lambda a: a[0], g_stacked)
-        red, new_state = GR.reduce_gradients(
-            g_l, GR.squeeze_state(gr_state), gr)
+        st = GR.squeeze_state(gr_state)
+        if GR.wants_overlap(gr):
+            red, new_state = GR.pipelined_reduce(g_l, st, gr)
+        else:
+            red, new_state = GR.reduce_gradients(g_l, st, gr)
         return red, GR.unsqueeze_state(new_state)
 
     reduce_fn = shard_map_fn(

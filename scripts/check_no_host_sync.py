@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Static guard: no host syncs inside scan-body / step functions
+(ISSUE 6 satellite).
+
+The communication-overlap schedule (``grad_reduce.pipelined_reduce``)
+only buys anything if the device queue stays full: a host
+synchronization inside a step body — ``block_until_ready``,
+``jax.device_get``, ``np.asarray`` on a traced value, ``.item()`` —
+fences the dispatch stream and silently destroys the overlap (and the
+chunked-dispatch amortization of PR 1 with it).  This pass parses every
+module under ``flink_ml_tpu/models/`` and ``flink_ml_tpu/parallel/``
+and flags those calls inside functions that are (a) named like step /
+scan bodies (``update``, ``batch_step``, ``device_fn``, ``*_step``,
+``*_body``, ...) or (b) passed as the scanned body to ``lax.scan`` /
+``masked_chunk_scan`` anywhere in the module — nested helper defs
+inside a step body are covered by the AST walk.
+
+Heuristic by design (AST names, not tracing), tuned to this repo's
+idiom: step bodies are pure device math here, so ANY of the four calls
+is a finding.  A justified host sync goes in the explicit allowlist
+below with a reason.
+
+Run with no arguments to check the two subsystems; pass explicit paths
+to check those instead.  Exit 0 = clean, 1 = findings (one line each).
+Wired into tier-1 via tests/test_no_host_sync.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: every step/scan body in these trees must stay host-sync-free
+SCAN_ROOTS = [
+    "flink_ml_tpu/models",
+    "flink_ml_tpu/parallel",
+]
+
+#: (file, function) pairs exempt with a reason — currently none.
+ALLOWLIST: dict = {}
+
+#: function names that ARE step/scan bodies in this repo's idiom
+STEP_NAMES = {
+    "update", "batch_step", "scan_step", "chunk_step", "device_fn",
+    "train_step", "epoch_body", "body", "step",
+}
+
+STEP_SUFFIXES = ("_step", "_body", "_update")
+
+#: callables whose first argument is a scanned/stepped body
+SCAN_CALLEES = {"scan", "masked_chunk_scan", "while_loop", "fori_loop"}
+
+
+def _call_name(call: ast.Call):
+    """Trailing name of the called expression: ``lax.scan`` -> "scan"."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _is_step_name(name: str) -> bool:
+    return name in STEP_NAMES or name.endswith(STEP_SUFFIXES)
+
+
+def _scanned_body_names(tree: ast.AST) -> set:
+    """Names passed as the body argument to scan-family calls anywhere in
+    the module (``lax.scan(step_fn, ...)``, ``fori_loop(lo, hi, body,
+    ...)``) — those functions are step bodies regardless of their name."""
+    out = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name not in SCAN_CALLEES or not node.args:
+            continue
+        args = node.args
+        cands = [args[2]] if name == "fori_loop" and len(args) >= 3 \
+            else args[:2] if name == "while_loop" else [args[0]]
+        for cand in cands:
+            if isinstance(cand, ast.Name):
+                out.add(cand.id)
+    return out
+
+
+def _sync_finding(call: ast.Call):
+    """The host-sync kind of a call, or None."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "block_until_ready":
+            return "block_until_ready"
+        if f.attr == "item":
+            return ".item()"
+        if f.attr == "device_get":
+            return "jax.device_get"
+        if f.attr == "asarray" and isinstance(f.value, ast.Name) \
+                and f.value.id in ("np", "numpy", "onp"):
+            return "np.asarray"
+    elif isinstance(f, ast.Name) and f.id == "device_get":
+        return "device_get"
+    return None
+
+
+def check_file(path: str) -> list:
+    src = open(path).read()
+    tree = ast.parse(src, filename=path)
+    rel = os.path.relpath(path, REPO)
+    scanned = _scanned_body_names(tree)
+    problems = []
+    seen: set = set()
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not (_is_step_name(fn.name) or fn.name in scanned):
+            continue
+        if (rel, fn.name) in ALLOWLIST:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _sync_finding(node)
+            if kind and (rel, node.lineno) not in seen:
+                seen.add((rel, node.lineno))
+                problems.append(
+                    f"{rel}:{node.lineno}: {kind} inside step body "
+                    f"{fn.name}() — a host sync here fences the dispatch "
+                    "stream and destroys comm/compute overlap")
+    return problems
+
+
+def _module_paths() -> list:
+    paths = []
+    for root in SCAN_ROOTS:
+        for dirpath, _dirnames, filenames in os.walk(
+                os.path.join(REPO, root)):
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    paths.append(os.path.join(dirpath, f))
+    return paths
+
+
+def main(argv) -> int:
+    paths = argv or _module_paths()
+    problems = []
+    for path in paths:
+        problems += check_file(path)
+    for p in problems:
+        print(f"HOST SYNC IN STEP BODY: {p}")
+    if not problems:
+        print(f"host-sync discipline clean ({len(paths)} module(s))")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -22,8 +22,11 @@ def test_repo_bench_schema_is_drift_free():
 
 def test_version_bump_without_doc_update_is_caught(tmp_path, monkeypatch):
     src = open(check.BENCH).read()
-    bumped = src.replace('"comm_metric_version": 1,',
-                         '"comm_metric_version": 2,')
+    # bump whatever comm version the live bench carries (version-agnostic:
+    # the r11 2-bump broke the old literal form of this test)
+    cur = int(check.bench_metric_versions(src)["comm_metric_version"])
+    bumped = src.replace(f'"comm_metric_version": {cur},',
+                         f'"comm_metric_version": {cur + 1},')
     assert bumped != src
     fake = tmp_path / "bench.py"
     fake.write_text(bumped)

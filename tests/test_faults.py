@@ -421,6 +421,56 @@ def test_resilient_fit_survives_crash_plus_corrupt_newest_checkpoint(
     np.testing.assert_array_equal(log, ref_log)
 
 
+def test_resilient_fit_adaptive_overlap_crash_resumes_bitexact(tmp_path):
+    """ISSUE 6 acceptance extension of the pair above: the crash now
+    lands MID adaptive-window with bucketed one-step-stale overlap — so
+    recovery must round-trip the pending gradient buffer, the per-leaf
+    rung/EMA policy state, and the EF residual (all riding the params
+    carry under GR_STATE_KEY), and the fit-end drain must apply the same
+    mass either way.  Any dropped or re-zeroed piece of the schedule
+    state breaks bit-exactness."""
+    from flink_ml_tpu.data.datacache import DataCacheReader
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+    from flink_ml_tpu.parallel.grad_reduce import GradReduceConfig
+
+    cache = _lr_cache(tmp_path, "c_adaptive")
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=4, tol=0.0,
+                    grad_reduce=GradReduceConfig(
+                        mode="topk", density=0.25, bucket_count=3,
+                        overlap=True, adaptive=True, adaptive_window=3))
+    kw = dict(num_features=8, config=cfg, cache_decoded=False,
+              steps_per_dispatch=2)
+    # 6 batches/epoch, cuts every 2 steps, window 3: global step 17 (the
+    # crash) sits mid-window — tick 16 of window [15, 18)
+
+    def reader():
+        return DataCacheReader(cache, batch_rows=256)
+
+    ref_state, ref_log = sgd_fit_outofcore(logistic_loss, reader, **kw)
+
+    plan = (FaultPlan(seed=5)
+            .inject("checkpoint.write", at=8, kind="torn")
+            .inject("source.pull", at=17, kind="crash"))
+    report = RecoveryReport()
+    with plan:
+        state, log = resilient_fit(
+            sgd_fit_outofcore, logistic_loss,
+            lambda: plan.wrap_source(reader()),
+            checkpoint=CheckpointConfig(str(tmp_path / "ck_a"),
+                                        max_to_keep=4),
+            checkpoint_every_steps=2, max_restarts=2,
+            backoff=RetryPolicy(base_delay=0.01, sleep=lambda s: None),
+            report=report, **kw)
+
+    assert report.restarts == 1 and report.recovered
+    assert any(n.endswith(".corrupt")
+               for n in os.listdir(tmp_path / "ck_a"))
+    np.testing.assert_array_equal(state.coefficients, ref_state.coefficients)
+    assert state.intercept == ref_state.intercept
+    np.testing.assert_array_equal(log, ref_log)
+
+
 def test_outofcore_reader_retry_heals_transient_exactly(tmp_path):
     """sgd_fit_outofcore(retry_policy=): a transient reader failure
     mid-epoch costs a backoff, not the fit — and the healed run's params

@@ -540,6 +540,385 @@ def test_widedeep_sharded_topk_trains():
                    jax.device_get(grs)["ef"]))
 
 
+# ------------------------------------------ r11: buckets / adaptive / overlap
+
+
+def test_r11_config_validation():
+    with pytest.raises(ValueError, match="bucket_count"):
+        GradReduceConfig(bucket_count=-1)
+    with pytest.raises(ValueError, match="topk-family"):
+        GradReduceConfig(mode="int8", adaptive=True)
+    with pytest.raises(ValueError, match="ladder rungs"):
+        GradReduceConfig(mode="topk", adaptive=True,
+                         density_ladder=(0.1, "fp4"))
+    with pytest.raises(ValueError, match="not in"):
+        GradReduceConfig(mode="topk", adaptive=True,
+                         density_ladder=(0.1, 1.5))
+    with pytest.raises(ValueError, match="requires adaptive"):
+        GradReduceConfig(mode="topk", density_ladder=(0.1,))
+    # the exact-mode fence: overlap is ignored, not an error
+    assert not GR.wants_overlap(GradReduceConfig(mode="exact", overlap=True))
+    assert GR.wants_overlap(GradReduceConfig(mode="topk", overlap=True))
+    assert not GR.wants_overlap(None)
+    assert GR.effective_ladder(
+        GradReduceConfig(mode="topk", density=0.2, adaptive=True)) == \
+        (0.05, 0.2, "exact")
+
+
+def test_bucket_plan_balanced_and_covering():
+    like = {"w": np.zeros((1000,), np.float32),
+            "b": np.zeros((), np.float32),
+            "v": np.zeros((7, 3), np.float32)}
+    cfg = GradReduceConfig(mode="topk", bucket_count=8)
+    plan = GR.plan_buckets(like, cfg)
+    sizes = plan.bucket_sizes
+    assert len(sizes) == 8 and sum(sizes) == plan.total == 1022
+    assert max(sizes) - min(sizes) <= 1          # size-balanced
+    # ranges tile [0, total) exactly, in order
+    pos = 0
+    for lo, hi in plan.ranges:
+        assert lo == pos and hi > lo
+        pos = hi
+    assert pos == plan.total
+    # every bucket knows exactly the leaves it overlaps
+    for (lo, hi), leaves in zip(plan.ranges, plan.bucket_leaves):
+        for li in leaves:
+            assert plan.leaf_offsets[li] < hi and \
+                plan.leaf_offsets[li + 1] > lo
+    # bucket_count=0 (adaptive-only) degrades to one bucket per leaf
+    # (sorted-dict-key leaf order: b (1), v (21), w (1000))
+    per_leaf = GR.plan_buckets(like, GradReduceConfig(
+        mode="topk", adaptive=True))
+    assert per_leaf.ranges == ((0, 1), (1, 22), (22, 1022))
+
+
+def test_exact_bucketed_bit_identical():
+    """Acceptance: exact mode with bucketing enabled is bit-identical to
+    the legacy blocking psum path (psum is elementwise — the transport
+    cut cannot change a single bit)."""
+    g = _grads(seed=9, d=100)
+    red0, _, _ = _run_reduce(g, GradReduceConfig(mode="exact"), {"data": 8})
+    red1, _, _ = _run_reduce(g, GradReduceConfig(mode="exact",
+                                                 bucket_count=4),
+                             {"data": 8})
+    np.testing.assert_array_equal(red0["w"], red1["w"])
+    np.testing.assert_array_equal(red0["b"], red1["b"])
+
+
+def test_sgd_exact_bucketed_fit_bit_identical():
+    """The full-fit A/B of the same fence: an exact bucketed fit equals
+    the no-config legacy fit bit-for-bit."""
+    from flink_ml_tpu.models.common.losses import LOSSES
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit
+
+    X, y = _lr_problem()
+    mesh = device_mesh({"data": 8})
+    kw = dict(learning_rate=0.5, max_epochs=20, tol=0, global_batch_size=64)
+    s0, log0 = sgd_fit(LOSSES["logistic"], X, y, None, SGDConfig(**kw), mesh)
+    s1, log1 = sgd_fit(
+        LOSSES["logistic"], X, y, None,
+        SGDConfig(**kw, grad_reduce=GradReduceConfig(
+            mode="exact", bucket_count=8, overlap=True)), mesh)
+    np.testing.assert_array_equal(s0.coefficients, s1.coefficients)
+    assert s0.intercept == s1.intercept
+    np.testing.assert_array_equal(log0, log1)
+
+
+def test_topk_bucketed_ef_lossless():
+    """EF bookkeeping invariant survives the bucket transport: summed
+    over participants, gradient mass == reduced + carried residual
+    (nothing dropped at bucket boundaries, only deferred)."""
+    cfg = GradReduceConfig(mode="topk", density=0.1, bucket_count=4)
+    g = _grads(seed=10, d=100)
+    red, state, _ = _run_reduce(g, cfg, {"data": 8})
+    total_grad = np.asarray(g["w"]).sum(0)
+    total_res = np.asarray(state["ef"]["w"]).sum(0)
+    np.testing.assert_allclose(red["w"] + total_res, total_grad, atol=1e-5)
+
+
+def test_topk_bucketed_selects_per_bucket():
+    """Bucketed top-k selects k per BUCKET: gradient mass concentrated in
+    one bucket's span still leaves every other bucket sending its own
+    top-k (the SparCML variable-rate posture the planner feeds)."""
+    cfg = GradReduceConfig(mode="topk", density=0.25, bucket_count=2)
+    w = np.zeros((8, 64), np.float32)
+    w[:, :32] = 100.0        # bucket 0 span dominates
+    w[:, 32:] = 0.001        # per-leaf topk would never send these
+    g = {"w": jnp.asarray(w)}
+    red, _, _ = _run_reduce(g, cfg, {"data": 8})
+    # bucket 1 (elements 32:64) sent its own top-k despite the tiny values
+    assert np.abs(red["w"][32:]).max() > 0
+
+
+def test_adaptive_rung_follows_residual_ratio():
+    """The policy loop: diffuse gradients (top-k residual dominates)
+    climb the ladder toward exact; spiky gradients (residual ~ 0)
+    descend toward the cheap rung.  Selection only moves at window
+    boundaries."""
+    cfg = GradReduceConfig(mode="topk", density=0.1, adaptive=True,
+                           adaptive_window=2)
+    rung0 = GR._initial_rung(cfg)
+
+    # diffuse: random normal at density 0.1 keeps ~90% of the mass unsent
+    state = None
+    for seed in range(6):
+        gi = _grads(seed=100 + seed, d=256)
+        _, state, _ = _run_reduce(gi, cfg, {"data": 8}, state=state)
+    rung = np.asarray(state["rung"])[0]
+    assert rung[1] > rung0            # the dense leaf climbed
+    assert int(np.asarray(state["tick"])[0]) == 6
+
+    # spiky: one huge coordinate per participant — top-k captures
+    # essentially everything, ratio ~ 0, the leaf descends
+    spiky = np.full((8, 256), 1e-6, np.float32)
+    spiky[:, 3] = 1e3
+    g = {"w": jnp.asarray(spiky), "b": jnp.asarray(np.ones(8, np.float32))}
+    state = None
+    for _ in range(6):
+        _, state, _ = _run_reduce(g, cfg, {"data": 8}, state=state)
+    rung = np.asarray(state["rung"])[0]
+    assert rung[1] < rung0
+
+
+def test_adaptive_exact_rung_clears_residual():
+    """A leaf pinned at the exact rung reduces exactly AND consumes the
+    whole accumulated residual (unsent == 0)."""
+    cfg = GradReduceConfig(mode="topk", density=0.1, adaptive=True,
+                           density_ladder=("exact",))
+    g = _grads(seed=12)
+    red, state, _ = _run_reduce(g, cfg, {"data": 8})
+    np.testing.assert_allclose(red["w"], np.asarray(g["w"]).sum(0),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(state["ef"]["w"]), 0.0, atol=1e-7)
+
+
+def test_adaptive_int8_rung_runs():
+    cfg = GradReduceConfig(mode="topk", density=0.1, adaptive=True,
+                           density_ladder=("int8",), block_size=16)
+    g = _grads(seed=13)
+    red, state, _ = _run_reduce(g, cfg, {"data": 8})
+    exact = np.asarray(g["w"]).sum(0)
+    scales = (np.abs(np.asarray(g["w"]).reshape(8, -1, 16)).max(axis=2)
+              / 127.0)
+    bound = np.repeat(scales.sum(0), 16) * (1.0 + 1e-6)
+    assert np.all(np.abs(red["w"] - exact) <= bound)
+    np.testing.assert_allclose(np.asarray(state["ef"]["w"]), 0.0, atol=1e-7)
+
+
+def test_pipelined_reduce_is_one_step_stale():
+    """pipelined_reduce returns the reduction of the PREVIOUS call's
+    gradient: call 1 reduces the zeros-initialized pending (a no-op),
+    call 2 reduces call 1's gradient."""
+    cfg = GradReduceConfig(mode="topk", density=1.0, overlap=True)
+    mesh = device_mesh({"data": 8})
+    g1, g2 = _grads(seed=14), _grads(seed=15)
+    state = GR.init_state(cfg, jax.tree_util.tree_map(lambda a: a[0], g1), 8)
+    dev_spec = P("data")
+
+    def body(g, st):
+        g_l = jax.tree_util.tree_map(lambda a: a[0], g)
+        red, new_st = GR.pipelined_reduce(g_l, GR.squeeze_state(st), cfg)
+        return (jax.tree_util.tree_map(lambda a: a[None], red),
+                GR.unsqueeze_state(new_st))
+
+    fn = jax.jit(shard_map_fn(body, mesh, in_specs=(dev_spec, dev_spec),
+                              out_specs=(dev_spec, dev_spec)))
+    red1, state = fn(g1, state)
+    np.testing.assert_allclose(np.asarray(red1["w"])[0], 0.0, atol=1e-7)
+    red2, state = fn(g2, state)
+    np.testing.assert_allclose(np.asarray(red2["w"])[0],
+                               np.asarray(g1["w"]).sum(0), atol=1e-5)
+    # the pending buffer now carries g2, and drain_pending recovers it
+    # (+ the empty residual) exactly
+    drain = GR.drain_pending(jax.device_get(state))
+    np.testing.assert_allclose(drain["w"], np.asarray(g2["w"]).sum(0),
+                               atol=1e-5)
+
+
+def test_sgd_overlap_topk_converges_to_dense():
+    """Acceptance: one-step-stale bucketed EF top-k at density 0.1 lands
+    within 1e-3 of the dense loss (the PR 3 tolerance) — the residual
+    absorbs the staleness like it absorbs the sparsification."""
+    from flink_ml_tpu.models.common.losses import LOSSES
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit
+
+    X, y = _lr_problem()
+    mesh = device_mesh({"data": 8})
+    kw = dict(learning_rate=0.2, max_epochs=200, tol=0,
+              global_batch_size=64)
+    _, log_dense = sgd_fit(LOSSES["logistic"], X, y, None, SGDConfig(**kw),
+                           mesh)
+    state, log_ov = sgd_fit(
+        LOSSES["logistic"], X, y, None,
+        SGDConfig(**kw, grad_reduce=GradReduceConfig(
+            mode="topk", density=0.1, bucket_count=4, overlap=True)), mesh)
+    assert abs(log_dense[-1] - log_ov[-1]) < 1e-3, (
+        f"dense {log_dense[-1]} vs overlapped {log_ov[-1]}")
+    assert np.isfinite(state.coefficients).all()
+
+
+def test_outofcore_overlap_adaptive_chunked_bit_exact_vs_w1(tmp_path):
+    """W=1 vs W=8 stay bit-exact with the whole r11 state — pending
+    buffer, rung/EMA/tick, EF residual — riding the donated carry (the
+    masked dead steps must freeze ALL of it)."""
+    from flink_ml_tpu.data.datacache import DataCacheReader
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    cache = _stream_cache(tmp_path)
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=2, tol=0.0,
+                    grad_reduce=GradReduceConfig(
+                        mode="topk", density=0.25, bucket_count=3,
+                        overlap=True, adaptive=True, adaptive_window=2))
+
+    def reader():
+        return DataCacheReader(cache, batch_rows=256)
+
+    s1, log1 = sgd_fit_outofcore(logistic_loss, reader, num_features=8,
+                                 config=cfg, steps_per_dispatch=1)
+    s8, log8 = sgd_fit_outofcore(logistic_loss, reader, num_features=8,
+                                 config=cfg, steps_per_dispatch=8)
+    assert s1.planned_impl == "dense-stream-reduced"
+    np.testing.assert_array_equal(s1.coefficients, s8.coefficients)
+    np.testing.assert_array_equal(log1, log8)
+
+
+def test_outofcore_overlap_checkpoint_roundtrip_exact(tmp_path):
+    """Crash + resume with overlap + adaptive + buckets reproduces the
+    uninterrupted run bit-for-bit: the pending gradient and the policy
+    state ride the checkpoint cut, and the fit-end drain applies the
+    same mass either way."""
+    from flink_ml_tpu.data.datacache import DataCacheReader
+    from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    cache = _stream_cache(tmp_path)
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=4, tol=0.0,
+                    grad_reduce=GradReduceConfig(
+                        mode="topk", density=0.25, bucket_count=3,
+                        overlap=True, adaptive=True, adaptive_window=3))
+
+    def reader():
+        return DataCacheReader(cache, batch_rows=256)
+
+    ref_state, ref_log = sgd_fit_outofcore(
+        logistic_loss, reader, num_features=8, config=cfg)
+
+    ck = CheckpointConfig(str(tmp_path / "ck"), max_to_keep=3)
+    _FailAfter.counter = 0
+    with pytest.raises(RuntimeError, match="injected"):
+        sgd_fit_outofcore(
+            logistic_loss, lambda: _FailAfter(reader(), 15),
+            num_features=8, config=cfg, cache_decoded=False,
+            checkpoint=ck, checkpoint_every_steps=2)
+    resumed_state, resumed_log = sgd_fit_outofcore(
+        logistic_loss, reader, num_features=8, config=cfg,
+        checkpoint=ck, checkpoint_every_steps=2, resume=True)
+    np.testing.assert_array_equal(resumed_state.coefficients,
+                                  ref_state.coefficients)
+    assert resumed_state.intercept == ref_state.intercept
+    np.testing.assert_array_equal(resumed_log, ref_log)
+
+
+def test_widedeep_bucketed_density1_matches_exact():
+    """Bucketed density-1.0 top-k sends every entry, so the bucket
+    transport must still reproduce the implicit-GSPMD step allclose."""
+    from flink_ml_tpu.models.recommendation.widedeep import (
+        build_sharded_train_step)
+
+    mesh = device_mesh({"data": 4, "model": 2})
+    vocab = [16, 12]
+    rng = np.random.default_rng(2)
+    B = 32
+    dense = rng.normal(size=(B, 3)).astype(np.float32)
+    cat = (np.stack([rng.integers(0, v, size=B) for v in vocab], 1)
+           + np.asarray([0, 16])).astype(np.int32)
+    labels = rng.integers(0, 2, size=B).astype(np.float32)
+    mask = np.ones(B, np.float32)
+
+    step_e, p_e, _, os_e, shard_e = build_sharded_train_step(
+        mesh, 3, vocab, 8, (16, 8))
+    batch = shard_e(dense, cat, labels, mask)
+    for _ in range(3):
+        p_e, os_e, loss_e = step_e(p_e, os_e, *batch)
+
+    step_c, p_c, _, os_c, shard_c, grs = build_sharded_train_step(
+        mesh, 3, vocab, 8, (16, 8),
+        grad_reduce=GradReduceConfig(mode="topk", density=1.0,
+                                     bucket_count=3))
+    batch_c = shard_c(dense, cat, labels, mask)
+    for _ in range(3):
+        p_c, os_c, grs, loss_c = step_c(p_c, os_c, grs, *batch_c)
+    np.testing.assert_allclose(float(loss_e), float(loss_c), rtol=1e-5,
+                               atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(p_e)),
+                    jax.tree_util.tree_leaves(jax.device_get(p_c))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_widedeep_overlap_adaptive_trains():
+    from flink_ml_tpu.models.recommendation.widedeep import (
+        build_sharded_train_step)
+
+    mesh = device_mesh({"data": 4, "model": 2})
+    vocab = [16, 12]
+    rng = np.random.default_rng(3)
+    B = 32
+    dense = rng.normal(size=(B, 3)).astype(np.float32)
+    cat = (np.stack([rng.integers(0, v, size=B) for v in vocab], 1)
+           + np.asarray([0, 16])).astype(np.int32)
+    labels = rng.integers(0, 2, size=B).astype(np.float32)
+    mask = np.ones(B, np.float32)
+
+    step, p, _, os_, shard, grs = build_sharded_train_step(
+        mesh, 3, vocab, 8, (16, 8),
+        grad_reduce=GradReduceConfig(mode="topk", density=0.1,
+                                     bucket_count=2, overlap=True,
+                                     adaptive=True, adaptive_window=3))
+    batch = shard(dense, cat, labels, mask)
+    losses = []
+    for _ in range(10):
+        p, os_, grs, loss = step(p, os_, grs, *batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert int(np.asarray(jax.device_get(grs)["tick"])[0]) == 10
+
+
+def test_payload_bytes_fabric_split_and_buckets():
+    like = {"w": np.zeros((1 << 20,), np.float32),
+            "b": np.zeros((), np.float32)}
+    # flat: total == compressed (one fabric)
+    flat = GR.payload_bytes(like, GradReduceConfig(mode="topk",
+                                                   density=0.1))
+    assert flat["total_wire_bytes"] == flat["compressed_bytes"]
+    # hierarchical: the two fabrics report separately and total sums them
+    hier = GR.payload_bytes(
+        like, GradReduceConfig(mode="topk", density=0.1, dcn_axis="dcn"),
+        ici_size=4)
+    assert hier["dcn_compressed_bytes"] == hier["compressed_bytes"]
+    assert hier["dcn_dense_bytes"] == hier["dense_bytes"]
+    assert hier["total_wire_bytes"] == \
+        hier["ici_bytes"] + hier["dcn_compressed_bytes"]
+    assert hier["dcn_compression_ratio"] >= 5.0
+    # bucketed accounting follows the transport's per-bucket k
+    bucketed = GR.payload_bytes(like, GradReduceConfig(
+        mode="topk", density=0.1, bucket_count=8))
+    assert bucketed["bucket_count"] == 8
+    assert bucketed["compression_ratio"] >= 5.0
+    # adaptive with realized rungs: exact rung pays dense bytes
+    cfg = GradReduceConfig(mode="topk", density=0.1, adaptive=True)
+    cheap = GR.payload_bytes(like, cfg, rungs=[0, 0])
+    dear = GR.payload_bytes(like, cfg, rungs=[2, 2])   # "exact" rung
+    assert cheap["compressed_bytes"] < dear["compressed_bytes"]
+    assert dear["compressed_bytes"] == dear["dense_bytes"]
+    rep = GR.bucket_report(like, cfg, rungs=[2, 0])
+    per_leaf = {e["leaf"]: e for e in rep["per_leaf"]}
+    assert per_leaf[0]["mode"] == "exact"
+    assert per_leaf[1]["density"] == 0.025
+
+
 # ---------------------------------------------------------- hosted iterate
 
 
@@ -590,3 +969,59 @@ def test_hosted_iterate_carries_reducer_state(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(jax.device_get(full.state["w"])),
         np.asarray(jax.device_get(resumed.state["w"])))
+
+
+def test_hosted_iterate_carries_r11_schedule_state(tmp_path):
+    """The r11 reducer state — pending overlap buffer, adaptive
+    rung/EMA/tick — is just more pytree leaves in the iterate state:
+    per-epoch checkpoints round-trip the whole schedule, so crash +
+    resume equals the uninterrupted run exactly (including which rung
+    each leaf sits on)."""
+    from flink_ml_tpu.iteration import (
+        IterationBodyResult,
+        IterationConfig,
+        iterate,
+    )
+    from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+
+    mesh = device_mesh({"data": 8})
+    cfg = GradReduceConfig(mode="topk", density=0.25, bucket_count=2,
+                           overlap=True, adaptive=True, adaptive_window=3)
+    d = 32
+    rng = np.random.default_rng(6)
+    data = jnp.asarray(rng.normal(size=(8, d)).astype(np.float32))
+    target = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
+    dev_spec = P("data")
+
+    def reduce_fn(w, st, x):
+        def body(w, st, x):
+            g = {"w": x[0] * (w - target)}
+            red, new_st = GR.pipelined_reduce(g, GR.squeeze_state(st), cfg)
+            return red["w"], GR.unsqueeze_state(new_st)
+
+        return shard_map_fn(body, mesh,
+                            in_specs=(P(), dev_spec, P("data", None)),
+                            out_specs=(P(), dev_spec))(w, st, x)
+
+    def epoch_body(state, epoch, x):
+        w, st = state["w"], state["gr"]
+        g, st = reduce_fn(w, st, x)
+        return IterationBodyResult({"w": w - 0.05 * g, "gr": st})
+
+    init = {"w": jnp.zeros((d,), jnp.float32),
+            "gr": GR.init_state(cfg, {"w": jnp.zeros((d,))}, 8)}
+    ck = str(tmp_path / "ck")
+    full = iterate(epoch_body, init, data, max_epochs=8,
+                   config=IterationConfig(mode="hosted"),
+                   checkpoint=CheckpointConfig(ck))
+    resumed = iterate(epoch_body, init, data, max_epochs=8,
+                      config=IterationConfig(mode="hosted"),
+                      checkpoint=CheckpointConfig(ck), resume=True)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(full.state["w"])),
+        np.asarray(jax.device_get(resumed.state["w"])))
+    for a, b in zip(
+            jax.tree_util.tree_leaves(jax.device_get(full.state["gr"])),
+            jax.tree_util.tree_leaves(jax.device_get(resumed.state["gr"]))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(np.asarray(jax.device_get(full.state["gr"]["tick"]))[0]) == 8

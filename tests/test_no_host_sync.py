@@ -1,0 +1,80 @@
+"""Tier-1 wiring for scripts/check_no_host_sync.py (ISSUE 6 satellite):
+step / scan-body functions in models/ and parallel/ must stay free of
+host synchronization — a ``block_until_ready`` / ``jax.device_get`` /
+``np.asarray`` / ``.item()`` inside the step body fences the dispatch
+stream and silently destroys the comm/compute overlap the bucketed
+reduction schedule builds — and the checker itself must actually catch
+each violation kind (a guard that can't fail guards nothing)."""
+
+import importlib.util
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "check_no_host_sync",
+    os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                 "check_no_host_sync.py"))
+chs = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(chs)
+
+
+def test_step_bodies_are_host_sync_free():
+    problems = []
+    for path in chs._module_paths():
+        problems += chs.check_file(path)
+    assert problems == []
+
+
+def test_checker_flags_every_sync_kind(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "import jax\n"
+        "def batch_step(params, xb):\n"
+        "    loss = (xb @ params).sum()\n"
+        "    host = np.asarray(loss)\n"
+        "    v = loss.item()\n"
+        "    jax.device_get(params)\n"
+        "    loss.block_until_ready()\n"
+        "    return params, host + v\n")
+    problems = chs.check_file(str(bad))
+    kinds = {k for k in ("np.asarray", ".item()", "jax.device_get",
+                         "block_until_ready")
+             if any(k in p for p in problems)}
+    assert len(problems) == 4 and len(kinds) == 4
+
+
+def test_checker_covers_scanned_bodies_by_reference(tmp_path):
+    """A function passed to lax.scan is a step body whatever its name."""
+    bad = tmp_path / "scanned.py"
+    bad.write_text(
+        "import jax\n"
+        "def oddly_named(carry, xs):\n"
+        "    v = carry.item()\n"
+        "    return carry, v\n"
+        "def run(xs, carry):\n"
+        "    return jax.lax.scan(oddly_named, carry, xs)\n")
+    problems = chs.check_file(str(bad))
+    assert len(problems) == 1 and ".item()" in problems[0]
+
+
+def test_checker_covers_nested_defs_inside_step(tmp_path):
+    bad = tmp_path / "nested.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "def epoch_body(state, epoch, data):\n"
+        "    def inner(x):\n"
+        "        return np.asarray(x)\n"
+        "    return inner(state)\n")
+    problems = chs.check_file(str(bad))
+    assert len(problems) == 1 and "np.asarray" in problems[0]
+
+
+def test_checker_ignores_non_step_functions(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text(
+        "import numpy as np\n"
+        "def decode(batch):\n"
+        "    return np.asarray(batch)\n"
+        "def fetch(params):\n"
+        "    return params.item()\n")
+    assert chs.check_file(str(good)) == []
