@@ -533,6 +533,24 @@ def prefetch_to_device(batches: Iterable[Any], *, depth: int = 2,
             yield item
     finally:
         stop.set()
+        # Quiesce the pipeline threads before returning control: an
+        # abandoned-but-alive reader still holds the SOURCE iterator, and
+        # a supervised fit (robustness.resilient_fit) re-attempts over
+        # the same live source — a zombie reader would race the new
+        # attempt's pulls (observed: windows silently consumed between
+        # WAL replay and the live tail).  The join is bounded: a reader
+        # parked inside a blocking live-source pull cannot be
+        # interrupted — it dies at its next stop check; sources feeding
+        # supervised fits should deliver or fail, not park forever.
+        for t in threads:
+            t.join(timeout=5.0)
+            if t.is_alive():
+                import logging
+
+                logging.getLogger("flink_ml_tpu.robustness").warning(
+                    "prefetch thread %s still alive after close "
+                    "(blocked in a live-source pull?); it will exit at "
+                    "its next stop check", t.name)
         if metric_group is not None:
             st.publish(metric_group)
         if workers > 1 or put_workers > 1:

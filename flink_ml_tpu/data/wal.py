@@ -53,7 +53,7 @@ from .table import Table
 from ..robustness.durability import CorruptStateError
 from ..robustness.faults import fault_point
 
-__all__ = ["WindowLog"]
+__all__ = ["WindowLog", "WindowBatchReader"]
 
 log = logging.getLogger("flink_ml_tpu.robustness")
 
@@ -187,3 +187,86 @@ class WindowLog:
                     os.unlink(os.path.join(self._dir, name))
                 except OSError:
                     pass
+
+
+class WindowBatchReader:
+    """Adapts a :class:`WindowLog` (or any iterable of window Tables)
+    into the ``sgd_fit_outofcore`` reader protocol for CONTINUOUS
+    training: one window = one optimizer batch, every window carrying
+    exactly ``batch_rows`` rows (the training-stream contract — a ragged
+    window raises instead of silently padding, because the WAL replay
+    and the offline-equivalence acceptance both assume a fixed grid).
+
+    Speaks the checkpoint fast-forward half of the cursor protocol
+    (``seek`` + ``batch_rows``): ``seek(k * batch_rows)`` maps the row
+    cursor back onto the log's WINDOW cursor via ``WindowLog.restore``,
+    so a resumed fit replays exactly the logged-but-unacknowledged
+    windows past its restored step — the exactly-once ingest edge of the
+    train-while-serve loop (``flink_ml_tpu/online/driver.py``).  It does
+    NOT claim ``total_rows``: the stream is unbounded, so the decoded
+    replay cache must never engage.
+
+    ``max_windows`` bounds the run (benches/tests); the bound is an
+    ABSOLUTE window index, so a resumed reader still stops at the same
+    stream position.
+    """
+
+    def __init__(self, log: Any, batch_rows: int, *,
+                 max_windows: Optional[int] = None):
+        if batch_rows < 1:
+            raise ValueError("batch_rows must be >= 1")
+        self._log = log
+        self.batch_rows = int(batch_rows)
+        self._max = max_windows
+        self._start = 0
+        self._stream: Optional[Iterator[Any]] = None
+
+    def _plain_stream(self) -> Iterator[Any]:
+        """ONE cached iterator over a non-restorable source: seek and
+        iteration must share it — discarding from a throwaway
+        ``iter()`` of a re-iterable (list/tuple) source would lose the
+        position silently and re-train old windows under shifted
+        indices."""
+        if self._stream is None:
+            self._stream = iter(self._log)
+        return self._stream
+
+    def seek(self, rows: int) -> None:
+        if rows % self.batch_rows:
+            raise ValueError(
+                f"seek({rows}) is not a multiple of batch_rows="
+                f"{self.batch_rows}: window-granular streams only "
+                "reposition at window boundaries")
+        idx = rows // self.batch_rows
+        if hasattr(self._log, "restore"):
+            self._log.restore({"consumed": idx})
+        else:
+            # plain iterable: discard-to-position on the SHARED stream
+            # (a live source's consumed windows are gone regardless);
+            # seeking backward cannot be honored — fail loudly
+            if idx < self._start:
+                raise ValueError(
+                    f"seek({rows}) rewinds a non-restorable source "
+                    f"(position {self._start * self.batch_rows}); wrap "
+                    "the feed in a WindowLog for replayable resume")
+            it = self._plain_stream()
+            for _ in range(idx - self._start):
+                if next(it, None) is None:
+                    break
+        self._start = idx
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        pos = self._start
+        src = (self._log if hasattr(self._log, "restore")
+               else self._plain_stream())
+        for window in src:
+            if self._max is not None and pos >= self._max:
+                return
+            if window.num_rows != self.batch_rows:
+                raise ValueError(
+                    f"window {pos} carries {window.num_rows} rows, the "
+                    f"training stream is pinned to batch_rows="
+                    f"{self.batch_rows}; continuous fits need a fixed "
+                    "window grid (re-window the source)")
+            pos += 1
+            yield window.to_dict()

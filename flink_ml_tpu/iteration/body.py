@@ -142,6 +142,17 @@ class IterationListener:
                                        context: EpochContext) -> None:
         pass
 
+    def on_checkpoint_saved(self, epoch: int,
+                            context: EpochContext) -> None:
+        """Fires right after a checkpoint cut lands (hosted mode only —
+        fused iterations cannot checkpoint mid-run).  THE hook the
+        continuous-learning publish listener rides: at this point the
+        (state, source cursor) pair is durable, so a publish of exactly
+        this state composes with crash recovery into exactly-once —
+        a crash after the cut re-publishes the same step idempotently
+        (``online/publish.py``)."""
+        pass
+
     def on_iteration_terminated(self, context: EpochContext) -> None:
         pass
 

@@ -478,6 +478,15 @@ def _iterate_hosted(body: BodyFn, initial_state, provider: _DataProvider,
     elif isinstance(checkpoint, CheckpointConfig):
         manager = CheckpointManager(checkpoint)
 
+    # Does any listener actually consume the checkpoint hook?  Only then
+    # must an async save land before the hook fires (its contract is
+    # durability); listeners that never override it keep the full
+    # async-save overlap.
+    wants_ckpt_hook = any(
+        type(lst).on_checkpoint_saved
+        is not IterationListener.on_checkpoint_saved
+        for lst in listeners)
+
     state = _private_copy(initial_state) if donating else initial_state
     start_epoch = 0
     resumed_terminated = False
@@ -551,8 +560,13 @@ def _iterate_hosted(body: BodyFn, initial_state, provider: _DataProvider,
                         to_save = (_private_copy(state) if donating
                                    else state)
                         manager.save_async(epoch, to_save, extra)
+                        if wants_ckpt_hook:
+                            manager.wait()   # hook promises durability
                     else:
                         manager.save(epoch, state, extra)
+                    if wants_ckpt_hook:
+                        for listener in listeners:
+                            listener.on_checkpoint_saved(epoch - 1, ctx)
                 if stop:
                     terminated_reason = "criteria"
                     break
@@ -584,8 +598,13 @@ def _iterate_hosted(body: BodyFn, initial_state, provider: _DataProvider,
                     # background thread would otherwise read.
                     to_save = _private_copy(state) if donating else state
                     manager.save_async(epoch, to_save, extra)
+                    if wants_ckpt_hook:
+                        manager.wait()   # hook promises durability
                 else:
                     manager.save(epoch, state, extra)
+                if wants_ckpt_hook:
+                    for listener in listeners:
+                        listener.on_checkpoint_saved(epoch - 1, ctx)
             if stop:
                 terminated_reason = "criteria"
                 break

@@ -1341,7 +1341,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                       checkpoint=None,
                       checkpoint_every_steps: int = 0,
                       resume: bool = False,
-                      retry_policy=None
+                      retry_policy=None,
+                      publish_cb: Optional[Callable] = None
                       ) -> Tuple[LinearState, list]:
     """Out-of-core variant of :func:`sgd_fit`: the dataset never has to fit
     in host RAM or HBM (the Criteo-1TB shape, BASELINE.md north star).
@@ -1481,6 +1482,24 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     is quarantined and the fit falls back to the previous valid one
     (``CheckpointManager.latest()``); ``robustness.resilient_fit`` wraps
     this fit to make the whole crash->restore->replay loop automatic.
+
+    **Chunk-boundary publishes** (``publish_cb``): called as
+    ``publish_cb(global_step, params_fn)`` at every cut point — each
+    ``checkpoint_every_steps`` crossing and each epoch boundary, right
+    AFTER the checkpoint save when a manager is attached, so the
+    published state is never ahead of the durable one.  ``params_fn``
+    is a ZERO-ARG thunk returning the cut's host ``{"w", "b"}`` pytree
+    (reducer state stripped): the device->host fetch (a dispatch-stream
+    fence) is paid only when the callback actually publishes, not at
+    cuts its cadence policy skips.  The thunk must be consumed INSIDE
+    the callback — the underlying buffers are donated to the next
+    dispatch.  The train-while-serve driver
+    (``flink_ml_tpu/online/driver.py``) encodes the result as a param
+    delta and swaps it into the live serving generation.
+    With an overlapped ``grad_reduce`` the published cut intentionally
+    excludes the fit-end drain (the in-loop trajectory — the same state
+    a checkpoint of that cut holds, which is what keeps crash->resume->
+    republish bit-exact).
 
     **Retry** (``retry_policy``, a ``robustness.retry.RetryPolicy``):
     each epoch's reader is wrapped in a ``RetryingIterator`` — the wrap
@@ -1783,6 +1802,15 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                                    float(host["b"]),
                                    planned_impl=stream_impl), loss_log
 
+    def _publish_params(params):
+        """Host copy of the cut's params for ``publish_cb`` — reducer
+        state (EF residual / pending) is trainer-internal, never
+        served."""
+        host = jax.device_get(_fetch_replicated(params))
+        if isinstance(host, dict):
+            host = {k: v for k, v in host.items() if k != GR_STATE_KEY}
+        return host
+
     def _save(epoch, step_in_epoch, loss_sum, n_batches, converged=False):
         manager.save(global_step, {
             "params": params,
@@ -1959,47 +1987,71 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         step_in_epoch = skip_steps
         n_dispatches = 0
         resume_loss_sum, resume_n_batches, skip_steps = None, 0, 0
+        # The pipeline generator is closed EXPLICITLY on every exit
+        # (normal or exception): its teardown stops + joins the reader
+        # threads, so a supervised restart (resilient_fit) never races a
+        # zombie reader for the shared live source.  Relying on GC would
+        # not do — the exception traceback pins the frames in a cycle
+        # and the close happens arbitrarily late.
         if chunked:
-            for chunk, mask, n_valid in prefetch_to_device(
-                    source, depth=chunk_depth,
-                    transform=route, sharding=sharding,
-                    workers=prefetch_workers,
-                    put_workers=prefetch_put_workers, stats=prefetch_stats,
-                    chunks=W):
-                # (retry_policy wraps the READER, not this pipeline: the
-                # source here is a generator chain, which dies on a
-                # propagated exception — a pipeline-level retry of it
-                # would read StopIteration and silently truncate)
-                if loss_sum is None:
-                    loss_sum = jnp.zeros((), jnp.float32)
-                params, loss_sum = chunk_step(params, loss_sum, chunk, mask)
-                n_batches += n_valid
-                step_in_epoch += n_valid
-                global_step += n_valid
-                n_dispatches += 1
-                # mid-epoch cuts land at chunk boundaries: save when the
-                # chunk crossed a checkpoint_every_steps multiple
-                if (manager is not None and checkpoint_every_steps > 0
-                        and step_in_epoch // checkpoint_every_steps
-                        > (step_in_epoch - n_valid)
-                        // checkpoint_every_steps):
-                    _save(epoch, step_in_epoch, loss_sum, n_batches)
+            pipeline = prefetch_to_device(
+                source, depth=chunk_depth,
+                transform=route, sharding=sharding,
+                workers=prefetch_workers,
+                put_workers=prefetch_put_workers, stats=prefetch_stats,
+                chunks=W)
         else:
-            for dev_batch in prefetch_to_device(
-                    source, depth=prefetch_depth,
-                    transform=route, sharding=sharding,
-                    workers=prefetch_workers,
-                    put_workers=prefetch_put_workers, stats=prefetch_stats,
-                    put_fn=put_fn):
-                params, value = batch_step(params, *dev_batch)
-                loss_sum = value if loss_sum is None else add(loss_sum, value)
-                n_batches += 1
-                step_in_epoch += 1
-                global_step += 1
-                n_dispatches += 1
-                if (manager is not None and checkpoint_every_steps > 0
-                        and step_in_epoch % checkpoint_every_steps == 0):
-                    _save(epoch, step_in_epoch, loss_sum, n_batches)
+            pipeline = prefetch_to_device(
+                source, depth=prefetch_depth,
+                transform=route, sharding=sharding,
+                workers=prefetch_workers,
+                put_workers=prefetch_put_workers, stats=prefetch_stats,
+                put_fn=put_fn)
+        try:
+            if chunked:
+                for chunk, mask, n_valid in pipeline:
+                    # (retry_policy wraps the READER, not this pipeline: the
+                    # source here is a generator chain, which dies on a
+                    # propagated exception — a pipeline-level retry of it
+                    # would read StopIteration and silently truncate)
+                    if loss_sum is None:
+                        loss_sum = jnp.zeros((), jnp.float32)
+                    params, loss_sum = chunk_step(params, loss_sum, chunk, mask)
+                    n_batches += n_valid
+                    step_in_epoch += n_valid
+                    global_step += n_valid
+                    n_dispatches += 1
+                    # mid-epoch cuts land at chunk boundaries: save when the
+                    # chunk crossed a checkpoint_every_steps multiple (and
+                    # publish AFTER the save — never serve ahead of durable)
+                    if (checkpoint_every_steps > 0
+                            and (manager is not None or publish_cb is not None)
+                            and step_in_epoch // checkpoint_every_steps
+                            > (step_in_epoch - n_valid)
+                            // checkpoint_every_steps):
+                        if manager is not None:
+                            _save(epoch, step_in_epoch, loss_sum, n_batches)
+                        if publish_cb is not None:
+                            publish_cb(global_step,
+                                       lambda p=params: _publish_params(p))
+            else:
+                for dev_batch in pipeline:
+                    params, value = batch_step(params, *dev_batch)
+                    loss_sum = value if loss_sum is None else add(loss_sum, value)
+                    n_batches += 1
+                    step_in_epoch += 1
+                    global_step += 1
+                    n_dispatches += 1
+                    if (checkpoint_every_steps > 0
+                            and (manager is not None or publish_cb is not None)
+                            and step_in_epoch % checkpoint_every_steps == 0):
+                        if manager is not None:
+                            _save(epoch, step_in_epoch, loss_sum, n_batches)
+                        if publish_cb is not None:
+                            publish_cb(global_step,
+                                       lambda p=params: _publish_params(p))
+        finally:
+            pipeline.close()
         if loss_sum is None:
             raise ValueError("make_reader() returned an empty epoch")
         dispatch_log.append(n_dispatches)
@@ -2017,6 +2069,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
             prev_loss = epoch_loss
         if manager is not None:
             _save(epoch + 1, 0, None, 0, converged=stop)  # epoch-boundary cut
+        if publish_cb is not None:
+            publish_cb(global_step, lambda p=params: _publish_params(p))
         if stop:
             break
     params = _fetch_replicated(params)

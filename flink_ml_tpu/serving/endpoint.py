@@ -62,6 +62,17 @@ class ServingEndpoint:
         ``endpoint.registry.deploy(name, new_version)``."""
         return self._registry
 
+    def delta_publisher(self):
+        """A :class:`~flink_ml_tpu.online.publish.DeltaPublisher` bound
+        to this endpoint's registry entry and metrics — the serving-side
+        half of the continuous-learning publish protocol.  Publishes
+        account (delta/full counters, staleness gauge) on THIS
+        endpoint."""
+        from ..online.publish import DeltaPublisher
+
+        return DeltaPublisher(self._registry, self._name,
+                              metrics=self.metrics)
+
     def hot_swap(self, model, **deploy_kwargs):
         """Self-healing hot-swap: deploy ``model`` as the next generation
         with ``rollback=True`` — a failed load/warm-up (corrupt

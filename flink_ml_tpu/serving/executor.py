@@ -24,6 +24,7 @@ The adapter's contract:
 
 from __future__ import annotations
 
+import copy
 import threading
 
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
@@ -101,6 +102,31 @@ class ServableModel:
         self._schema = set(example.column_names)
         self._ready = False
 
+    #: True for executor families whose compiled score programs take the
+    #: params as RUNTIME arguments (the module-global serving jit cache):
+    #: a same-shape new generation can :meth:`rebind` without warm-up —
+    #: the continuous-learning delta-publish fast path.  The generic
+    #: adapter serves through ``model.transform``, whose jit caches may
+    #: bake params in as constants, so it stays False.
+    rebind_safe = False
+
+    def rebind(self, model) -> "ServableModel":
+        """A ready clone of this servable scoring with ``model`` (same
+        example/buckets/output schema).  Only meaningful when
+        ``rebind_safe``: the clone inherits readiness WITHOUT a warm-up
+        because every compiled program it can reach is already compiled
+        (params are runtime args) — publish becomes a buffer swap.
+        Callers own the same-shape contract; a shape change must go
+        through the full deploy path instead."""
+        if not self.rebind_safe:
+            raise TypeError(
+                f"{type(self).__name__} is not rebind-safe: its transform "
+                "path may bake params into compiled programs — deploy the "
+                "new version through the registry (load->warm->swap)")
+        clone = copy.copy(self)
+        clone.model = model
+        return clone
+
     # -- predict ------------------------------------------------------------
     def check_schema(self, table: Table) -> None:
         names = set(table.column_names)
@@ -164,6 +190,8 @@ class _LinearServable(ServableModel):
     dense features score through a donated-input jitted margin; sparse and
     mixed layouts fall back to the model's own (bucket-routed) transform."""
 
+    rebind_safe = True
+
     def _run(self, table: Table) -> Table:
         from ..models.common.linear import resolve_features
 
@@ -193,6 +221,8 @@ def _kmeans_assign(measure, points, centroids):
 class _KMeansServable(ServableModel):
     """KMeansModel: donated-input jitted nearest-centroid assign."""
 
+    rebind_safe = True
+
     def _run(self, table: Table) -> Table:
         from ..distance import DistanceMeasure
         from ..linalg import stack_vectors
@@ -220,6 +250,8 @@ def _widedeep_scores(params, dense, cat_ids):
 
 class _WideDeepServable(ServableModel):
     """WideDeepModel: donated-input jitted sigmoid(forward)."""
+
+    rebind_safe = True
 
     def _run(self, table: Table) -> Table:
         from ..models.recommendation.widedeep import _validate_cat_ids

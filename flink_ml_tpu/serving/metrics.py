@@ -82,6 +82,16 @@ class ServingMetrics:
         self.shed = self.group.counter("shed")
         #: failed hot-swaps healed by rolling back to the live generation
         self.rollbacks = self.group.counter("rollbacks")
+        #: continuous-learning publish accounting (ISSUE 7): how the live
+        #: generation last changed — device-resident delta swaps vs full
+        #: load->warm->swap deploys — plus model freshness
+        self.publishes_delta = self.group.counter("publishes_delta")
+        self.publishes_full = self.group.counter("publishes_full")
+        self._staleness = self.group.gauge("model_staleness_seconds")
+        self._publish_rate = self.group.gauge("publishes_per_sec")
+        self._publish_bytes = self.group.gauge("last_publish_bytes")
+        self._last_publish_at: Optional[float] = None
+        self._publish_rate_value = 0.0
         self._health = self.group.gauge("health")
         self._health.set(HEALTH_SERVING)
         self._queue_depth = self.group.gauge("queue_depth")
@@ -118,6 +128,44 @@ class ServingMetrics:
         self._generation.set(generation)
         self._health.set(HEALTH_SERVING)
 
+    def on_publish(self, generation: int, *, mode: str = "full",
+                   payload_bytes: Optional[int] = None,
+                   now: Optional[float] = None) -> None:
+        """A continuous-learning publish landed (``mode`` "delta" for a
+        device-resident buffer swap, anything else counts as full).
+        Resets the staleness gauge and feeds the publishes/sec EWMA (the
+        on_batch requests/sec stance)."""
+        self.on_deploy(generation)
+        (self.publishes_delta if mode == "delta"
+         else self.publishes_full).inc()
+        if payload_bytes is not None:
+            self._publish_bytes.set(int(payload_bytes))
+        now = time.time() if now is None else now
+        with self._rate_lock:
+            if self._last_publish_at is not None:
+                inst = 1.0 / max(now - self._last_publish_at, 1e-9)
+                self._publish_rate_value = (
+                    0.8 * self._publish_rate_value + 0.2 * inst
+                    if self._publish_rate_value else inst)
+                self._publish_rate.set(round(self._publish_rate_value, 3))
+            self._last_publish_at = now
+        self._staleness.set(0.0)
+
+    def touch_staleness(self, now: Optional[float] = None) -> None:
+        """Refresh the model-staleness gauge (seconds since the last
+        publish).  Called from the serve loop per batch — one
+        ``time.time()`` — so the gauge stays live between publishes; a
+        never-published endpoint reads -1 (unknown, not fresh)."""
+        if self._last_publish_at is None:
+            self._staleness.set(-1.0)
+            return
+        now = time.time() if now is None else now
+        self._staleness.set(round(now - self._last_publish_at, 3))
+
+    @property
+    def staleness_seconds(self) -> float:
+        return self._staleness.value
+
     def on_submit(self, queue_depth: int) -> None:
         self._queue_depth.set(queue_depth)
 
@@ -134,6 +182,7 @@ class ServingMetrics:
             self.latency.record(lat)
         self._queue_depth.set(queue_depth)
         self._fill.set(round(rows / max(bucket, 1), 4))
+        self.touch_staleness(time.time())
         self.publish()
         if generation is not None:
             self._generation.set(generation)

@@ -41,7 +41,12 @@ from ..robustness.faults import fault_point
 from ..utils import persist
 from .executor import ServableModel, make_servable
 
-__all__ = ["DeployedModel", "ModelRegistry"]
+__all__ = ["DeployedModel", "GenerationConflict", "ModelRegistry"]
+
+
+class GenerationConflict(RuntimeError):
+    """A conditional publish lost the race to a concurrent deploy: the
+    live generation is not the one the caller validated against."""
 
 log = logging.getLogger("flink_ml_tpu.robustness")
 
@@ -142,6 +147,66 @@ class ModelRegistry:
             self._live[name] = deployed   # THE swap: one dict assignment
         if metrics is not None:
             metrics.on_deploy(generation)
+        return deployed
+
+    def publish_servable(self, name: str, servable: ServableModel, *,
+                         source: str = "<publish>",
+                         metrics: Optional[Any] = None,
+                         mode: str = "delta",
+                         payload_bytes: Optional[int] = None,
+                         expected_generation: Optional[int] = None
+                         ) -> DeployedModel:
+        """Swap an already-READY servable in as the next generation of
+        ``name`` — the continuous-learning publish fast path.  Unlike
+        :meth:`deploy` there is no load and no warm-up here: the caller
+        (:class:`~flink_ml_tpu.online.publish.DeltaPublisher`) rebound a
+        live servable around same-shape params, so every compiled
+        executor it can reach already exists.  The swap itself is the
+        same single reference assignment under the registry lock, so the
+        atomicity contract (in-flight batches finish on their captured
+        version; no request ever sees a half-published model) is
+        identical to a full deploy.
+
+        ``mode``/``payload_bytes`` flow to
+        ``ServingMetrics.on_publish`` for the delta-vs-full counters and
+        the staleness gauge.
+
+        ``expected_generation`` makes the swap CONDITIONAL: if the live
+        generation moved past it (a concurrent external deploy landed
+        between the caller's read and this swap), the publish is
+        refused with :class:`GenerationConflict` instead of silently
+        clobbering the newer model — the compare-and-swap the publish
+        protocol's validation-then-swap sequence needs."""
+        if not servable.ready:
+            raise RuntimeError(
+                f"publish_servable({name!r}): servable is not ready — "
+                "rebind() preserves readiness; anything else must "
+                "warm_up() first (or go through deploy())")
+        # chaos seam: the chunk-boundary publish is a crash site the
+        # exactly-once tests exercise (crash BEFORE the swap => the old
+        # generation keeps serving; the replayed cut republishes)
+        fault_point("serving.publish")
+        metrics = metrics if metrics is not None else self.metrics
+        with self._lock:
+            previous = self._live.get(name)
+            if (expected_generation is not None and previous is not None
+                    and previous.generation != expected_generation):
+                raise GenerationConflict(
+                    f"publish of {name!r} expected generation "
+                    f"{expected_generation} but {previous.generation} is "
+                    "live (a concurrent deploy landed); re-validate "
+                    "against the new generation and retry")
+            generation = (previous.generation + 1) if previous else 1
+            deployed = DeployedModel(name=name, servable=servable,
+                                     generation=generation, source=source,
+                                     deployed_at=time.time())
+            self._live[name] = deployed   # THE swap: one dict assignment
+        if metrics is not None:
+            if hasattr(metrics, "on_publish"):
+                metrics.on_publish(generation, mode=mode,
+                                   payload_bytes=payload_bytes)
+            else:
+                metrics.on_deploy(generation)
         return deployed
 
     def current(self, name: str) -> DeployedModel:

@@ -34,8 +34,12 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: every step/scan body in these trees must stay host-sync-free
+#: (``online/`` joined with ISSUE 7: its driver feeds the same chunked
+#: scan, so a host sync in a step-named helper there would fence the
+#: training dispatch stream the publishes ride on)
 SCAN_ROOTS = [
     "flink_ml_tpu/models",
+    "flink_ml_tpu/online",
     "flink_ml_tpu/parallel",
 ]
 

@@ -78,3 +78,20 @@ def test_checker_ignores_non_step_functions(tmp_path):
         "def fetch(params):\n"
         "    return params.item()\n")
     assert chs.check_file(str(good)) == []
+
+
+def test_checker_covers_online_package():
+    """ISSUE 7 satellite: the continuous-learning package joined the
+    scanned roots — its driver feeds the same chunked dispatch stream,
+    so a host sync in a step-named helper there would fence training
+    under the publishes.  Assert the root is registered AND that the
+    walk actually visits its modules (a registered-but-empty root would
+    silently guard nothing)."""
+    assert "flink_ml_tpu/online" in chs.SCAN_ROOTS
+    visited = [p for p in chs._module_paths()
+               if os.sep + os.path.join("flink_ml_tpu", "online") + os.sep
+               in p]
+    names = {os.path.basename(p) for p in visited}
+    assert {"driver.py", "publish.py", "delta.py"} <= names
+    for path in visited:
+        assert chs.check_file(path) == []
