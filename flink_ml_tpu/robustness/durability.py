@@ -85,7 +85,12 @@ def write_manifest(dirpath: str,
         name: {"crc32": file_crc32(os.path.join(dirpath, name)),
                "bytes": os.path.getsize(os.path.join(dirpath, name))}
         for name in names}}
-    with open(os.path.join(dirpath, MANIFEST_NAME), "w") as f:
+    # a torn manifest is SAFE here: the COMMITTED marker is written
+    # after it, and verify_dir treats manifest-without-marker as
+    # crashed-mid-commit (quarantined) — the marker, not an os.replace,
+    # is this protocol's commit point.
+    with open(os.path.join(dirpath,   # graftlint: disable=atomic-writes
+                           MANIFEST_NAME), "w") as f:
         json.dump(manifest, f, indent=1, sort_keys=True)
         f.flush()
         os.fsync(f.fileno())
@@ -96,7 +101,9 @@ def write_commit_marker(dirpath: str) -> None:
     """The last write of the commit protocol — its presence asserts the
     manifest (and everything it hashes) fully landed."""
     marker = os.path.join(dirpath, COMMIT_MARKER)
-    with open(marker, "w") as f:
+    # zero-byte marker: nothing to tear, fsync'd below — atomic by
+    # content, no tmp+replace needed.
+    with open(marker, "w") as f:   # graftlint: disable=atomic-writes
         f.flush()
         os.fsync(f.fileno())
     dirfd = os.open(dirpath, os.O_RDONLY)

@@ -1,0 +1,3 @@
+"""Repo tooling.  The package exists so ``python -m scripts.graftlint``
+resolves from the repo root; nothing here is shipped (pyproject packaging
+includes ``flink_ml_tpu*`` only)."""

@@ -1,114 +1,61 @@
 #!/usr/bin/env python
-"""Static guard: durable-layer writes must be atomic (ISSUE 5 satellite).
+"""DEPRECATED shim — the atomic-writes check now lives in graftlint.
 
-The durability contract of ``utils/persist.py``, ``iteration/
-checkpoint.py`` and ``data/wal.py`` is *write tmp -> os.replace*: a
-crash mid-write must never leave a half-written file at a path a loader
-trusts.  This pass parses each module and flags any ``open(path, "w")``
-/ ``open(path, "wb")`` call whose enclosing function does not later (or
-anywhere, same function) call ``os.replace`` on a path sharing a
-variable with the opened expression — the pattern that makes the write
-atomic (writing INTO a tmp dir that is itself renamed counts: the
-shared variable is the tmp dir name).
+The real pass is ``scripts/graftlint/passes/atomic_writes.py``; run it
+(and every other pass) with::
 
-Heuristic by design (AST names, not dataflow), tuned to this repo's
-idiom; a false positive is fixed by actually making the write atomic or
-adding the path to the explicit allowlist below with a justification.
+    python -m scripts.graftlint
 
-Run with no arguments to check the three durable modules; pass explicit
-paths to check those instead.  Exit 0 = clean, 1 = findings (one line
-each).  Wired into tier-1 via tests/test_atomic_writes.py.
+This file keeps the legacy surface (``DURABLE_MODULES``, ``check_file``,
+CLI) alive for existing callers and ``tests/test_atomic_writes.py``,
+delegating to the framework-hosted pass (inline suppressions included).
+NOTE the legacy module list is frozen at the original three files; the
+pass additionally guards ``robustness/durability.py``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
+import warnings
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-#: the durable layer: every open-for-write here must be atomic
+from scripts.graftlint.core import ModuleInfo, Project  # noqa: E402
+from scripts.graftlint.passes.atomic_writes import (  # noqa: E402
+    AtomicWritesPass,
+)
+
+#: the legacy durable-module list (frozen; see module docstring)
 DURABLE_MODULES = [
     "flink_ml_tpu/utils/persist.py",
     "flink_ml_tpu/iteration/checkpoint.py",
     "flink_ml_tpu/data/wal.py",
 ]
 
-#: (file, function) pairs exempt with a reason — currently none.
-ALLOWLIST: dict = {}
-
-_WRITE_MODES = {"w", "wb", "w+", "wb+", "a", "ab"}
-
-
-def _names(node: ast.AST) -> set:
-    """Variable names referenced by an expression, skipping attribute
-    roots used as call targets (``os`` in ``os.path.join(tmp, ...)``)."""
-    out = set()
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Name):
-            out.add(sub.id)
-    out.discard("os")
-    return out
-
-
-def _open_mode(call: ast.Call):
-    """The literal mode of an ``open(...)`` call, or None."""
-    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
-            and isinstance(call.args[1].value, str):
-        return call.args[1].value
-    for kw in call.keywords:
-        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
-            return kw.value.value
-    return None
-
-
-def _is_open(call: ast.Call) -> bool:
-    return isinstance(call.func, ast.Name) and call.func.id == "open"
-
-
-def _is_os_replace(call: ast.Call) -> bool:
-    f = call.func
-    return (isinstance(f, ast.Attribute) and f.attr == "replace"
-            and isinstance(f.value, ast.Name) and f.value.id == "os")
+_pass = AtomicWritesPass()
+_project = Project(repo=REPO)
 
 
 def check_file(path: str) -> list:
-    src = open(path).read()
-    tree = ast.parse(src, filename=path)
-    rel = os.path.relpath(path, REPO)
-    problems = []
-    for fn in ast.walk(tree):
-        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if (rel, fn.name) in ALLOWLIST:
-            continue
-        writes = []     # (lineno, path-variable names)
-        replaced = set()  # names appearing as os.replace source args
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            if _is_open(node):
-                mode = _open_mode(node)
-                if mode and mode.strip("b+") in ("w", "a") \
-                        and mode in _WRITE_MODES and node.args:
-                    writes.append((node.lineno, _names(node.args[0])))
-            elif _is_os_replace(node) and node.args:
-                replaced |= _names(node.args[0])
-        for lineno, names in writes:
-            if not names:
-                problems.append(
-                    f"{rel}:{lineno}: open-for-write on a literal path "
-                    "with no os.replace — not crash-atomic")
-            elif not names & replaced:
-                problems.append(
-                    f"{rel}:{lineno}: open-for-write on {sorted(names)} "
-                    f"but {fn.name}() never os.replace's a path sharing "
-                    "those names — a crash can leave a half-written file")
-    return problems
+    """Problem strings for one module, in the legacy one-line format.
+    Inline ``# graftlint: disable=atomic-writes`` suppressions are
+    honored, so this surface and the canonical gate agree on what is
+    clean (the two protocol-level exceptions in
+    ``robustness/durability.py`` stay quiet here too)."""
+    mod = ModuleInfo(path, REPO)
+    return [f"{f.path}:{f.line}: {f.message}"
+            for f in _pass.check_module(mod, _project)
+            if not {_pass.id, "all"} & mod.suppressions.get(f.line, set())]
 
 
 def main(argv) -> int:
+    warnings.warn(
+        "scripts/check_atomic_writes.py is a shim; use "
+        "`python -m scripts.graftlint` (pass id: atomic-writes)",
+        DeprecationWarning, stacklevel=2)
     paths = argv or [os.path.join(REPO, m) for m in DURABLE_MODULES]
     problems = []
     for path in paths:

@@ -1,154 +1,63 @@
 #!/usr/bin/env python
-"""Static guard: no host syncs inside scan-body / step functions
-(ISSUE 6 satellite).
+"""DEPRECATED shim — the host-sync check now lives in graftlint.
 
-The communication-overlap schedule (``grad_reduce.pipelined_reduce``)
-only buys anything if the device queue stays full: a host
-synchronization inside a step body — ``block_until_ready``,
-``jax.device_get``, ``np.asarray`` on a traced value, ``.item()`` —
-fences the dispatch stream and silently destroys the overlap (and the
-chunked-dispatch amortization of PR 1 with it).  This pass parses every
-module under ``flink_ml_tpu/models/`` and ``flink_ml_tpu/parallel/``
-and flags those calls inside functions that are (a) named like step /
-scan bodies (``update``, ``batch_step``, ``device_fn``, ``*_step``,
-``*_body``, ...) or (b) passed as the scanned body to ``lax.scan`` /
-``masked_chunk_scan`` anywhere in the module — nested helper defs
-inside a step body are covered by the AST walk.
+The real pass is ``scripts/graftlint/passes/host_sync.py``; run it (and
+every other pass) with::
 
-Heuristic by design (AST names, not tracing), tuned to this repo's
-idiom: step bodies are pure device math here, so ANY of the four calls
-is a finding.  A justified host sync goes in the explicit allowlist
-below with a reason.
+    python -m scripts.graftlint
 
-Run with no arguments to check the two subsystems; pass explicit paths
-to check those instead.  Exit 0 = clean, 1 = findings (one line each).
-Wired into tier-1 via tests/test_no_host_sync.py.
+This file keeps the legacy surface (``SCAN_ROOTS``, ``_module_paths``,
+``check_file``, CLI) alive for existing callers and
+``tests/test_no_host_sync.py``, delegating every check to the
+framework-hosted pass so there is exactly ONE implementation.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
+import warnings
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-#: every step/scan body in these trees must stay host-sync-free
-#: (``online/`` joined with ISSUE 7: its driver feeds the same chunked
-#: scan, so a host sync in a step-named helper there would fence the
-#: training dispatch stream the publishes ride on)
-SCAN_ROOTS = [
-    "flink_ml_tpu/models",
-    "flink_ml_tpu/online",
-    "flink_ml_tpu/parallel",
-]
+from scripts.graftlint.core import (  # noqa: E402
+    ModuleInfo,
+    Project,
+    iter_py_files,
+)
+from scripts.graftlint.passes.host_sync import (  # noqa: E402
+    SCAN_ROOTS as _ROOTS,
+    HostSyncPass,
+)
 
-#: (file, function) pairs exempt with a reason — currently none.
-ALLOWLIST: dict = {}
+#: legacy name (a list, as before); the pass's tuple is canonical
+SCAN_ROOTS = list(_ROOTS)
 
-#: function names that ARE step/scan bodies in this repo's idiom
-STEP_NAMES = {
-    "update", "batch_step", "scan_step", "chunk_step", "device_fn",
-    "train_step", "epoch_body", "body", "step",
-}
-
-STEP_SUFFIXES = ("_step", "_body", "_update")
-
-#: callables whose first argument is a scanned/stepped body
-SCAN_CALLEES = {"scan", "masked_chunk_scan", "while_loop", "fori_loop"}
-
-
-def _call_name(call: ast.Call):
-    """Trailing name of the called expression: ``lax.scan`` -> "scan"."""
-    f = call.func
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    if isinstance(f, ast.Name):
-        return f.id
-    return None
-
-
-def _is_step_name(name: str) -> bool:
-    return name in STEP_NAMES or name.endswith(STEP_SUFFIXES)
-
-
-def _scanned_body_names(tree: ast.AST) -> set:
-    """Names passed as the body argument to scan-family calls anywhere in
-    the module (``lax.scan(step_fn, ...)``, ``fori_loop(lo, hi, body,
-    ...)``) — those functions are step bodies regardless of their name."""
-    out = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        name = _call_name(node)
-        if name not in SCAN_CALLEES or not node.args:
-            continue
-        args = node.args
-        cands = [args[2]] if name == "fori_loop" and len(args) >= 3 \
-            else args[:2] if name == "while_loop" else [args[0]]
-        for cand in cands:
-            if isinstance(cand, ast.Name):
-                out.add(cand.id)
-    return out
-
-
-def _sync_finding(call: ast.Call):
-    """The host-sync kind of a call, or None."""
-    f = call.func
-    if isinstance(f, ast.Attribute):
-        if f.attr == "block_until_ready":
-            return "block_until_ready"
-        if f.attr == "item":
-            return ".item()"
-        if f.attr == "device_get":
-            return "jax.device_get"
-        if f.attr == "asarray" and isinstance(f.value, ast.Name) \
-                and f.value.id in ("np", "numpy", "onp"):
-            return "np.asarray"
-    elif isinstance(f, ast.Name) and f.id == "device_get":
-        return "device_get"
-    return None
+_pass = HostSyncPass()
+_project = Project(repo=REPO)
 
 
 def check_file(path: str) -> list:
-    src = open(path).read()
-    tree = ast.parse(src, filename=path)
-    rel = os.path.relpath(path, REPO)
-    scanned = _scanned_body_names(tree)
-    problems = []
-    seen: set = set()
-    for fn in ast.walk(tree):
-        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if not (_is_step_name(fn.name) or fn.name in scanned):
-            continue
-        if (rel, fn.name) in ALLOWLIST:
-            continue
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            kind = _sync_finding(node)
-            if kind and (rel, node.lineno) not in seen:
-                seen.add((rel, node.lineno))
-                problems.append(
-                    f"{rel}:{node.lineno}: {kind} inside step body "
-                    f"{fn.name}() — a host sync here fences the dispatch "
-                    "stream and destroys comm/compute overlap")
-    return problems
+    """Problem strings for one module, in the legacy one-line format.
+    Inline ``# graftlint: disable=host-sync`` suppressions are honored,
+    so this surface and the canonical gate agree on what is clean."""
+    mod = ModuleInfo(path, REPO)
+    return [f"{f.path}:{f.line}: {f.message}"
+            for f in _pass.check_module(mod, _project)
+            if not {_pass.id, "all"} & mod.suppressions.get(f.line, set())]
 
 
 def _module_paths() -> list:
-    paths = []
-    for root in SCAN_ROOTS:
-        for dirpath, _dirnames, filenames in os.walk(
-                os.path.join(REPO, root)):
-            for f in sorted(filenames):
-                if f.endswith(".py"):
-                    paths.append(os.path.join(dirpath, f))
-    return paths
+    return list(iter_py_files([os.path.join(REPO, r) for r in SCAN_ROOTS]))
 
 
 def main(argv) -> int:
+    warnings.warn(
+        "scripts/check_no_host_sync.py is a shim; use "
+        "`python -m scripts.graftlint` (pass id: host-sync)",
+        DeprecationWarning, stacklevel=2)
     paths = argv or _module_paths()
     problems = []
     for path in paths:

@@ -1,0 +1,75 @@
+"""CLI: ``python -m scripts.graftlint [paths...] [--json FILE|-]``.
+
+Exit 0 = clean (baselined/suppressed findings don't fail the run),
+1 = findings.  ``--json`` additionally emits the machine-readable
+report (finding list + per-pass counts) so CI tooling can diff finding
+counts across PRs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .runner import all_passes, run
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m scripts.graftlint",
+        description="unified static-analysis gate (see scripts/graftlint)")
+    ap.add_argument("paths", nargs="*",
+                    help="restrict AST passes to these files/dirs "
+                         "(default: each pass's own roots)")
+    ap.add_argument("--json", metavar="FILE",
+                    help="write the JSON report to FILE ('-' = stdout)")
+    ap.add_argument("--passes", metavar="ID[,ID...]",
+                    help="comma-separated pass ids to run (default: all; "
+                         "disables unused-suppression enforcement)")
+    ap.add_argument("--list-passes", action="store_true",
+                    help="print the pass catalog and exit")
+    args = ap.parse_args(argv)
+
+    catalog = all_passes()
+    if args.list_passes:
+        for p in catalog:
+            print(f"{p.id:24s} {p.describes}")
+        return 0
+
+    chosen = None
+    if args.passes:
+        wanted = {s.strip() for s in args.passes.split(",") if s.strip()}
+        known = {p.id for p in catalog}
+        unknown = wanted - known
+        if unknown:
+            print(f"unknown pass id(s): {', '.join(sorted(unknown))} "
+                  f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+        chosen = [p for p in catalog if p.id in wanted]
+
+    try:
+        report = run(passes=chosen, paths=args.paths or None)
+    except FileNotFoundError as exc:
+        print(str(exc), file=sys.stderr)
+        return 2
+    # with ``--json -`` stdout IS the machine-readable report; the
+    # human-readable rendering moves to stderr so the stream parses
+    print(report.render(),
+          file=sys.stderr if args.json == "-" else sys.stdout)
+    if args.json:
+        payload = json.dumps(report.as_dict(), indent=2, sort_keys=True)
+        if args.json == "-":
+            print(payload)
+        else:
+            import os
+
+            tmp = args.json + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(payload + "\n")
+            os.replace(tmp, args.json)
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,272 @@
+"""Shared analysis infrastructure every graftlint pass builds on.
+
+One parse per module (cached on :class:`Project`), with:
+
+- **Qualified-name resolution** — ``ModuleInfo.qualname`` resolves an
+  expression through ``import x as y`` / ``from a.b import c as d``
+  aliases AND module-level local rebinding (``sleep2 = time.sleep``), so
+  a pass matches ``jax.lax.psum`` however the module spells it.
+- **Suppressions** — a ``# graftlint: disable=<pass>[,<pass>]`` comment
+  on the flagged line drops that line's findings for those passes; the
+  runner enforces that every suppression is *exercised* (an unused one is
+  itself a finding — a suppression that guards nothing rots silently).
+- **Function index** — every ``def`` in the module (nested included) by
+  name, for the follow-functions-passed-by-reference analyses.
+- **The shared walker** — :func:`iter_py_files` with one exclusion set
+  (``__pycache__`` et al.) instead of each checker re-implementing
+  directory filtering.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set
+
+#: directories the shared walker never descends into (generated or
+#: vendored artifacts — each must be .gitignore'd, see test_graftlint)
+EXCLUDE_DIRS = {"__pycache__", ".git", ".pytest_cache", ".mypy_cache",
+                ".ruff_cache", ".ipynb_checkpoints", ".venv", "node_modules",
+                "build", "dist"}
+
+#: ids are a comma-separated list right after ``disable=``; anything
+#: after the list (a justification) is free text, not part of the ids
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([\w-]+(?:\s*,\s*[\w-]+)*)")
+
+
+def iter_py_files(roots: Sequence[str]) -> Iterator[str]:
+    """Every ``.py`` under ``roots`` (files pass through verbatim), in
+    sorted order, skipping :data:`EXCLUDE_DIRS` — THE directory-filter
+    shared by all passes and both legacy checker shims."""
+    for root in roots:
+        if os.path.isfile(root):
+            yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in EXCLUDE_DIRS
+                                 and not d.endswith(".egg-info"))
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+
+
+@dataclass
+class Finding:
+    """One diagnostic: ``file:line``, the pass that raised it, the claim,
+    and a fix hint.  ``symbol`` (the enclosing function) keys the
+    baseline — line numbers drift with every edit, symbols rarely do."""
+
+    pass_id: str
+    path: str            # repo-relative
+    line: int
+    message: str
+    symbol: str = ""     # enclosing function ("outer.inner" when nested)
+    hint: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.pass_id} {self.path}::{self.symbol or '<module>'}"
+
+    def render(self) -> str:
+        text = f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+        if self.hint:
+            text += f"\n    fix: {self.hint}"
+        return text
+
+    def as_dict(self) -> dict:
+        return {"pass": self.pass_id, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message,
+                "hint": self.hint}
+
+
+class ModuleInfo:
+    """One parsed module + the resolution tables passes share."""
+
+    def __init__(self, path: str, repo: str):
+        self.path = os.path.abspath(path)
+        try:
+            self.rel = os.path.relpath(self.path, repo)
+        except ValueError:          # different drive (windows) — keep abs
+            self.rel = self.path
+        with open(self.path) as f:
+            self.src = f.read()
+        self.tree = ast.parse(self.src, filename=self.path)
+        self.lines = self.src.splitlines()
+        #: dotted package of this module ("flink_ml_tpu.data.prefetch")
+        #: for resolving relative imports; "" when outside the repo
+        self.package = ""
+        if not self.rel.startswith(("..", os.sep)):
+            self.package = self.rel[:-3].replace(os.sep, ".") \
+                if self.rel.endswith(".py") else ""
+        self.aliases: Dict[str, str] = {}
+        self.functions: Dict[str, List[ast.AST]] = {}
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        self._index()
+        #: line -> set of pass ids disabled on that line.  Parsed from
+        #: COMMENT tokens only — a docstring QUOTING the syntax is
+        #: documentation, not a suppression
+        self.suppressions: Dict[int, Set[str]] = {}
+        for line_no, comment in self._comments():
+            m = _SUPPRESS_RE.search(comment)
+            if m:
+                self.suppressions.setdefault(line_no, set()).update(
+                    p.strip() for p in m.group(1).split(",") if p.strip())
+
+    def _comments(self):
+        import io
+        import tokenize
+
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.src).readline):
+                if tok.type == tokenize.COMMENT:
+                    yield tok.start[0], tok.string
+        except (tokenize.TokenError, IndentationError):
+            return
+
+    # -- indexing -----------------------------------------------------------
+    def _index(self) -> None:
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._record_import(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, []).append(node)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, (ast.Name, ast.Attribute)) \
+                    and self._parents.get(node) is self.tree:
+                # module-level rebinding: ``sleep2 = time.sleep``
+                dotted = self._dotted(node.value)
+                if dotted:
+                    self.aliases[node.targets[0].id] = \
+                        self.aliases.get(dotted, dotted)
+
+    def _record_import(self, node) -> None:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                self.aliases[(a.asname or a.name).split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+                if a.asname:
+                    self.aliases[a.asname] = a.name
+            return
+        base = node.module or ""
+        if node.level:                      # relative import
+            parts = self.package.split(".") if self.package else []
+            parts = parts[:len(parts) - node.level]
+            base = ".".join(parts + ([node.module] if node.module else []))
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.aliases[a.asname or a.name] = \
+                f"{base}.{a.name}" if base else a.name
+
+    @staticmethod
+    def _dotted(node) -> Optional[str]:
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return None
+
+    # -- resolution ---------------------------------------------------------
+    def qualname(self, node) -> Optional[str]:
+        """Alias-resolved dotted name of an expression, or None when it
+        is not a plain name/attribute chain.  ``np.asarray`` ->
+        ``numpy.asarray``; ``lax.psum`` -> ``jax.lax.psum`` (given
+        ``from jax import lax``)."""
+        dotted = self._dotted(node)
+        if dotted is None:
+            return None
+        root, _, rest = dotted.partition(".")
+        resolved = self.aliases.get(root, root)
+        return f"{resolved}.{rest}" if rest else resolved
+
+    def call_qualname(self, call: ast.Call) -> Optional[str]:
+        return self.qualname(call.func)
+
+    def enclosing_function(self, node) -> str:
+        """Dotted enclosing-def chain of ``node`` ("" at module level)."""
+        chain: List[str] = []
+        cur = self._parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                chain.append(cur.name)
+            cur = self._parents.get(cur)
+        return ".".join(reversed(chain))
+
+    def parent(self, node) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def finding(self, pass_id: str, node, message: str,
+                hint: str = "") -> Finding:
+        return Finding(pass_id=pass_id, path=self.rel,
+                       line=getattr(node, "lineno", 0), message=message,
+                       symbol=self.enclosing_function(node), hint=hint)
+
+
+@dataclass
+class Project:
+    """Module cache + repo layout shared across passes (each file parses
+    once no matter how many passes read it)."""
+
+    repo: str
+    _cache: Dict[str, ModuleInfo] = field(default_factory=dict)
+    #: modules any pass actually visited — the universe for
+    #: unused-suppression enforcement
+    scanned: Set[str] = field(default_factory=set)
+
+    def module(self, path: str) -> ModuleInfo:
+        path = os.path.abspath(path)
+        if path not in self._cache:
+            self._cache[path] = ModuleInfo(path, self.repo)
+        return self._cache[path]
+
+    def iter_modules(self, roots: Sequence[str]) -> Iterator[ModuleInfo]:
+        """ModuleInfos under repo-relative ``roots``; remembers what was
+        visited for suppression enforcement."""
+        abs_roots = [r if os.path.isabs(r) else os.path.join(self.repo, r)
+                     for r in roots]
+        for path in iter_py_files(abs_roots):
+            mod = self.module(path)
+            self.scanned.add(mod.path)
+            yield mod
+
+    def resolve_module(self, dotted: str) -> Optional[ModuleInfo]:
+        """The ModuleInfo for a dotted module path inside the repo
+        (``flink_ml_tpu.parallel.grad_reduce``), or None."""
+        rel = dotted.replace(".", os.sep)
+        for cand in (rel + ".py", os.path.join(rel, "__init__.py")):
+            path = os.path.join(self.repo, cand)
+            if os.path.isfile(path):
+                return self.module(path)
+        return None
+
+    def resolve_function(self, mod: ModuleInfo, name: str,
+                         ) -> Optional[tuple]:
+        """Resolve a bare callee name to ``(ModuleInfo, FunctionDef)`` —
+        a def in ``mod`` itself, or followed through a from-import into
+        another repo module (one hop; deeper chains resolve recursively
+        at the caller's discretion)."""
+        if name in mod.functions:
+            return mod, mod.functions[name][-1]
+        dotted = mod.aliases.get(name)
+        if not dotted or "." not in dotted:
+            return None
+        mod_path, _, fn_name = dotted.rpartition(".")
+        target = self.resolve_module(mod_path)
+        if target is not None and fn_name in target.functions:
+            return target, target.functions[fn_name][-1]
+        # ``from ..parallel import grad_reduce`` + ``grad_reduce.foo``
+        # resolves at the call site via qualname instead
+        return None

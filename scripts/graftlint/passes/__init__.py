@@ -1,0 +1,22 @@
+"""The pass catalog.  Order is the report order; ids are the names
+``# graftlint: disable=<id>`` and the baseline file key on."""
+
+from .atomic_writes import AtomicWritesPass
+from .bench_schema import BenchSchemaPass
+from .collectives import CollectiveConsistencyPass
+from .donation import DonationSafetyPass
+from .host_sync import HostSyncPass
+from .locks import LockDisciplinePass
+
+ALL_PASSES = (
+    HostSyncPass,
+    AtomicWritesPass,
+    DonationSafetyPass,
+    LockDisciplinePass,
+    CollectiveConsistencyPass,
+    BenchSchemaPass,
+)
+
+__all__ = ["ALL_PASSES", "AtomicWritesPass", "BenchSchemaPass",
+           "CollectiveConsistencyPass", "DonationSafetyPass",
+           "HostSyncPass", "LockDisciplinePass"]

@@ -1,0 +1,98 @@
+"""atomic-writes — durable-layer writes must be tmp -> ``os.replace``.
+
+Absorbed from ``scripts/check_atomic_writes.py`` (ISSUE 5 satellite; the
+script is now a delegating shim).  The durability contract of
+``utils/persist.py`` / ``iteration/checkpoint.py`` / ``data/wal.py`` is
+*write tmp -> os.replace*: a crash mid-write must never leave a
+half-written file at a path a loader trusts.  Flags any
+``open(path, "w"/"wb"/"a"...)`` whose enclosing function never
+``os.replace``'s a path sharing a variable with the opened expression
+(writing INTO a tmp dir that is itself renamed counts: the shared
+variable is the tmp dir name).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from typing import List
+
+from ..core import ModuleInfo, Project
+from .base import LintPass
+
+#: the durable layer: every open-for-write here must be atomic
+#: (``robustness/durability.py`` joined this PR — the manifest/marker
+#: commit protocol lives there and must obey its own rule)
+DURABLE_MODULES = (
+    "flink_ml_tpu/utils/persist.py",
+    "flink_ml_tpu/iteration/checkpoint.py",
+    "flink_ml_tpu/data/wal.py",
+    "flink_ml_tpu/robustness/durability.py",
+)
+
+_WRITE_MODES = {"w", "wb", "w+", "wb+", "a", "ab"}
+
+
+def _names(node: ast.AST) -> set:
+    """Variable names referenced by an expression, skipping the ``os``
+    module root used in ``os.path.join(tmp, ...)``."""
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+    out.discard("os")
+    return out
+
+
+def _open_mode(call: ast.Call):
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        return call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            return kw.value.value
+    return None
+
+
+class AtomicWritesPass(LintPass):
+    id = "atomic-writes"
+    describes = ("durable-module open-for-write sites follow the "
+                 "write-tmp -> os.replace commit pattern")
+    roots = DURABLE_MODULES
+    scope_fixed = True      # the convention applies to the durable layer
+    hint = ("write to '<path>.tmp' then os.replace(tmp, path) — or write "
+            "into a tmp dir that is itself renamed")
+
+    def check_module(self, mod: ModuleInfo,
+                     project: Project) -> List:
+        findings = []
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            writes = []       # (node, path-variable names)
+            replaced = set()  # names appearing as os.replace source args
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                qual = mod.call_qualname(node)
+                if qual == "open" and node.args:
+                    mode = _open_mode(node)
+                    if mode and mode.strip("b+") in ("w", "a") \
+                            and mode in _WRITE_MODES:
+                        writes.append((node, _names(node.args[0])))
+                elif qual == "os.replace" and node.args:
+                    replaced |= _names(node.args[0])
+            for node, names in writes:
+                if not names:
+                    findings.append(mod.finding(
+                        self.id, node,
+                        "open-for-write on a literal path with no "
+                        "os.replace — not crash-atomic", hint=self.hint))
+                elif not names & replaced:
+                    findings.append(mod.finding(
+                        self.id, node,
+                        f"open-for-write on {sorted(names)} but "
+                        f"{fn.name}() never os.replace's a path sharing "
+                        "those names — a crash can leave a half-written "
+                        "file", hint=self.hint))
+        return findings

@@ -1,0 +1,59 @@
+"""bench-schema — bench.py <-> BENCH_SCHEMA.md drift (non-AST pass).
+
+Delegates to ``scripts/check_bench_schema.py`` (still the canonical
+implementation — its logic is regex-over-docs, not AST, and
+``tests/test_bench_schema.py`` exercises it directly); this pass folds
+it into the single ``python -m scripts.graftlint`` entry point so CI
+and humans run ONE command.  Each drift line becomes a Finding;
+``baseline_exempt`` keeps the runner from ever grandfathering one —
+schema drift is fixed, not accepted.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+from typing import List, Optional, Sequence
+
+from ..core import Finding, Project
+from .base import LintPass
+
+_SCRIPT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "check_bench_schema.py")
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location("check_bench_schema",
+                                                  _SCRIPT)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+class BenchSchemaPass(LintPass):
+    id = "bench-schema"
+    describes = ("bench.py metric-version literals match BENCH_SCHEMA.md "
+                 "and every emitted BENCH_*.json key is documented")
+    roots = ()
+    baseline_exempt = True
+    hint = ("bump bench.py and BENCH_SCHEMA.md together; document new "
+            "keys in the schema doc (scripts/check_bench_schema.py "
+            "--help for details)")
+
+    def run(self, project: Project,
+            paths: Optional[Sequence[str]] = None) -> List[Finding]:
+        if paths:
+            return []        # an explicit AST-path narrowing is active
+        checker = _load_checker()
+        problems = checker.check_versions()
+        import glob
+
+        documented = checker.schema_documented_keys(
+            open(checker.SCHEMA).read())
+        for path in sorted(glob.glob(os.path.join(project.repo,
+                                                  "BENCH_*.json"))):
+            problems += checker.check_json(path, documented)
+        return [Finding(pass_id=self.id, path="bench.py", line=0,
+                        message=p, symbol="<schema>", hint=self.hint)
+                for p in problems]

@@ -1,0 +1,364 @@
+"""donation-safety — a donated buffer is never read again.
+
+The hazard ``iteration/core.py::_private_copy`` exists to prevent: a
+value passed at a ``donate_argnums`` position of a jitted function is
+*consumed* — XLA may reuse its buffer for the output, so any later read
+of the same Python name observes garbage (or trips the deleted-buffer
+check, backend-dependent and often only on TPU).  PR 1's donated-carry
+chunk scan and PR 7's resume paths both had to get this right by hand;
+this pass checks it everywhere.
+
+What counts as a donating callable:
+
+- ``name = jax.jit(fn, donate_argnums=...)`` with a non-empty literal
+  (or conditional ``(0,) if cfg else ()`` — treated as donating, since
+  the read-after-donate is a bug whenever the condition holds);
+- a def decorated ``@partial(jax.jit, donate_argnums=...)``;
+- a *factory*: a local function whose returned value flows from a
+  ``jax.jit(..., donate_argnums=<param>)`` call (``serving/executor.py::
+  _serving_jit``) — call sites with a literal at that parameter bind a
+  donating callable;
+- a direct ``jax.jit(fn, donate_argnums=...)(args...)`` call.
+
+The check is a small path-sensitive walk over each function body: a
+bare name passed at a donated position becomes *donated*; a later Load
+of it on any path is a finding; a Store (including the common
+``state = step(state, ...)`` rebind) clears it.  Loop bodies run twice
+so a donation on iteration N is seen by a read at the loop head on
+iteration N+1 — the resume-path shape of the bug.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from typing import Dict, List, Optional, Set
+
+from ..core import ModuleInfo, Project
+from .base import LintPass
+
+_PARTIAL = {"functools.partial", "partial"}
+
+
+def _jit_call(mod: ModuleInfo, node) -> bool:
+    return isinstance(node, ast.Call) and \
+        mod.call_qualname(node) in ("jax.jit", "jit")
+
+
+def _donate_kwarg(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            return kw.value
+    return None
+
+
+def _positions(expr) -> Optional[Set[int]]:
+    """Donated positions from a donate_argnums expression: int / tuple
+    literal, or the union over a conditional's arms.  None = statically
+    unknown (the pass then skips — it cannot name positions)."""
+    if expr is None:
+        return set()
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return {expr.value}
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for el in expr.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.add(el.value)
+            else:
+                return None
+        return out
+    if isinstance(expr, ast.IfExp):
+        a, b = _positions(expr.body), _positions(expr.orelse)
+        if a is None or b is None:
+            return None
+        return a | b
+    return None
+
+
+def _jit_donation_positions(mod: ModuleInfo, call: ast.Call,
+                            ) -> Optional[Set[int]]:
+    """Donated positions of a ``jax.jit(...)`` call (empty set = not
+    donating)."""
+    expr = _donate_kwarg(call)
+    if expr is None:
+        return set()
+    return _positions(expr)
+
+
+class _Factory:
+    """A local function that manufactures donating callables: calls get
+    their positions from the argument bound to ``param`` (name or
+    index)."""
+
+    def __init__(self, param_name: str, param_index: int):
+        self.param_name = param_name
+        self.param_index = param_index
+
+    def positions_at_call(self, call: ast.Call) -> Optional[Set[int]]:
+        if self.param_index < len(call.args):
+            return _positions(call.args[self.param_index])
+        for kw in call.keywords:
+            if kw.arg == self.param_name:
+                return _positions(kw.value)
+        return set()        # param defaulted — assume non-donating
+
+
+def _find_factories(mod: ModuleInfo) -> Dict[str, _Factory]:
+    """Local functions whose body jits with ``donate_argnums`` flowing
+    from one of their parameters."""
+    out: Dict[str, _Factory] = {}
+    for fns in mod.functions.values():
+        for fn in fns:
+            params = [a.arg for a in fn.args.args]
+            for node in ast.walk(fn):
+                if not _jit_call(mod, node):
+                    continue
+                expr = _donate_kwarg(node)
+                if expr is None:
+                    continue
+                # names feeding the donate expr, one assignment hop deep
+                feed = {n.id for n in ast.walk(expr)
+                        if isinstance(n, ast.Name)}
+                for stmt in ast.walk(fn):
+                    if isinstance(stmt, ast.Assign) and \
+                            len(stmt.targets) == 1 and \
+                            isinstance(stmt.targets[0], ast.Name) and \
+                            stmt.targets[0].id in feed:
+                        feed |= {n.id for n in ast.walk(stmt.value)
+                                 if isinstance(n, ast.Name)}
+                for p in params:
+                    if p in feed:
+                        out[fn.name] = _Factory(p, params.index(p))
+                        break
+    return out
+
+
+class DonationSafetyPass(LintPass):
+    id = "donation-safety"
+    describes = ("a value passed at a donate_argnums position of a "
+                 "jitted function is never read again on any path")
+    roots = ("flink_ml_tpu", "scripts")
+    hint = ("rebind the result over the donated name "
+            "(state = step(state, ...)) or donate a private copy "
+            "(iteration/core.py::_private_copy)")
+
+    def check_module(self, mod: ModuleInfo,
+                     project: Project) -> List:
+        factories = _find_factories(mod)
+        # module-level donating callables: name -> positions
+        module_donating: Dict[str, Set[int]] = {}
+        for stmt in mod.tree.body:
+            self._collect_bindings(mod, stmt, factories, module_donating)
+
+        findings: List = []
+        for fns in mod.functions.values():
+            for fn in fns:
+                self._check_function(mod, fn, factories,
+                                     dict(module_donating), findings)
+        # unique per (line, name)
+        seen, out = set(), []
+        for f in findings:
+            key = (f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+        return out
+
+    # -- binding collection --------------------------------------------------
+    def _collect_bindings(self, mod, stmt, factories,
+                          donating: Dict[str, Set[int]]) -> None:
+        """Record ``name = <donating callable>`` bindings from one
+        statement (module- or function-level)."""
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                isinstance(stmt.value, ast.Call):
+            name = stmt.targets[0].id
+            pos = self._call_positions(mod, stmt.value, factories)
+            if pos:
+                donating[name] = pos
+            elif name in donating:
+                del donating[name]      # rebound to something else
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in stmt.decorator_list:
+                if isinstance(dec, ast.Call) and \
+                        mod.call_qualname(dec) in _PARTIAL and dec.args and \
+                        mod.qualname(dec.args[0]) in ("jax.jit", "jit"):
+                    pos = _positions(_donate_kwarg(dec))
+                    if pos:
+                        donating[stmt.name] = pos
+
+    def _call_positions(self, mod, call: ast.Call, factories,
+                        ) -> Optional[Set[int]]:
+        """Donated positions of the callable a Call produces (jit call or
+        factory call), or empty/None."""
+        qual = mod.call_qualname(call)
+        if qual in ("jax.jit", "jit"):
+            return _jit_donation_positions(mod, call)
+        fname = call.func.id if isinstance(call.func, ast.Name) else None
+        if fname in factories:
+            return factories[fname].positions_at_call(call)
+        return set()
+
+    # -- per-function walk ---------------------------------------------------
+    def _check_function(self, mod, fn, factories, donating, findings):
+        donated: Dict[str, ast.Call] = {}    # name -> the donating call
+
+        def handle_loads(expr, fresh=()):
+            """Flag Loads of donated names in ``expr``.  ``fresh`` names
+            were donated by a call inside THIS expression: only a read
+            textually AFTER that call's end is a read-after-donate —
+            Python evaluates left-to-right, so ``f(state) + state.sum()``
+            reads the donated buffer but ``state.sum() + f(state)`` does
+            not (and the donated argument itself sits inside the call
+            span)."""
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        node.id in donated:
+                    call = donated[node.id]
+                    if node.id in fresh:
+                        call_end = (getattr(call, "end_lineno",
+                                            call.lineno),
+                                    getattr(call, "end_col_offset", 1 << 30))
+                        if (node.lineno, node.col_offset) <= call_end:
+                            continue
+                    callee = (mod.qualname(call.func)
+                              or getattr(call.func, "id", "<jitted>"))
+                    findings.append(mod.finding(
+                        self.id, node,
+                        f"'{node.id}' is read after being passed at a "
+                        f"donated position of {callee}() at line "
+                        f"{call.lineno} — the donated buffer may have "
+                        "been reused by XLA", hint=self.hint))
+                    del donated[node.id]     # report once per donation
+
+        def handle_calls(expr):
+            """Mark names donated by donating calls inside ``expr``."""
+            for node in ast.walk(expr):
+                if not isinstance(node, ast.Call):
+                    continue
+                pos: Optional[Set[int]] = None
+                if isinstance(node.func, ast.Name):
+                    name = node.func.id
+                    if name in donating:
+                        pos = donating[name]
+                elif _jit_call(mod, node.func):
+                    # jax.jit(f, donate_argnums=...)(args)
+                    pos = _jit_donation_positions(mod, node.func)
+                if not pos:
+                    continue
+                for p in pos:
+                    if p < len(node.args) and \
+                            isinstance(node.args[p], ast.Name):
+                        donated[node.args[p].id] = node
+
+        def process_expr(expr):
+            """One expression, evaluation-order-aware: record donations
+            made by calls inside it, THEN check loads — names donated by
+            this very expression only flag when read after the call's
+            span (``f(state) + state.sum()``)."""
+            prior = set(donated)
+            handle_calls(expr)
+            handle_loads(expr, fresh=set(donated) - prior)
+
+        def kill_targets(target):
+            for node in ast.walk(target):
+                if isinstance(node, ast.Name):
+                    donated.pop(node.id, None)
+
+        def exec_stmt(stmt) -> bool:
+            """Process one statement; True = control never falls through
+            (return/raise/break/continue) — later statements in the
+            block, and sibling-branch merges, must not see this path's
+            donations."""
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                # nested defs: bindings only (their bodies are checked as
+                # their own functions via mod.functions)
+                self._collect_bindings(mod, stmt, factories, donating)
+                return False
+            if isinstance(stmt, ast.Assign):
+                process_expr(stmt.value)
+                for t in stmt.targets:
+                    kill_targets(t)
+                self._collect_bindings(mod, stmt, factories, donating)
+                return False
+            if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                if stmt.value is not None:
+                    process_expr(stmt.value)
+                if isinstance(stmt, ast.AugAssign):
+                    handle_loads(stmt.target)
+                kill_targets(stmt.target)
+                return False
+            if isinstance(stmt, ast.Expr):
+                process_expr(stmt.value)
+                return False
+            if isinstance(stmt, ast.Return):
+                if stmt.value is not None:
+                    process_expr(stmt.value)
+                return True
+            if isinstance(stmt, ast.Raise):
+                for part in (stmt.exc, stmt.cause):
+                    if part is not None:
+                        process_expr(part)
+                return True
+            if isinstance(stmt, (ast.Break, ast.Continue)):
+                return True
+            if isinstance(stmt, ast.If):
+                process_expr(stmt.test)
+                snap = dict(donated)
+                t_body = exec_block(stmt.body)
+                after_body = dict(donated)
+                donated.clear()
+                donated.update(snap)
+                t_else = exec_block(stmt.orelse)
+                # a name donated on ANY path that REACHES here stays
+                # donated; an arm that returned/raised contributes
+                # nothing to the fall-through state
+                if t_body and t_else:
+                    return True
+                if t_else:
+                    donated.clear()
+                if not t_body:
+                    donated.update(after_body)
+                return False
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                process_expr(stmt.iter)
+                for _ in range(2):       # back-edge: donations reach the head
+                    kill_targets(stmt.target)
+                    exec_block(stmt.body)
+                exec_block(stmt.orelse)
+                return False
+            if isinstance(stmt, ast.While):
+                for _ in range(2):
+                    process_expr(stmt.test)
+                    exec_block(stmt.body)
+                exec_block(stmt.orelse)
+                return False
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    process_expr(item.context_expr)
+                    if item.optional_vars is not None:
+                        kill_targets(item.optional_vars)
+                return exec_block(stmt.body)
+            if isinstance(stmt, ast.Try):
+                exec_block(stmt.body)
+                for h in stmt.handlers:
+                    exec_block(h.body)
+                exec_block(stmt.orelse)
+                exec_block(stmt.finalbody)
+                return False
+            # default: inspect all expressions in the statement
+            for node in ast.iter_child_nodes(stmt):
+                if isinstance(node, ast.expr):
+                    process_expr(node)
+            return False
+
+        def exec_block(stmts) -> bool:
+            for s in stmts:
+                if exec_stmt(s):
+                    return True      # later statements are unreachable
+            return False
+
+        exec_block(fn.body)

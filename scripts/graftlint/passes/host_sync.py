@@ -1,0 +1,126 @@
+"""host-sync — no host synchronization inside step/scan bodies.
+
+Absorbed from ``scripts/check_no_host_sync.py`` (ISSUE 6 satellite; that
+script is now a delegating shim).  The communication-overlap schedule
+(``grad_reduce.pipelined_reduce``) only buys anything if the device
+queue stays full: a ``block_until_ready`` / ``jax.device_get`` /
+``np.asarray`` / ``.item()`` inside a step body fences the dispatch
+stream and silently destroys the overlap (and PR 1's chunked-dispatch
+amortization with it).
+
+A function is a step body if (a) it is named like one (``update``,
+``*_step``, ``*_body``, ...) or (b) it is passed by reference as the
+scanned body to ``lax.scan`` / ``masked_chunk_scan`` / ``while_loop`` /
+``fori_loop`` anywhere in the module; nested helper defs inside a step
+body are covered by the AST walk.  Heuristic by design (AST names, not
+tracing) — step bodies are pure device math in this repo, so ANY of the
+four calls is a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from typing import List
+
+from ..core import ModuleInfo, Project
+from .base import LintPass
+
+#: function names that ARE step/scan bodies in this repo's idiom
+STEP_NAMES = {
+    "update", "batch_step", "scan_step", "chunk_step", "device_fn",
+    "train_step", "epoch_body", "body", "step",
+}
+
+STEP_SUFFIXES = ("_step", "_body", "_update")
+
+#: callables whose argument is a scanned/stepped body function
+SCAN_CALLEES = {"scan", "masked_chunk_scan", "while_loop", "fori_loop"}
+
+#: every step/scan body in these trees must stay host-sync-free
+#: (``online/`` joined with ISSUE 7: its driver feeds the same chunked
+#: scan, so a host sync in a step-named helper there would fence the
+#: training dispatch stream the publishes ride on)
+SCAN_ROOTS = (
+    "flink_ml_tpu/models",
+    "flink_ml_tpu/online",
+    "flink_ml_tpu/parallel",
+)
+
+
+def is_step_name(name: str) -> bool:
+    return name in STEP_NAMES or name.endswith(STEP_SUFFIXES)
+
+
+def scanned_body_names(tree: ast.AST) -> set:
+    """Names passed as the body argument to scan-family calls anywhere in
+    the module — step bodies regardless of their name."""
+    out = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name not in SCAN_CALLEES or not node.args:
+            continue
+        args = node.args
+        cands = [args[2]] if name == "fori_loop" and len(args) >= 3 \
+            else args[:2] if name == "while_loop" else [args[0]]
+        for cand in cands:
+            if isinstance(cand, ast.Name):
+                out.add(cand.id)
+    return out
+
+
+def sync_kind(mod: ModuleInfo, call: ast.Call):
+    """The host-sync kind of a call, or None.  ``np.asarray`` matches
+    through import aliasing (``import numpy as onp`` included)."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "block_until_ready":
+            return "block_until_ready"
+        if f.attr == "item":
+            return ".item()"
+        if f.attr == "device_get":
+            return "jax.device_get"
+        if f.attr == "asarray":
+            root = mod.qualname(f.value)
+            if root in ("numpy", "np", "onp"):
+                return "np.asarray"
+    elif isinstance(f, ast.Name) and f.id == "device_get":
+        return "device_get"
+    return None
+
+
+class HostSyncPass(LintPass):
+    id = "host-sync"
+    describes = ("no host synchronization (block_until_ready/device_get/"
+                 "np.asarray/.item) inside step or scan-body functions")
+    roots = SCAN_ROOTS
+    scope_fixed = True      # the convention applies to the step trees
+    hint = ("keep step bodies pure device math; fetch on the host side of "
+            "the dispatch boundary (see ARCHITECTURE.md 'Gradient "
+            "reduction')")
+
+    def check_module(self, mod: ModuleInfo,
+                     project: Project) -> List:
+        scanned = scanned_body_names(mod.tree)
+        findings, seen = [], set()
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not (is_step_name(fn.name) or fn.name in scanned):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = sync_kind(mod, node)
+                if kind and node.lineno not in seen:
+                    seen.add(node.lineno)
+                    findings.append(mod.finding(
+                        self.id, node,
+                        f"{kind} inside step body {fn.name}() — a host "
+                        "sync here fences the dispatch stream and "
+                        "destroys comm/compute overlap", hint=self.hint))
+        return findings

@@ -1,0 +1,325 @@
+"""lock-discipline — no Lock/RLock held across a blocking call.
+
+THE bug class of PR 1's ``flush_lock``-across-``put`` deadlock: a lock
+held while parking on a bounded queue (or a thread join, a sleep, a
+``device_put``, file/socket I/O) serializes the pipeline at best and
+deadlocks it at worst — every lock site in ``data/prefetch.py``,
+``serving/``, ``online/publish.py`` and ``utils/padding.py`` follows
+the convention *compute under the lock, block outside it*.
+
+Mechanics:
+
+- **Lock identification** — names/attributes assigned from
+  ``threading.Lock()`` / ``RLock()`` (including ``self._x = Lock()``
+  and dataclass ``field(default_factory=threading.Lock)``), plus the
+  naming convention: any ``with``/``acquire`` target whose trailing
+  name contains "lock" or "mutex".
+- **Held regions** — ``with lock:`` bodies, and linear
+  ``lock.acquire()`` ... ``lock.release()`` spans in statement order
+  (which correctly models the release-before-put / reacquire pattern
+  ``prefetch._flush_ready`` uses).
+- **Blocking calls** — ``queue.put/get`` on queue-typed or queue-named
+  receivers, ``Thread.join``, ``time.sleep``, ``jax.device_put``,
+  ``block_until_ready``, ``open``, socket send/recv, ``Event/\
+  Condition.wait``, ``Future.result`` — and any call to a local
+  function whose body (transitively, depth-capped) contains one:
+  the follow-by-reference analysis that caught the original
+  ``_flush_ready`` shape.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from typing import Dict, List, Optional, Tuple
+
+from ..core import ModuleInfo, Project
+from .base import LintPass
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+_QUEUE_CTORS = {"queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+                "queue.PriorityQueue", "Queue", "SimpleQueue"}
+_LOCKISH_RE = re.compile(r"(lock|mutex)", re.IGNORECASE)
+_QUEUEISH_RE = re.compile(r"(^|_)(q|fq|queue)$|queue", re.IGNORECASE)
+_THREADISH_RE = re.compile(r"(thread|worker|proc|pool)|^te?$",
+                           re.IGNORECASE)
+_FUTISH_RE = re.compile(r"fut", re.IGNORECASE)
+_SOCKET_ATTRS = {"recv", "recv_into", "send", "sendall", "accept",
+                 "connect"}
+_ALWAYS_BLOCKING_QUALS = {
+    "time.sleep", "jax.device_put", "device_put",
+    "jax.block_until_ready", "futures.wait",
+    "concurrent.futures.wait", "select.select",
+}
+
+_MAX_DEPTH = 4
+
+
+def _trailing_name(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _expr_key(node) -> Optional[str]:
+    """Stable textual identity for a lock expression ("self._lock",
+    "flush_lock")."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _ModuleFacts:
+    """Per-module name classification: which names are locks, queues,
+    threads (constructor-tracked, annotation-tracked, plus the naming
+    conventions)."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.lock_keys: set = set()
+        self.queue_names: set = set()
+        self.thread_names: set = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                value = node.value
+                ctor = self._ctor_qual(value)
+                ann = getattr(node, "annotation", None)
+                ann_qual = mod.qualname(ann) if ann is not None else None
+                for t in targets:
+                    key = _expr_key(t)
+                    name = _trailing_name(t)
+                    if key is None or name is None:
+                        continue
+                    if ctor in _LOCK_CTORS or self._lock_factory(value):
+                        self.lock_keys.add(key)
+                    if ctor in _QUEUE_CTORS or (
+                            ann_qual and "Queue" in ann_qual):
+                        self.queue_names.add(name)
+                    if ctor in ("threading.Thread", "Thread"):
+                        self.thread_names.add(name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for a in node.args.args + node.args.kwonlyargs:
+                    if a.annotation is not None:
+                        q = mod.qualname(a.annotation)
+                        if q and "Queue" in q:
+                            self.queue_names.add(a.arg)
+
+    def _ctor_qual(self, value) -> Optional[str]:
+        if isinstance(value, ast.Call):
+            return self.mod.call_qualname(value)
+        return None
+
+    def _lock_factory(self, value) -> bool:
+        """``field(default_factory=threading.Lock)``."""
+        if not (isinstance(value, ast.Call)
+                and self.mod.call_qualname(value) in
+                ("dataclasses.field", "field")):
+            return False
+        for kw in value.keywords:
+            if kw.arg == "default_factory" and \
+                    self.mod.qualname(kw.value) in _LOCK_CTORS:
+                return True
+        return False
+
+    def is_lock(self, expr) -> bool:
+        key = _expr_key(expr)
+        name = _trailing_name(expr)
+        if key in self.lock_keys:
+            return True
+        return bool(name and _LOCKISH_RE.search(name))
+
+    def is_queueish(self, expr) -> bool:
+        name = _trailing_name(expr)
+        return bool(name and (name in self.queue_names
+                              or _QUEUEISH_RE.search(name)))
+
+    def is_threadish(self, expr) -> bool:
+        name = _trailing_name(expr)
+        return bool(name and (name in self.thread_names
+                              or _THREADISH_RE.search(name)))
+
+
+def _blocking_reason(mod: ModuleInfo, facts: _ModuleFacts,
+                     call: ast.Call) -> Optional[str]:
+    """Why a single call is blocking, or None.  Local-function
+    transitivity is layered on top by ``_fn_blocking``."""
+    qual = mod.call_qualname(call)
+    if qual in _ALWAYS_BLOCKING_QUALS:
+        return qual
+    if qual == "open":
+        return "open() file I/O"
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    attr = f.attr
+    if attr == "block_until_ready":
+        return "block_until_ready"
+    if attr == "sleep" and qual and qual.endswith("time.sleep"):
+        return "time.sleep"
+    if attr in ("put", "get", "put_nowait", "get_nowait"):
+        if attr.endswith("_nowait"):
+            return None
+        if facts.is_queueish(f.value):
+            return f"queue {attr}()"
+        return None
+    if attr == "join":
+        if isinstance(f.value, ast.Constant):
+            return None                       # "sep".join(...)
+        if mod.qualname(f.value) in ("os.path", "posixpath", "ntpath"):
+            return None
+        if facts.is_threadish(f.value):
+            return "Thread.join"
+        return None
+    if attr in _SOCKET_ATTRS:
+        return f"socket .{attr}()"
+    if attr == "wait":
+        return ".wait()"
+    if attr == "result":
+        name = _trailing_name(f.value)
+        if name and _FUTISH_RE.search(name):
+            return "Future.result()"
+        return None
+    return None
+
+
+def _fn_blocking(mod: ModuleInfo, facts: _ModuleFacts, fn,
+                 memo: Dict[str, Optional[str]], depth: int = 0,
+                 ) -> Optional[str]:
+    """First blocking reason anywhere in ``fn`` (transitive through
+    bare-name calls to local functions, depth-capped), or None.
+    Ignores the callee's own lock regions — a callee that blocks while
+    NOT holding our lock still blocks us."""
+    if fn.name in memo:
+        return memo[fn.name]
+    memo[fn.name] = None          # cycle guard
+    reason = None
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        reason = _blocking_reason(mod, facts, node)
+        if reason:
+            break
+        if depth < _MAX_DEPTH and isinstance(node.func, ast.Name) and \
+                node.func.id in mod.functions and \
+                node.func.id != fn.name:
+            inner = _fn_blocking(mod, facts,
+                                 mod.functions[node.func.id][-1],
+                                 memo, depth + 1)
+            if inner:
+                reason = f"{node.func.id}() -> {inner}"
+                break
+    memo[fn.name] = reason
+    return reason
+
+
+class LockDisciplinePass(LintPass):
+    id = "lock-discipline"
+    describes = ("no threading.Lock/RLock held across a blocking call "
+                 "(queue put/get, join, sleep, device_put, "
+                 "block_until_ready, file/socket I/O)")
+    roots = ("flink_ml_tpu", "scripts")
+    hint = ("compute under the lock, block outside it — snapshot what "
+            "you need, release, then block (prefetch._flush_ready is "
+            "the worked example)")
+
+    def check_module(self, mod: ModuleInfo,
+                     project: Project) -> List:
+        facts = _ModuleFacts(mod)
+        memo: Dict[str, Optional[str]] = {}
+        findings: List = []
+        for fns in mod.functions.values():
+            for fn in fns:
+                self._check_fn(mod, facts, fn, memo, findings)
+        return findings
+
+    def _check_fn(self, mod, facts, fn, memo, findings):
+        held: List[Tuple[str, int]] = []      # (lock key, acquire line)
+
+        def check_call(node: ast.Call):
+            if not held:
+                return
+            # acquire/release themselves are region markers, not
+            # blocking events (nested-lock ordering is out of scope)
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("acquire", "release") and \
+                    facts.is_lock(node.func.value):
+                return
+            reason = _blocking_reason(mod, facts, node)
+            if reason is None and isinstance(node.func, ast.Name) and \
+                    node.func.id in mod.functions:
+                inner = _fn_blocking(mod, facts,
+                                     mod.functions[node.func.id][-1],
+                                     memo, 1)
+                if inner:
+                    reason = f"{node.func.id}() -> {inner}"
+            if reason:
+                lock, line = held[-1]
+                findings.append(mod.finding(
+                    self.id, node,
+                    f"{lock} (held since line {line}) is held across a "
+                    f"blocking call: {reason} — blocking under a lock "
+                    "stalls every other thread at best and deadlocks "
+                    "under backpressure at worst", hint=self.hint))
+
+        def scan_expr(expr):
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call):
+                    check_call(node)
+                    # acquire()/release() toggle the held set even when
+                    # embedded in a larger statement
+                    if isinstance(node.func, ast.Attribute) and \
+                            facts.is_lock(node.func.value):
+                        key = _expr_key(node.func.value) or "<lock>"
+                        if node.func.attr == "acquire":
+                            held.append((key, node.lineno))
+                        elif node.func.attr == "release":
+                            for i in range(len(held) - 1, -1, -1):
+                                if held[i][0] == key:
+                                    del held[i]
+                                    break
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.Lambda)):
+                    return    # nested callables checked on their own
+
+        def exec_stmt(stmt):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                lock_items = []
+                for item in stmt.items:
+                    scan_expr(item.context_expr)
+                    if isinstance(item.context_expr, ast.Call):
+                        continue      # ``with pool:`` etc
+                    if facts.is_lock(item.context_expr):
+                        key = _expr_key(item.context_expr) or "<lock>"
+                        held.append((key, stmt.lineno))
+                        lock_items.append(key)
+                exec_block(stmt.body)
+                for _ in lock_items:
+                    held.pop()
+                return
+            for node in ast.iter_child_nodes(stmt):
+                if isinstance(node, ast.expr):
+                    scan_expr(node)
+            for attr in ("body", "orelse", "finalbody"):
+                for sub in getattr(stmt, attr, []) or []:
+                    exec_stmt(sub)
+            for h in getattr(stmt, "handlers", []) or []:
+                exec_block(h.body)
+
+        def exec_block(stmts):
+            for s in stmts:
+                exec_stmt(s)
+
+        exec_block(fn.body)
