@@ -939,6 +939,112 @@ def bench_kmeans(results: dict) -> None:
         4 * n * K * D * tpu_rate / 1e12, 1)
 
 
+def bench_workset(results: dict) -> None:
+    """Workset-iteration leg (workset_metric_version 1): bound-filtered
+    KMeans vs the BSP fit on the same clustered dataset, A/B in one run.
+
+    Reports rounds-to-converge (the while_loop exit vs the BSP loop's
+    fixed maxIter), the active-fraction decay curve (how fast the Hamerly
+    bounds settle the points), and assign-FLOPs-actually-spent vs BSP —
+    the bound-filter accounting: points scored per round x the per-point
+    assign cost, vs every-point-every-round.  The fused program still
+    scores densely (fixed shapes), so the FLOPs ratio is the sum of the
+    early-exit saving (real wall-clock today) and the bound-filter saving
+    (what a compacting backend banks); both components are in the notes.
+
+    Headline fields are PRE-NULLED at entry: a mid-leg failure (or a
+    degraded backend) still emits every documented key, as null, instead
+    of silently dropping the series."""
+    import jax
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.distance import DistanceMeasure
+    from flink_ml_tpu.iteration import IterationConfig, iterate
+    from flink_ml_tpu.models.clustering import kmeans as km
+
+    results["workset_rounds_to_converge"] = None
+    results["workset_bsp_rounds"] = None
+    results["workset_assign_flops_ratio"] = None
+    results["workset_bitexact"] = None
+    notes = results["notes"].setdefault("workset", {})
+    results["notes"]["workset_metric_version"] = 1
+
+    smoke = _smoke()
+    n = 1 << 14 if smoke else 1 << 19
+    k, d = (16, 32) if smoke else (64, 64)
+    max_iter = 96
+    measure = DistanceMeasure.get_instance("euclidean")
+    mesh = km.default_mesh()
+
+    # clustered blobs generated ON DEVICE (convergence must actually
+    # happen before max_iter — unstructured noise would not converge and
+    # the leg would measure nothing)
+    @jax.jit
+    def gen(key):
+        kc, kl, kn = jax.random.split(key, 3)
+        centers = jax.random.normal(kc, (k, d), jnp.float32) * 8.0
+        lab = jax.random.randint(kl, (n,), 0, k, jnp.int32)
+        pts = centers[lab] + jax.random.normal(kn, (n, d), jnp.float32) * 0.4
+        return pts
+
+    # shard the batch dim over the mesh's data axis (device->device
+    # reshard, nothing crosses the host tunnel) so a multi-device run
+    # actually measures the SPMD loop — incl. the mask psum the exit
+    # decision rides — and a 1-device host is a no-op placement
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharded = NamedSharding(mesh, P("data"))
+    points = jax.device_put(gen(jax.random.PRNGKey(42)), sharded)
+    mask = jax.device_put(jnp.ones((n,), jnp.float32), sharded)
+    init = km.replicate(points[:k] + 0.0, mesh)
+    notes["mesh_devices"] = int(np.prod(list(mesh.shape.values())))
+
+    bsp_body = km.kmeans_epoch_step(measure, k)
+    ws_body = km.kmeans_workset_epoch_step(measure, k)
+    plan = km._fit_plan(n, d, k, measure, mesh, workset=True)
+
+    def run_bsp():
+        return iterate(bsp_body, init, (points, mask), max_epochs=max_iter,
+                       config=IterationConfig(mode="fused"))
+
+    def run_ws():
+        return iterate(ws_body, init, (points, mask), max_epochs=max_iter,
+                       workset=plan.init_workset(mask),
+                       config=IterationConfig(mode="fused"))
+
+    run_bsp(); run_ws()  # compile + warmup
+    start = time.perf_counter()
+    res_bsp = run_bsp()
+    np.asarray(jax.device_get(res_bsp.state))
+    bsp_wall = time.perf_counter() - start
+    start = time.perf_counter()
+    res_ws = run_ws()
+    c_ws = np.asarray(jax.device_get(res_ws.state))
+    ws_wall = time.perf_counter() - start
+
+    c_bsp = np.asarray(jax.device_get(res_bsp.state))
+    results["workset_bitexact"] = bool(np.array_equal(c_bsp, c_ws))
+    results["workset_rounds_to_converge"] = res_ws.num_epochs
+    results["workset_bsp_rounds"] = res_bsp.num_epochs
+
+    frac = np.asarray(
+        res_ws.side["epoch_trace"]["active_fraction"], np.float64)
+    scored = km.workset_points_scored(frac, n, n)
+    unit = 4.0 * k * d            # assign flops per point scored
+    bsp_flops = res_bsp.num_epochs * n * unit
+    ws_flops = float(scored.sum()) * unit
+    results["workset_assign_flops_ratio"] = (
+        round(bsp_flops / ws_flops, 2) if ws_flops > 0 else None)
+    notes["active_fraction_curve"] = [round(float(f), 4) for f in frac[:32]]
+    notes["points_scored_min_frac"] = (
+        round(float(scored.min()) / n, 4) if scored.size else None)
+    notes["early_exit_flops_ratio"] = round(
+        float(res_bsp.num_epochs) / max(res_ws.num_epochs, 1), 2)
+    notes["bsp_wall_s"] = round(bsp_wall, 3)
+    notes["ws_wall_s"] = round(ws_wall, 3)
+    notes["shape"] = f"n={n} k={k} d={d} max_iter={max_iter}"
+
+
 def _probe_tpu_backend(timeout_s: int = 240) -> bool:
     """Is the axon TPU actually reachable?  During a relay outage the
     first device use blocks ~25 min inside make_c_api_client before
@@ -2242,9 +2348,9 @@ def main() -> None:
             "headline leg failed mid-run (backend died after the "
             "probe?) — this line records the failure, not a rate")
     for leg in (bench_logreg_outofcore, bench_criteo_e2e, bench_kmeans,
-                bench_widedeep, bench_als, bench_gbt, bench_online_ftrl,
-                bench_serving, bench_pipeline, bench_comm, bench_wal,
-                bench_recovery, bench_online):
+                bench_workset, bench_widedeep, bench_als, bench_gbt,
+                bench_online_ftrl, bench_serving, bench_pipeline,
+                bench_comm, bench_wal, bench_recovery, bench_online):
         try:
             leg(results)
         except Exception as exc:   # noqa: BLE001

@@ -5,6 +5,8 @@ from .body import (  # noqa: F401
     IterationConfig,
     IterationListener,
     OperatorLifeCycle,
+    Workset,
+    active_fraction,
 )
 from .checkpoint import (  # noqa: F401
     CheckpointConfig,

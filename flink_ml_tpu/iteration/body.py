@@ -26,6 +26,8 @@ __all__ = [
     "EpochContext",
     "OperatorLifeCycle",
     "IterationConfig",
+    "Workset",
+    "active_fraction",
     "normalize_body_result",
 ]
 
@@ -72,6 +74,62 @@ class IterationConfig:
             raise ValueError(
                 f"steps_per_dispatch must be >= 1, got "
                 f"{self.steps_per_dispatch}")
+
+
+@dataclass
+class Workset:
+    """Device-resident active set riding the iteration carry — the delta-
+    iteration workset of Ewen et al. (*Spinning Fast Iterative Data
+    Flows*) rebuilt TPU-native: where the reference streams the changed
+    elements through a feedback edge each superstep, here the workset is
+    a mask over device-resident data that never leaves HBM.
+
+    - ``mask``: per-element activity, float32 0/1 (or bool) arrays — a
+      single array or a pytree of them (ALS masks users AND items).  An
+      element with mask 0 is *provably settled this round*: the body must
+      reuse its cached contribution instead of recomputing it.
+    - ``bounds``: optional per-element bound state the body uses to decide
+      settlement (Hamerly upper/lower distance bounds for KMeans, cached
+      assignments, movement deltas, ...).  Rides the carry — and therefore
+      chunk-boundary checkpoints — untouched by the driver.
+
+    The driver terminates when :func:`active_fraction` falls to
+    ``workset_tol`` (default exactly zero): an empty workset is the
+    reference's empty-workset termination criterion
+    (``SharedProgressAligner``'s zero-feedback-records rule applied to the
+    delta iteration's solution-set updates).
+    """
+
+    mask: Any
+    bounds: Any = None
+
+
+def _workset_flatten(ws: Workset):
+    return (ws.mask, ws.bounds), None
+
+
+def _workset_unflatten(_, children):
+    return Workset(*children)
+
+
+jax.tree_util.register_pytree_node(Workset, _workset_flatten,
+                                   _workset_unflatten)
+
+
+def active_fraction(workset: Workset):
+    """Global fraction of active elements, as a traced scalar: total mask
+    mass over total element count across every mask leaf.  Under a jitted
+    SPMD program with sharded masks XLA inserts the cross-device psum —
+    every shard sees the same replicated scalar, so the while_loop exit
+    decision is mesh-consistent by construction."""
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(workset.mask)
+    total = sum(x.size for x in leaves)
+    if total == 0:
+        return jnp.asarray(0.0, jnp.float32)
+    act = sum(jnp.sum(x.astype(jnp.float32)) for x in leaves)
+    return act / jnp.asarray(float(total), jnp.float32)
 
 
 @dataclass

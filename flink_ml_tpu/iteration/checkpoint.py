@@ -74,9 +74,17 @@ def _decode_key(node: Any) -> Any:
 def _encode_structure(tree: Any, leaves: List[np.ndarray]) -> Any:
     """JSON-able structure skeleton with leaf placeholders.  Supports dict /
     list / tuple / namedtuple / None containers — the practical shapes of
-    training state (incl. optax NamedTuple optimizer states)."""
+    training state (incl. optax NamedTuple optimizer states) — plus the
+    iteration runtime's :class:`~.body.Workset` (a workset iteration's
+    hosted carry is ``(state, Workset)``, so the active-set mask and bound
+    state round-trip through crash-recovery cuts bit-exactly)."""
+    from .body import Workset
+
     if tree is None:
         return None
+    if isinstance(tree, Workset):
+        return {"__workset__": [_encode_structure(tree.mask, leaves),
+                                _encode_structure(tree.bounds, leaves)]}
     if isinstance(tree, dict):
         return {"__dict__": [[_encode_key(k), _encode_structure(v, leaves)]
                              for k, v in tree.items()]}
@@ -106,8 +114,14 @@ def _resolve_namedtuple(qualified: str):
 
 
 def _decode_structure(node: Any, leaves: Dict[int, np.ndarray]) -> Any:
+    from .body import Workset
+
     if node is None:
         return None
+    if "__workset__" in node:
+        mask_node, bounds_node = node["__workset__"]
+        return Workset(_decode_structure(mask_node, leaves),
+                       _decode_structure(bounds_node, leaves))
     if "__dict__" in node:
         return {_decode_key(k): _decode_structure(v, leaves)
                 for k, v in node["__dict__"]}

@@ -45,6 +45,8 @@ from .body import (
     IterationConfig,
     IterationListener,
     OperatorLifeCycle,
+    Workset,
+    active_fraction,
     normalize_body_result,
 )
 from .checkpoint import CheckpointConfig, CheckpointManager
@@ -57,12 +59,19 @@ BodyFn = Callable[..., Any]
 @dataclass
 class IterationResult:
     """Final state + collected outputs (the analog of the iteration's output
-    streams after ``OutputOperator`` unwrapping)."""
+    streams after ``OutputOperator`` unwrapping).
+
+    ``workset`` is the final :class:`Workset` of a workset iteration (None
+    otherwise).  ``side["epoch_trace"]`` (criteria-driven fused loops and
+    per-epoch hosted loops) holds the per-epoch convergence curves —
+    ``{"active_fraction": (num_epochs,), "termination": (num_epochs,)}``
+    host arrays — that would otherwise die inside the fused while_loop."""
 
     state: Any
     outputs: Any
     num_epochs: int
     side: dict
+    workset: Any = None
 
 
 def _private_copy(state: Any) -> Any:
@@ -253,6 +262,8 @@ def iterate(
     listeners: Sequence[IterationListener] = (),
     per_round_init: Optional[Callable[[], Any]] = None,
     per_round: Optional[Sequence[str]] = None,
+    workset: Optional[Workset] = None,
+    workset_tol: float = 0.0,
     checkpoint: Optional[Union[CheckpointConfig, CheckpointManager]] = None,
     resume: bool = False,
 ) -> IterationResult:
@@ -276,7 +287,23 @@ def iterate(
     output forwarding yields).  Works in both fused and hosted modes.
 
     Termination: ``max_epochs`` reached, OR the body's ``termination`` vote
-    is zero/false, OR an iterator data source is exhausted.
+    is zero/false, OR an iterator data source is exhausted, OR — workset
+    iterations — the active fraction falls to ``workset_tol``.
+
+    **Workset iterations** (``workset=``): pass the initial
+    :class:`Workset` (device-resident active-set mask + optional
+    per-element bound state) and a body with the extended signature
+    ``body(state, workset, epoch[, data]) ->`` result whose feedback is
+    ``(new_state, new_workset)``.  The mask/bounds pytree rides the
+    ``lax.scan``/``lax.while_loop`` carry with the state — in hosted mode
+    it also rides chunk-boundary checkpoints (GR_STATE_KEY-style), so
+    ``resilient_fit`` crash-resume restores mask, bounds, AND the rounds
+    run bit-exactly.  The driver terminates when
+    :func:`~.body.active_fraction` drops to ``workset_tol`` (default:
+    exactly zero — the reference's empty-workset criterion), AND-ed with
+    any explicit body vote.  Incompatible with ``per_round=`` and the
+    PER_ROUND lifecycle (those re-init state each round; a workset is
+    cross-round by definition).
 
     ``steps_per_dispatch=W`` (hosted mode, device-resident data): scan
     ``W`` epochs per jit dispatch — one host round-trip (and one
@@ -315,6 +342,37 @@ def iterate(
             return _call_body(inner_body, {**state, **reset_subtree},
                               epoch, rest[0] if rest else None)
 
+    frac_fn = None
+    if workset is not None:
+        if not isinstance(workset, Workset):
+            raise TypeError(
+                f"workset= expects a Workset, got {type(workset).__name__}")
+        if per_round or config.lifecycle == OperatorLifeCycle.PER_ROUND:
+            raise ValueError(
+                "workset iterations are incompatible with per-round "
+                "re-initialisation (the workset is cross-round state)")
+        ws_body, ws_tol = body, float(workset_tol)
+
+        def body(carry, epoch, *rest):  # noqa: F811
+            # The workset rides the carry NEXT TO the user state; the
+            # continue-vote is "records still flowing" = active elements
+            # remain, AND-ed with any explicit body vote.
+            state, ws = carry
+            res = normalize_body_result(
+                ws_body(state, ws, epoch, *rest) if rest
+                else ws_body(state, ws, epoch))
+            new_state, new_ws = res.feedback
+            cont = active_fraction(new_ws) > ws_tol
+            if res.termination is not None:
+                cont = jnp.logical_and(
+                    cont,
+                    jnp.asarray(res.termination).astype(bool).reshape(()))
+            return IterationBodyResult((new_state, new_ws), res.outputs,
+                                       cont)
+
+        initial_state = (initial_state, workset)
+        frac_fn = lambda carry: active_fraction(carry[1])  # noqa: E731
+
     provider = _DataProvider(data)
     # NOTE: distinct from the per_round= KEY LIST above — this is the
     # whole-state PER_ROUND lifecycle flag from IterationConfig.
@@ -334,18 +392,31 @@ def iterate(
             # (a while_loop can't stack a dynamic number of them) — auto must
             # not silently change output semantics, so probe for a vote and
             # fall back to hosted when one exists.  Explicit mode="fused"
-            # opts into last-output semantics.
+            # opts into last-output semantics.  A workset iteration always
+            # votes (the active-fraction criterion), so it stays fusible
+            # whenever the body emits NO outputs — then there are no output
+            # semantics to lose and the fused while_loop (plus its epoch
+            # trace) is the point of the feature.
             probe = jax.eval_shape(
                 lambda s, e: _call_body(body, s, e, provider(0)),
                 initial_state, jax.ShapeDtypeStruct((), jnp.int32))
-            fusible = probe.termination is None
+            fusible = (probe.termination is None
+                       or (workset is not None and probe.outputs is None))
         mode = "fused" if fusible else "hosted"
 
     if mode == "fused":
-        return _iterate_fused(body, initial_state, provider, config)
-    return _iterate_hosted(body, initial_state, provider, config, listeners,
-                           per_round_lifecycle, per_round_init, checkpoint,
-                           resume)
+        result = _iterate_fused(body, initial_state, provider, config,
+                                frac_fn=frac_fn)
+    else:
+        result = _iterate_hosted(body, initial_state, provider, config,
+                                 listeners, per_round_lifecycle,
+                                 per_round_init, checkpoint, resume,
+                                 frac_fn=frac_fn)
+    if workset is not None:
+        final_state, final_ws = result.state
+        result = dataclasses.replace(result, state=final_state,
+                                     workset=final_ws)
+    return result
 
 
 # ---------------------------------------------------------------------------
@@ -353,7 +424,9 @@ def iterate(
 # ---------------------------------------------------------------------------
 
 def _iterate_fused(body: BodyFn, initial_state, provider: _DataProvider,
-                   config: IterationConfig) -> IterationResult:
+                   config: IterationConfig, *,
+                   frac_fn: Optional[Callable[[Any], Any]] = None
+                   ) -> IterationResult:
     if not provider.is_static:
         raise ValueError("fused mode requires device-resident (static) data")
     if config.max_epochs is None:
@@ -396,29 +469,52 @@ def _iterate_fused(body: BodyFn, initial_state, provider: _DataProvider,
     zero_out = jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype), probe.outputs)
 
+    # Per-epoch convergence curves survive the fused loop in a fixed-size
+    # NaN-prefilled trace buffer riding the carry (the sgd.py loss-log
+    # pattern): a while_loop keeps only its final carry, so anything
+    # per-epoch must be indexed into a (max_epochs,) buffer on device.
+    # NaN tail = epochs never run.
+    trace0 = {
+        "active_fraction": jnp.full((max_epochs,), jnp.nan, jnp.float32),
+        "termination": jnp.full((max_epochs,), jnp.nan, jnp.float32),
+    }
+
     @partial(jax.jit, donate_argnums=(0,) if config.donate_state else ())
     def run(state, data):
         def cond(carry):
-            _, _, epoch, keep_going = carry
+            _, _, epoch, keep_going, _ = carry
             return jnp.logical_and(keep_going, epoch < max_epochs)
 
         def step(carry):
-            state, _, epoch, _ = carry
+            state, _, epoch, _, trace = carry
             res = _call_body(body, state, epoch, data)
-            keep_going = jnp.asarray(res.termination).astype(bool).reshape(())
-            return res.feedback, res.outputs, epoch + 1, keep_going
+            vote = jnp.asarray(res.termination)
+            keep_going = vote.astype(bool).reshape(())
+            frac = (frac_fn(res.feedback) if frac_fn is not None
+                    else jnp.asarray(jnp.nan, jnp.float32))
+            trace = {
+                "active_fraction":
+                    trace["active_fraction"].at[epoch].set(frac),
+                "termination":
+                    trace["termination"].at[epoch].set(
+                        vote.astype(jnp.float32).reshape(())),
+            }
+            return res.feedback, res.outputs, epoch + 1, keep_going, trace
 
         return jax.lax.while_loop(
             cond, step, (state, zero_out, jnp.asarray(0, jnp.int32),
-                         jnp.asarray(True)))
+                         jnp.asarray(True), trace0))
 
-    final_state, outputs, num_epochs, _ = run(initial_state, data)
+    final_state, outputs, num_epochs, _, trace = run(initial_state, data)
     # on a process-spanning mesh the loop counter comes back as a
     # non-fully-addressable replicated scalar; read this host's replica
     from ..parallel.mesh import fetch_replicated
 
-    return IterationResult(final_state, outputs,
-                           int(np.asarray(fetch_replicated(num_epochs))), {})
+    n_run = int(np.asarray(fetch_replicated(num_epochs)))
+    side = {"epoch_trace": {
+        k: np.asarray(fetch_replicated(v))[:n_run]
+        for k, v in trace.items()}}
+    return IterationResult(final_state, outputs, n_run, side)
 
 
 # ---------------------------------------------------------------------------
@@ -429,7 +525,9 @@ def _iterate_hosted(body: BodyFn, initial_state, provider: _DataProvider,
                     config: IterationConfig,
                     listeners: Sequence[IterationListener],
                     per_round_lifecycle: bool, per_round_init,
-                    checkpoint, resume: bool) -> IterationResult:
+                    checkpoint, resume: bool, *,
+                    frac_fn: Optional[Callable[[Any], Any]] = None
+                    ) -> IterationResult:
     donating = (config.jit and config.donate_state
                 and not per_round_lifecycle)
     if config.jit:
@@ -509,6 +607,12 @@ def _iterate_hosted(body: BodyFn, initial_state, provider: _DataProvider,
 
     outputs_log = []
     side: dict = {}
+    # Per-epoch convergence curves (per-epoch stepping only): device
+    # scalars collected WITHOUT syncing — one batched fetch at the end.
+    # Covers the epochs run in THIS call (a resumed run's earlier curve
+    # lives with the earlier call).
+    trace_frac: list = []
+    trace_term: list = []
     epoch = start_epoch
     terminated_reason = "max_epochs"
     from ..robustness.faults import fault_point
@@ -577,6 +681,12 @@ def _iterate_hosted(body: BodyFn, initial_state, provider: _DataProvider,
             state = res.feedback
             if res.outputs is not None:
                 outputs_log.append(res.outputs)
+            if frac_fn is not None:
+                # Eager tiny op on the fresh feedback buffers — dispatched
+                # before the next donating step call, so donation can't
+                # invalidate what it reads; no host sync here.
+                trace_frac.append(frac_fn(state))
+                trace_term.append(res.termination)
 
             ctx = EpochContext(epoch=epoch, state=state, outputs=res.outputs,
                                side=side)
@@ -628,4 +738,11 @@ def _iterate_hosted(body: BodyFn, initial_state, provider: _DataProvider,
         listener.on_iteration_terminated(final_ctx)
 
     side["termination_reason"] = terminated_reason
+    if trace_frac:
+        side["epoch_trace"] = {
+            "active_fraction": np.asarray(
+                jax.device_get(trace_frac), np.float32),
+            "termination": np.asarray(
+                jax.device_get(trace_term), np.float32),
+        }
     return IterationResult(state, outputs_log, epoch, side)

@@ -22,8 +22,9 @@ inside the iteration body.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from functools import partial
-from typing import List
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -32,9 +33,19 @@ import numpy as np
 from ...api.stage import Estimator, Model
 from ...data.table import Table
 from ...distance import DistanceMeasure
-from ...iteration import IterationBodyResult, IterationConfig, iterate
+from ...iteration import (
+    IterationBodyResult,
+    IterationConfig,
+    Workset,
+    iterate,
+)
 from ...linalg import stack_vectors
-from ...params.param import IntParam, ParamValidators, StringParam
+from ...params.param import (
+    BoolParam,
+    IntParam,
+    ParamValidators,
+    StringParam,
+)
 from ...params.shared import (
     HasDistanceMeasure,
     HasFeaturesCol,
@@ -102,6 +113,26 @@ class KMeansParams(KMeansModelParams, HasSeed, HasMaxIter):
         "(reference argmin semantics), 'fast', or 'split'.",
         default="first",
         validator=ParamValidators.in_array(["first", "fast", "split"]))
+    WORKSET = BoolParam(
+        "workset",
+        "Delta/workset iteration mode: thread Hamerly center-movement "
+        "bounds through the fused fit loop and exit the while_loop at "
+        "Lloyd's fixed point instead of always running maxIter rounds.  "
+        "Settled points keep cached assignments, shrinking the points "
+        "SCORED per round (the report/bench accounting; the fused "
+        "program still evaluates dense shapes, so the wall-clock win "
+        "today is the early exit).  Pins the XLA body — final centroids "
+        "are bit-identical to the XLA BSP fit (first-index argmin; "
+        "tiePolicy and the Pallas kernel, whose f32 reduction order "
+        "differs, do not apply).  The fit records a per-round "
+        "convergence report in estimator.last_workset_report.",
+        default=False)
+
+    def get_workset(self) -> bool:
+        return self.get(KMeansParams.WORKSET)
+
+    def set_workset(self, value: bool):
+        return self.set(KMeansParams.WORKSET, value)
 
     def get_k(self) -> int:
         return self.get(KMeansParams.K)
@@ -223,6 +254,18 @@ _INIT_MODES = {"random": select_random_centroids,
                "k-means++": select_kmeanspp_centroids}
 
 
+def _stats_from_assign(k: int, points, mask, assign):
+    """(sums, counts) from a per-point assignment vector — the reduce half
+    of :func:`_assign_stats`, split out so the workset body (which merges
+    cached and fresh assignments) runs the EXPRESSION-IDENTICAL einsum over
+    all n points: identical assignments => bit-identical sums, which is
+    what makes bound-filtered KMeans exact."""
+    onehot = jax.nn.one_hot(assign, k, dtype=points.dtype) # (n, k)
+    onehot = onehot * mask[:, None]                        # drop padding
+    sums = jnp.einsum("nk,nd->kd", onehot, points)         # MXU reduce
+    return sums, jnp.sum(onehot, axis=0)
+
+
 def _assign_stats(measure: DistanceMeasure, k: int, points, mask,
                   centroids):
     """THE Lloyd's statistics: (sums (k, d), counts (k,)) of the masked
@@ -230,10 +273,7 @@ def _assign_stats(measure: DistanceMeasure, k: int, points, mask,
     out-of-core per-batch accumulation so the two can never diverge."""
     dists = measure.pairwise(points, centroids)            # (n, k)
     assign = jnp.argmin(dists, axis=1)                     # (n,)
-    onehot = jax.nn.one_hot(assign, k, dtype=points.dtype) # (n, k)
-    onehot = onehot * mask[:, None]                        # drop padding
-    sums = jnp.einsum("nk,nd->kd", onehot, points)         # MXU reduce
-    return sums, jnp.sum(onehot, axis=0)
+    return _stats_from_assign(k, points, mask, assign)
 
 
 def _update_centroids(centroids, sums, counts, xp=jnp):
@@ -255,6 +295,104 @@ def kmeans_epoch_step(measure: DistanceMeasure, k: int):
         sums, counts = _assign_stats(measure, k, points, mask, centroids)
         return IterationBodyResult(
             feedback=_update_centroids(centroids, sums, counts))
+
+    return body
+
+
+def workset_points_scored(active_fraction, n_real: int,
+                          n_padded: int) -> np.ndarray:
+    """Points scored per round, derived from the POST-round
+    active-fraction trace: round 0 rescored every real point (BSP round
+    0), round ``e`` scores round ``e-1``'s survivors (the fraction is
+    over padded rows).  THE one copy of this convention — the fit report
+    and the bench leg's FLOPs accounting both read it, so a trace
+    semantics change cannot skew one silently."""
+    frac = np.asarray(active_fraction, np.float64)
+    if not frac.size:
+        return np.zeros((0,))
+    return np.concatenate([[float(n_real)], frac[:-1] * n_padded])
+
+
+#: relative slack on the Hamerly bound decay: f32 rounding of
+#: ``upper + drift`` / ``lower - drift`` may land BELOW the true bound, so
+#: every decayed bound is nudged conservatively outward — a too-loose
+#: bound only keeps a settled point active one more round (wasted score),
+#: never freezes a point that could still flip (wrong centroids).
+_WS_BOUND_SLACK = 1e-5
+
+
+def kmeans_workset_epoch_step(measure: DistanceMeasure, k: int):
+    """One bound-filtered Lloyd's iteration as an ``iterate`` workset body
+    (Hamerly 2010 adapted to the device-resident mask).
+
+    Per-point bound state rides ``workset.bounds``: the cached assignment,
+    an UPPER bound on the distance to the assigned centroid, and a LOWER
+    bound on the distance to every other centroid.  A masked-out point is
+    one whose ``upper < lower`` after decaying both by the centroids'
+    movement — the triangle inequality then proves its argmin cannot have
+    flipped, so its CACHED assignment feeds the stats reduce and the
+    result is bit-identical to the BSP body (the reduce itself still runs
+    the same einsum over all n points — identical assignments, identical
+    f32 summation order).  What shrinks is the LOGICAL scoring work: the
+    number of points whose (n, k) distance rows a round must re-score
+    (``points_scored`` in the fit report / bench leg) — the fused
+    fixed-shape program still evaluates densely, so that count is what a
+    compacting backend banks, while the early exit below is the physical
+    saving available today.
+
+    The body drives the workset to empty at Lloyd's fixed point: a round
+    with zero assignment flips produces bit-identical sums, hence zero
+    centroid drift, hence no point left to rescore — the driver's
+    active-fraction criterion then exits the ``lax.while_loop`` strictly
+    before ``max_epochs`` whenever the fit converges early.
+
+    Euclidean only: the bound decay leans on the triangle inequality in
+    TRUE distance space (``EuclideanDistanceMeasure.pairwise`` returns
+    root distances, not squares)."""
+    if measure.name != "euclidean":
+        raise ValueError(
+            "workset KMeans requires the euclidean measure (Hamerly "
+            f"bounds need the triangle inequality), got {measure.name!r}")
+
+    def body(centroids, ws, epoch, data):
+        points, pad_mask = data
+        active = ws.mask                                    # (n,) f32 0/1
+        prev_assign = ws.bounds["assign"]
+        dists = measure.pairwise(points, centroids)         # (n, k)
+        fresh = jnp.argmin(dists, axis=1).astype(jnp.int32)
+        is_min = jnp.arange(k, dtype=jnp.int32)[None, :] == fresh[:, None]
+        d_best = jnp.min(dists, axis=1)
+        d_second = jnp.min(jnp.where(is_min, jnp.inf, dists), axis=1)
+
+        # merge: active points take the fresh score, settled points keep
+        # their cached assignment/bounds (provably identical)
+        on = active > 0
+        assign = jnp.where(on, fresh, prev_assign).astype(jnp.int32)
+        upper = jnp.where(on, d_best, ws.bounds["upper"])
+        lower = jnp.where(on, d_second, ws.bounds["lower"])
+        changed = jnp.sum(active * (fresh != prev_assign))
+
+        sums, counts = _stats_from_assign(k, points, pad_mask, assign)
+        new_centroids = _update_centroids(centroids, sums, counts)
+
+        drift = jnp.sqrt(jnp.maximum(
+            jnp.sum(jnp.square(new_centroids - centroids), axis=1), 0.0))
+        drift_max = jnp.max(drift)
+        # conservative f32 decay (see _WS_BOUND_SLACK)
+        upper = upper + drift[assign]
+        upper = upper + jnp.abs(upper) * _WS_BOUND_SLACK
+        lower = lower - drift_max
+        lower = lower - jnp.abs(lower) * _WS_BOUND_SLACK
+        # fixed point: nothing moved and nothing flipped => every future
+        # BSP round is a bit-identical no-op — drain the workset entirely
+        settled = jnp.logical_and(drift_max == 0.0, changed == 0.0)
+        next_active = jnp.logical_and(upper >= lower,
+                                      jnp.logical_not(settled))
+        new_mask = jnp.where(pad_mask > 0,
+                             next_active.astype(jnp.float32), 0.0)
+        new_ws = Workset(new_mask, {"assign": assign, "upper": upper,
+                                    "lower": lower})
+        return IterationBodyResult(feedback=(new_centroids, new_ws))
 
     return body
 
@@ -321,6 +459,54 @@ def _plan_fit_impl(n: int, d: int, k: int, measure: DistanceMeasure,
     return ("pallas", bn) if bn is not None else ("xla", None)
 
 
+@dataclass(frozen=True)
+class FitPlan:
+    """THE per-fit shape/impl contract, derived once and shared by every
+    KMeans fit path (in-core BSP, workset, out-of-core streaming) instead
+    of each re-deriving k/d padding independently — the workset port must
+    not fork a third copy of the padding rules."""
+
+    impl: str                  # "xla" | "pallas"
+    block_n: Optional[int]     # Pallas tile rows (None for xla)
+    row_multiple: int          # per-shard row-count multiple for padding
+    fill: str                  # pad_rows_with_mask fill policy
+    k: int
+    d: int
+
+    def local_multiple(self, mesh) -> int:
+        """Per-process padded-row multiple on ``mesh`` under this plan."""
+        return local_axis_multiple(mesh, row_multiple=self.row_multiple)
+
+    def init_workset(self, pad_mask) -> Workset:
+        """The workset bound-state initializer: everything real starts
+        active with vacuous bounds (+inf upper / -inf lower forces a full
+        first-round rescore, exactly BSP round 0); padding rows are born
+        settled so they are never scored OR counted active.  Every bound
+        array derives elementwise from ``pad_mask`` so it inherits the
+        mask's sharding — the while_loop carry stays consistently sharded
+        on a multi-device mesh with no GSPMD resharding."""
+        mask = pad_mask.astype(jnp.float32)
+        zero = mask * 0.0
+        return Workset(
+            mask=mask,
+            bounds={"assign": zero.astype(jnp.int32),
+                    "upper": zero + jnp.asarray(jnp.inf, jnp.float32),
+                    "lower": zero - jnp.asarray(jnp.inf, jnp.float32)})
+
+
+def _fit_plan(n: int, d: int, k: int, measure: DistanceMeasure, mesh, *,
+              workset: bool = False) -> FitPlan:
+    """Build the shared :class:`FitPlan`.  The workset path pins the XLA
+    body (the Pallas stats kernel fuses away the per-point assignment the
+    bound cache needs) — everything else falls out of
+    :func:`_plan_fit_impl` exactly as before."""
+    impl, block_n = (("xla", None) if workset
+                     else _plan_fit_impl(n, d, k, measure, mesh))
+    row_multiple, fill = ((block_n, "zero") if impl == "pallas"
+                          else (1, "first_row"))
+    return FitPlan(impl, block_n, row_multiple, fill, k, d)
+
+
 def kmeans_fit_outofcore(make_reader, k: int, *,
                          measure_name: str = "euclidean",
                          max_iter: int = 20, seed: int = 0, mesh=None,
@@ -354,7 +540,12 @@ def kmeans_fit_outofcore(make_reader, k: int, *,
 
     from ...utils.padding import FixedRowBatcher
 
-    multiple = local_axis_multiple(mesh)
+    # The shared FitPlan owns the padding rules (n=0: per-batch streaming
+    # accumulation is below any Pallas residency threshold by
+    # construction, so the plan always lands on the XLA impl) — no
+    # independent re-derivation of the row multiple here.
+    plan = _fit_plan(0, 1, k, measure, mesh)
+    multiple = plan.local_multiple(mesh)
     sharding = NamedSharding(mesh, P("data"))
     # shared fixed-row protocol (first padded batch pins; ragged tail
     # zero-pads with mask 0)
@@ -434,6 +625,9 @@ class KMeans(KMeansParams, Estimator["KMeansModel"]):
 
     def fit(self, *inputs) -> "KMeansModel":
         (table,) = inputs
+        # report describes THIS fit only — a reused estimator must not
+        # serve a stale report from an earlier workset fit
+        self.last_workset_report = None
         mesh = default_mesh()
         k = self.get_k()
         measure = DistanceMeasure.get_instance(self.get_distance_measure())
@@ -463,14 +657,16 @@ class KMeans(KMeansParams, Estimator["KMeansModel"]):
                     f"host 0's shard, which holds {int(rows[0])} rows "
                     f"< k={k}; give host 0 at least k rows")
 
-        impl, block_n = _plan_fit_impl(n_for_plan,
-                                       host_points.shape[1], k, measure, mesh)
-        row_multiple, fill = (block_n, "zero") if impl == "pallas" else (1, "first_row")
+        workset_mode = self.get_workset()
+        plan = _fit_plan(n_for_plan, host_points.shape[1], k, measure, mesh,
+                         workset=workset_mode)
+        impl, block_n = plan.impl, plan.block_n
+        row_multiple, fill = plan.row_multiple, plan.fill
         select_init = _INIT_MODES[self.get_init_mode()]
         if multi_host:
             from ...parallel.distributed import broadcast_from_host0
 
-            multiple = local_axis_multiple(mesh, row_multiple=row_multiple)
+            multiple = plan.local_multiple(mesh)
             padded_rows = -(-rows // multiple) * multiple
             if not np.all(padded_rows == padded_rows[0]):
                 raise ValueError(
@@ -486,18 +682,30 @@ class KMeans(KMeansParams, Estimator["KMeansModel"]):
         points, mask = _prepare_points(host_points, mesh,
                                        row_multiple=row_multiple, fill=fill,
                                        cross_host_checked=True)
-        body = (kmeans_epoch_step_pallas(k, mesh, block_n=block_n,
-                                         tie_policy=self.get_tie_policy())
-                if impl == "pallas" else kmeans_epoch_step(measure, k))
         init_dev = replicate(init, mesh)
 
-        result = iterate(
-            body,
-            init_dev,
-            (points, mask),
-            max_epochs=self.get_max_iter(),
-            config=IterationConfig(mode="fused"),
-        )
+        if workset_mode:
+            result = iterate(
+                kmeans_workset_epoch_step(measure, k),
+                init_dev,
+                (points, mask),
+                max_epochs=self.get_max_iter(),
+                workset=plan.init_workset(mask),
+                config=IterationConfig(mode="fused"),
+            )
+            self.last_workset_report = self._workset_report(
+                result, n_real=n_for_plan, n_padded=int(points.shape[0]))
+        else:
+            body = (kmeans_epoch_step_pallas(k, mesh, block_n=block_n,
+                                             tie_policy=self.get_tie_policy())
+                    if impl == "pallas" else kmeans_epoch_step(measure, k))
+            result = iterate(
+                body,
+                init_dev,
+                (points, mask),
+                max_epochs=self.get_max_iter(),
+                config=IterationConfig(mode="fused"),
+            )
         centroids = np.asarray(fetch_replicated(result.state))
 
         model = KMeansModel()
@@ -505,6 +713,22 @@ class KMeans(KMeansParams, Estimator["KMeansModel"]):
         model.set_model_data(
             Table({"centroids": centroids[None, :, :]}))  # 1 row of (k, d)
         return model
+
+    def _workset_report(self, result, *, n_real: int, n_padded: int) -> dict:
+        """Convergence report of a workset fit: ``active_fraction[e]`` is
+        the fraction left active AFTER round ``e`` (over padded rows), so
+        the points actually SCORED in round ``e`` are the previous round's
+        survivors — round 0 scores every real point (BSP round 0)."""
+        trace = result.side.get("epoch_trace", {})
+        frac = np.asarray(trace.get("active_fraction", ()), np.float64)
+        scored = workset_points_scored(frac, n_real, n_padded)
+        return {
+            "rounds": result.num_epochs,
+            "max_epochs": self.get_max_iter(),
+            "n_points": int(n_real),
+            "active_fraction": frac,
+            "points_scored": scored,
+        }
 
     def fit_outofcore(self, make_reader, *, mesh=None,
                       features_key: str = None) -> "KMeansModel":

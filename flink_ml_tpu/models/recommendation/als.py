@@ -42,7 +42,12 @@ import numpy as np
 
 from ...api.stage import Estimator, Model
 from ...data.table import Table
-from ...iteration import IterationBodyResult, IterationConfig, iterate
+from ...iteration import (
+    IterationBodyResult,
+    IterationConfig,
+    Workset,
+    iterate,
+)
 from ...params.param import (
     BoolParam,
     FloatParam,
@@ -263,6 +268,26 @@ class ALSParams(ALSModelParams, HasMaxIter, HasSeed):
     def set_alpha(self, value: float):
         return self.set(ALSParams.ALPHA, value)
 
+    WORKSET_TOL = FloatParam(
+        "worksetTol",
+        "Delta/workset iteration threshold (0 disables): a user/item "
+        "whose neighborhood factors all moved less than this (L2 row "
+        "movement) last round keeps its previous factors — its solve "
+        "result is masked out (the fused program still evaluates the "
+        "dense normal equations; the wall-clock win today is that the "
+        "while_loop exits as soon as every movement settles below the "
+        "threshold, instead of always running maxIter epochs).  "
+        "Approximate by construction (masked updates would have moved "
+        "< tol); the fit records a per-round report in "
+        "estimator.last_workset_report.",
+        default=0.0, validator=ParamValidators.gt_eq(0))
+
+    def get_workset_tol(self) -> float:
+        return self.get(ALSParams.WORKSET_TOL)
+
+    def set_workset_tol(self, value: float):
+        return self.set(ALSParams.WORKSET_TOL, value)
+
 
 def _normal_equations(factors, group_idx, other_idx, ratings, weights,
                       n_groups: int, implicit: bool, alpha: float):
@@ -380,6 +405,53 @@ def als_epoch_step(n_users: int, n_items: int, reg: float, implicit: bool,
                 V = _solve_side_sorted(V, U, plan_v, ov, rv, wv, lrv, glv,
                                        n_items, reg, implicit, alpha)
         return IterationBodyResult(feedback=(U, V))
+
+    return body
+
+
+def als_workset_epoch_step(n_users: int, n_items: int, reg: float,
+                           implicit: bool, alpha: float, tol: float):
+    """One workset ALS epoch: the delta-iteration port of
+    :func:`als_epoch_step`.
+
+    The workset masks the two factor sides independently
+    (``mask={"users": (n_users,), "items": (n_items,)}``): a group stays
+    active only while something in its NEIGHBORHOOD still moves — user
+    ``u`` re-solves while any item it rated moved ≥ ``tol`` (L2 row
+    movement) last round, and symmetrically for items.  A masked group
+    keeps its previous factors; since its normal equations are built from
+    neighbor rows that all moved < ``tol``, the discarded update would
+    have been sub-threshold too — that is the approximation accepted in
+    exchange for settling.  Fixed shapes mean the dense solve is still
+    evaluated each round (what a compacting backend would skip); the
+    wall-clock saving today is the exit: when every movement settles
+    below ``tol`` both masks drain and the driver's active-fraction
+    criterion ends the fused while_loop strictly before ``maxIter``.
+
+    Uses the raw-index (scatter) data tuple — the movement aggregation
+    needs the per-rating (user, item) ids that the sorted NeqPlan layout
+    deliberately discards."""
+
+    def body(state, ws, epoch, data):
+        U, V = state
+        u_idx, i_idx, r, w = data
+        m_u, m_i = ws.mask["users"], ws.mask["items"]
+        # same precision pin as the BSP body (als_epoch_step)
+        with jax.default_matmul_precision("highest"):
+            U_solved = _solve_side(U, V, u_idx, i_idx, r, w, n_users, reg,
+                                   implicit, alpha)
+            U_new = jnp.where(m_u[:, None] > 0, U_solved, U)
+            V_solved = _solve_side(V, U_new, i_idx, u_idx, r, w, n_items,
+                                   reg, implicit, alpha)
+            V_new = jnp.where(m_i[:, None] > 0, V_solved, V)
+        du = jnp.sqrt(jnp.sum(jnp.square(U_new - U), axis=1))  # (n_users,)
+        dv = jnp.sqrt(jnp.sum(jnp.square(V_new - V), axis=1))  # (n_items,)
+        # neighborhood max-movement via scatter-max over the ratings
+        moved_u = jnp.zeros((n_users,), du.dtype).at[u_idx].max(dv[i_idx])
+        moved_i = jnp.zeros((n_items,), dv.dtype).at[i_idx].max(du[u_idx])
+        new_ws = Workset({"users": (moved_u >= tol).astype(jnp.float32),
+                          "items": (moved_i >= tol).astype(jnp.float32)})
+        return IterationBodyResult(feedback=((U_new, V_new), new_ws))
 
     return body
 
@@ -531,6 +603,9 @@ class ALSModel(ALSModelParams, Model):
 class ALS(ALSParams, Estimator[ALSModel]):
     def fit(self, *inputs) -> ALSModel:
         (table,) = inputs
+        # report describes THIS fit only — a reused estimator must not
+        # serve a stale report from an earlier workset fit
+        self.last_workset_report = None
         users = np.asarray(table[self.get_user_col()])
         items = np.asarray(table[self.get_item_col()])
         ratings = np.asarray(table[self.get_rating_col()], np.float32)
@@ -551,6 +626,10 @@ class ALS(ALSParams, Estimator[ALSModel]):
             np.float32)
 
         weights = np.ones(len(ratings), np.float32)
+        ws_tol = self.get_workset_tol()
+        if ws_tol > 0:
+            return self._fit_workset(user_ids, item_ids, u_idx, i_idx,
+                                     ratings, weights, U0, V0, ws_tol)
         neq_mode = self.get(ALSParams.NEQ_IMPL)
         plans = None
         if neq_mode in ("auto", "sorted"):
@@ -594,6 +673,43 @@ class ALS(ALSParams, Estimator[ALSModel]):
         )
         U, V = (np.asarray(jax.device_get(x)) for x in result.state)
 
+        model = ALSModel()
+        model.copy_params_from(self)
+        model.set_model_data(Table({
+            "userIds": user_ids[None], "itemIds": item_ids[None],
+            "userFactors": U[None], "itemFactors": V[None]}))
+        return model
+
+    def _fit_workset(self, user_ids, item_ids, u_idx, i_idx, ratings,
+                     weights, U0, V0, ws_tol: float) -> ALSModel:
+        """Workset (delta-iteration) fit: raw-index data, both sides
+        masked, convergence-driven while_loop exit (see
+        :func:`als_workset_epoch_step`)."""
+        data = (jnp.asarray(u_idx, jnp.int32),
+                jnp.asarray(i_idx, jnp.int32),
+                jnp.asarray(ratings), jnp.asarray(weights))
+        ws0 = Workset({"users": jnp.ones((len(user_ids),), jnp.float32),
+                       "items": jnp.ones((len(item_ids),), jnp.float32)})
+        result = iterate(
+            als_workset_epoch_step(len(user_ids), len(item_ids),
+                                   self.get_reg_param(),
+                                   self.get_implicit_prefs(),
+                                   self.get_alpha(), ws_tol),
+            (jnp.asarray(U0), jnp.asarray(V0)),
+            data,
+            max_epochs=self.get_max_iter(),
+            workset=ws0,
+            config=IterationConfig(mode="fused"),
+        )
+        trace = result.side.get("epoch_trace", {})
+        self.last_workset_report = {
+            "rounds": result.num_epochs,
+            "max_epochs": self.get_max_iter(),
+            "active_fraction": np.asarray(
+                trace.get("active_fraction", ()), np.float64),
+            "n_groups": len(user_ids) + len(item_ids),
+        }
+        U, V = (np.asarray(jax.device_get(x)) for x in result.state)
         model = ALSModel()
         model.copy_params_from(self)
         model.set_model_data(Table({

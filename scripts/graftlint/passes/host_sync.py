@@ -40,8 +40,12 @@ SCAN_CALLEES = {"scan", "masked_chunk_scan", "while_loop", "fori_loop"}
 #: every step/scan body in these trees must stay host-sync-free
 #: (``online/`` joined with ISSUE 7: its driver feeds the same chunked
 #: scan, so a host sync in a step-named helper there would fence the
-#: training dispatch stream the publishes ride on)
+#: training dispatch stream the publishes ride on; ``iteration/`` joined
+#: with ISSUE 9: the workset while_loop driver's whole value is zero host
+#: round-trips per round — a ``block_until_ready``/``.item()`` hiding in
+#: its scan/while bodies would re-serialize every epoch)
 SCAN_ROOTS = (
+    "flink_ml_tpu/iteration",
     "flink_ml_tpu/models",
     "flink_ml_tpu/online",
     "flink_ml_tpu/parallel",

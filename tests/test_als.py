@@ -348,3 +348,58 @@ def test_neq_plan_span_matches_full_plan():
     for g in cases:
         for chunk in (7, 64, 8192):
             assert _neq_plan_span(g, chunk) == NeqPlan(g, chunk).span
+
+
+# -- workset (delta-iteration) fit, ISSUE 9 ----------------------------------
+
+def test_workset_fit_converges_early_and_tracks_bsp():
+    """worksetTol > 0: users/items whose neighborhoods settled skip their
+    solves, the fused while_loop exits as soon as every movement falls
+    below the threshold (strictly before maxIter), and the factors stay
+    within threshold-scale distance of the BSP fit."""
+    table, _ = _synthetic(noise=0.01, seed=2)
+    kw = dict(rank=4, max_iter=60, reg=1e-2, seed=5)
+
+    def build(**extra):
+        est = (ALS().set_rank(kw["rank"]).set_max_iter(kw["max_iter"])
+               .set_reg_param(kw["reg"]).set_seed(kw["seed"]))
+        for name, v in extra.items():
+            getattr(est, f"set_{name}")(v)
+        return est
+
+    base = build().fit(table)
+    est = build(workset_tol=1e-4)
+    model = est.fit(table)
+
+    rep = est.last_workset_report
+    assert rep["rounds"] < kw["max_iter"]        # convergence-driven exit
+    assert rep["rounds"] == len(rep["active_fraction"])
+    assert rep["active_fraction"][-1] == 0.0     # both masks drained
+    # the skip rule shrinks the workset before it drains (some round
+    # solved strictly fewer than all groups)
+    assert rep["active_fraction"].min() == 0.0
+    assert np.any((rep["active_fraction"] > 0)
+                  & (rep["active_fraction"] < 1))
+
+    pb = base.transform(table)[0]["prediction"]
+    pw = model.transform(table)[0]["prediction"]
+    np.testing.assert_allclose(pw, pb, atol=5e-3)
+
+
+def test_workset_tol_param_defaults_and_validation():
+    assert ALS().get_workset_tol() == 0.0
+    assert ALS().set_workset_tol(1e-3).get_workset_tol() == 1e-3
+    with pytest.raises(Exception):
+        ALS().set_workset_tol(-1.0)
+
+
+def test_workset_zero_tol_is_plain_bsp_fit():
+    """worksetTol=0 (the default) must take the classic path — bitwise
+    identical to a fit that never heard of worksets."""
+    table, _ = _synthetic(seed=4)
+    a = (ALS().set_rank(4).set_max_iter(8).set_seed(3)).fit(table)
+    b = (ALS().set_rank(4).set_max_iter(8).set_seed(3)
+         .set_workset_tol(0.0)).fit(table)
+    np.testing.assert_array_equal(
+        a.get_model_data()[0]["userFactors"][0],
+        b.get_model_data()[0]["userFactors"][0])

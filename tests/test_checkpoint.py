@@ -469,3 +469,34 @@ def test_outofcore_midepoch_resume_exact_shuffled_stream(tmp_path):
                                   ref_state.coefficients)
     assert resumed_state.intercept == ref_state.intercept
     np.testing.assert_array_equal(resumed_log, ref_log)
+
+
+def test_workset_carry_round_trips_through_checkpoint(tmp_path):
+    """ISSUE 9: a workset iteration's hosted carry is (state, Workset) —
+    the mask AND the bound pytree must survive the save/load cycle
+    bit-exactly (GR_STATE_KEY-style ride-along), including a None
+    bounds."""
+    from flink_ml_tpu.iteration import Workset, load_pytree, save_pytree
+
+    ws = Workset(
+        mask=jnp.asarray([1.0, 0.0, 1.0], jnp.float32),
+        bounds={"assign": jnp.asarray([2, 0, 1], jnp.int32),
+                "upper": jnp.asarray([0.5, np.inf, 1.25], jnp.float32),
+                "lower": jnp.asarray([-np.inf, 0.0, 2.5], jnp.float32)})
+    carry = (jnp.arange(4.0), ws)
+    save_pytree(str(tmp_path / "ck"), carry, {"epoch": 3})
+    restored, meta = load_pytree(str(tmp_path / "ck"))
+    assert meta["epoch"] == 3
+    state_r, ws_r = restored
+    assert isinstance(ws_r, Workset)
+    np.testing.assert_array_equal(state_r, np.arange(4.0))
+    np.testing.assert_array_equal(ws_r.mask, np.asarray(ws.mask))
+    for key in ("assign", "upper", "lower"):
+        np.testing.assert_array_equal(ws_r.bounds[key],
+                                      np.asarray(ws.bounds[key]))
+
+    bare = Workset(mask=jnp.ones(2, jnp.float32))
+    save_pytree(str(tmp_path / "ck2"), bare, {})
+    ws2, _ = load_pytree(str(tmp_path / "ck2"))
+    assert isinstance(ws2, Workset) and ws2.bounds is None
+    np.testing.assert_array_equal(ws2.mask, [1.0, 1.0])

@@ -899,3 +899,67 @@ def test_zero_dropped_requests_during_continuous_publishes():
         assert endpoint.metrics.shed.value == 0
     finally:
         endpoint.close()
+
+
+def test_workset_iterate_crash_mid_run_resumes_bitexact(tmp_path):
+    """ISSUE 9 acceptance: a crash injected mid-iteration while the
+    active-set mask AND the Hamerly bound pytree ride the carry —
+    recovery restores the checkpoint cut (mask, bounds, cached
+    assignments, epoch counter together) and lands bit-exact on the
+    uninterrupted run: same centroids AND the same rounds-run count, so
+    the convergence-driven exit fires at the identical epoch."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.distance import DistanceMeasure
+    from flink_ml_tpu.iteration import Workset
+    from flink_ml_tpu.models.clustering.kmeans import (
+        _fit_plan,
+        kmeans_workset_epoch_step,
+    )
+    from flink_ml_tpu.parallel.mesh import default_mesh
+
+    rng = np.random.default_rng(0)
+    k, n, d = 5, 512, 8
+    centers = rng.normal(size=(k, d)) * 8.0
+    X = (centers[rng.integers(0, k, n)]
+         + rng.normal(size=(n, d)) * 0.4).astype(np.float32)
+    points = jnp.asarray(X)
+    pad_mask = jnp.ones((n,), jnp.float32)
+    init = jnp.asarray(X[:k])
+
+    measure = DistanceMeasure.get_instance("euclidean")
+    body = kmeans_workset_epoch_step(measure, k)
+    plan = _fit_plan(n, d, k, measure, default_mesh(), workset=True)
+
+    def run(checkpoint=None, resume=False):
+        return iterate(
+            body, init, (points, pad_mask), max_epochs=60,
+            workset=plan.init_workset(pad_mask),
+            config=IterationConfig(mode="hosted"),
+            checkpoint=checkpoint, resume=resume)
+
+    oracle = run()
+    assert oracle.num_epochs < 60       # converges mid-run
+    assert oracle.num_epochs > 8        # the crash lands before the exit
+
+    plan_f = FaultPlan().inject("iterate.epoch", at=6, kind="crash")
+    report = RecoveryReport()
+    with plan_f:
+        result = resilient_fit(
+            run, checkpoint=CheckpointConfig(str(tmp_path / "ck"),
+                                             interval=4),
+            max_restarts=1, report=report,
+            backoff=RetryPolicy(base_delay=0.0, sleep=lambda s: None))
+
+    assert report.restarts == 1 and report.recovered
+    # rounds-run count resumes exactly — the while-exit epoch matches
+    assert result.num_epochs == oracle.num_epochs
+    np.testing.assert_array_equal(np.asarray(result.state),
+                                  np.asarray(oracle.state))
+    # the recovered workset drained exactly like the uninterrupted one
+    np.testing.assert_array_equal(np.asarray(result.workset.mask),
+                                  np.asarray(oracle.workset.mask))
+    for key in ("assign", "upper", "lower"):
+        np.testing.assert_array_equal(
+            np.asarray(result.workset.bounds[key]),
+            np.asarray(oracle.workset.bounds[key]))

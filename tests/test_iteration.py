@@ -318,3 +318,126 @@ def test_mixed_lifecycle_validates_keys():
     with pytest.raises(TypeError, match="dict"):
         iterate(lambda s, e: s, jnp.asarray(0.0), max_epochs=1,
                 per_round=("a",))
+
+
+# -- workset iterations (ISSUE 9) --------------------------------------------
+
+def _counter_workset_body(state, ws, epoch, data):
+    """Toy workset: per-element counters run up to per-element targets;
+    an element leaves the workset once its target is reached."""
+    from flink_ml_tpu.iteration import Workset
+
+    new = state + ws.mask
+    return IterationBodyResult(
+        (new, Workset((new < data).astype(jnp.float32), ws.bounds)))
+
+
+def test_workset_drains_and_exits_before_max_epochs():
+    from flink_ml_tpu.iteration import Workset
+
+    targets = jnp.asarray([2.0, 5.0, 3.0, 7.0])
+    ws0 = Workset(jnp.ones(4, jnp.float32), {"aux": jnp.zeros(4)})
+    res = iterate(_counter_workset_body, jnp.zeros(4), targets,
+                  max_epochs=50, workset=ws0)
+    np.testing.assert_array_equal(np.asarray(res.state), [2, 5, 3, 7])
+    assert res.num_epochs == 7 < 50          # convergence-driven exit
+    assert np.all(np.asarray(res.workset.mask) == 0)
+    # bounds pytree rides untouched
+    np.testing.assert_array_equal(np.asarray(res.workset.bounds["aux"]),
+                                  np.zeros(4))
+
+
+def test_workset_fused_matches_hosted_including_trace():
+    from flink_ml_tpu.iteration import Workset
+
+    targets = jnp.asarray([2.0, 5.0, 3.0, 7.0])
+    ws0 = Workset(jnp.ones(4, jnp.float32))
+    fused = iterate(_counter_workset_body, jnp.zeros(4), targets,
+                    max_epochs=50, workset=ws0,
+                    config=IterationConfig(mode="fused"))
+    hosted = iterate(_counter_workset_body, jnp.zeros(4), targets,
+                     max_epochs=50, workset=ws0,
+                     config=IterationConfig(mode="hosted"))
+    np.testing.assert_array_equal(np.asarray(fused.state),
+                                  np.asarray(hosted.state))
+    assert fused.num_epochs == hosted.num_epochs
+    for key in ("active_fraction", "termination"):
+        np.testing.assert_allclose(fused.side["epoch_trace"][key],
+                                   hosted.side["epoch_trace"][key])
+
+
+def test_workset_epoch_trace_records_decay_curve():
+    from flink_ml_tpu.iteration import Workset
+
+    targets = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    res = iterate(_counter_workset_body, jnp.zeros(4), targets,
+                  max_epochs=32, workset=Workset(jnp.ones(4, jnp.float32)))
+    trace = res.side["epoch_trace"]
+    # one entry per epoch actually run; the NaN prefill never leaks out
+    assert trace["active_fraction"].shape == (res.num_epochs,)
+    assert not np.any(np.isnan(trace["active_fraction"]))
+    np.testing.assert_allclose(trace["active_fraction"],
+                               [0.75, 0.5, 0.25, 0.0])
+    # the final epoch votes stop (fraction hit zero)
+    assert trace["termination"][-1] == 0.0
+    assert np.all(trace["termination"][:-1] == 1.0)
+
+
+def test_criteria_while_loop_emits_termination_trace_without_workset():
+    # ISSUE 9 satellite: convergence curves survive the fused while_loop
+    # even for plain criteria-driven bodies — active_fraction is NaN
+    # (no workset), termination carries the per-epoch vote.
+    def body(x, epoch):
+        return IterationBodyResult(feedback=x * 2, termination=epoch < 3)
+
+    res = iterate(body, jnp.asarray(1.0), max_epochs=100,
+                  config=IterationConfig(mode="fused"))
+    trace = res.side["epoch_trace"]
+    assert res.num_epochs == 4
+    assert np.all(np.isnan(trace["active_fraction"]))
+    np.testing.assert_array_equal(trace["termination"], [1, 1, 1, 0])
+
+
+def test_workset_body_vote_ands_with_active_fraction():
+    from flink_ml_tpu.iteration import Workset
+
+    # elements never drain, but the body votes stop at epoch 3
+    def body(state, ws, epoch, data):
+        return IterationBodyResult((state + 1, ws), termination=epoch < 3)
+
+    res = iterate(body, jnp.zeros(4), jnp.ones(4), max_epochs=50,
+                  workset=Workset(jnp.ones(4, jnp.float32)))
+    assert res.num_epochs == 4
+    assert float(np.asarray(res.workset.mask).sum()) == 4.0
+
+
+def test_workset_tol_exits_at_nonzero_fraction():
+    from flink_ml_tpu.iteration import Workset
+
+    targets = jnp.asarray([2.0, 5.0, 3.0, 20.0])
+    res = iterate(_counter_workset_body, jnp.zeros(4), targets,
+                  max_epochs=50, workset=Workset(jnp.ones(4, jnp.float32)),
+                  workset_tol=0.3)   # exit once <= 30% remain active
+    # after epoch 5 only the target-20 element is active (25% <= 30%)
+    assert res.num_epochs == 5
+    assert float(np.asarray(res.workset.mask).sum()) == 1.0
+
+
+def test_workset_rejects_per_round_and_wrong_type():
+    from flink_ml_tpu.iteration import Workset
+
+    with pytest.raises(TypeError, match="Workset"):
+        iterate(_counter_workset_body, jnp.zeros(2), jnp.ones(2),
+                max_epochs=3, workset=jnp.ones(2))
+    with pytest.raises(ValueError, match="per-round"):
+        iterate(_counter_workset_body, {"a": jnp.zeros(2)}, jnp.ones(2),
+                max_epochs=3, workset=Workset(jnp.ones(2, jnp.float32)),
+                per_round=["a"])
+
+
+def test_workset_active_fraction_spans_mask_pytree():
+    from flink_ml_tpu.iteration import Workset, active_fraction
+
+    ws = Workset({"users": jnp.asarray([1.0, 0.0, 1.0]),
+                  "items": jnp.asarray([0.0])})
+    assert float(active_fraction(ws)) == 0.5

@@ -428,3 +428,89 @@ def test_kmeans_outofcore_epoch_aware_shuffled_reader(tmp_path):
     # every true center recovered within the cluster noise scale
     d = np.linalg.norm(got[:, None, :] - centers[None, :, :], axis=-1)
     assert d.min(axis=0).max() < 0.5
+
+
+# -- workset (delta-iteration) fit, ISSUE 9 ----------------------------------
+
+def _blob_table(n, d=16, k=5, seed=0, spread=8.0, noise=0.4):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, d)) * spread
+    lab = rng.integers(0, k, n)
+    X = centers[lab] + rng.normal(size=(n, d)) * noise
+    return Table({"features": X.astype(np.float32)})
+
+
+@pytest.mark.parametrize("tie", ["first", "fast", "split"])
+@pytest.mark.parametrize("n", [4096, 4003])   # exact multiple + padded tail
+def test_workset_kmeans_bitexact_vs_bsp(tie, n):
+    """Acceptance: on the virtual 8-device mesh the bound-filtered fit's
+    final centroids are BIT-identical to BSP across tie policies and
+    padded tails, the while_loop exits strictly before maxIter, and the
+    points scored per round decay below 20% of n before convergence."""
+    k, max_iter = 5, 60
+    table = _blob_table(n, k=k, seed=3)
+    bsp = (KMeans().set_k(k).set_max_iter(max_iter).set_seed(7)
+           .set_tie_policy(tie).fit(table))
+    est = (KMeans().set_k(k).set_max_iter(max_iter).set_seed(7)
+           .set_tie_policy(tie).set_workset(True))
+    wk = est.fit(table)
+
+    c_bsp = bsp.get_model_data()[0]["centroids"][0]
+    c_wk = wk.get_model_data()[0]["centroids"][0]
+    np.testing.assert_array_equal(c_bsp, c_wk)
+
+    rep = est.last_workset_report
+    assert rep["rounds"] < max_iter            # convergence-driven exit
+    assert rep["rounds"] == len(rep["active_fraction"])
+    assert rep["n_points"] == n
+    # bound filter bites: some pre-convergence round scores < 20% of n
+    scored = rep["points_scored"]
+    assert scored[0] == n                      # round 0 = BSP full rescore
+    assert scored[:-1].min() < 0.2 * n
+    # the workset drains exactly at the exit round
+    assert rep["active_fraction"][-1] == 0.0
+
+
+def test_workset_kmeans_report_absent_on_bsp_fit():
+    est = KMeans().set_k(2).set_max_iter(5)
+    est.fit(_table())
+    assert getattr(est, "last_workset_report", None) is None
+
+
+def test_workset_param_default_off_and_roundtrips(tmp_path):
+    est = KMeans().set_k(3).set_workset(True)
+    assert KMeans().get_workset() is False
+    est.save(str(tmp_path / "est"))
+    assert KMeans.load(str(tmp_path / "est")).get_workset() is True
+
+
+def test_workset_requires_euclidean():
+    from flink_ml_tpu.distance import DistanceMeasure
+    from flink_ml_tpu.models.clustering.kmeans import (
+        kmeans_workset_epoch_step)
+
+    with pytest.raises(ValueError, match="euclidean"):
+        kmeans_workset_epoch_step(
+            DistanceMeasure.get_instance("manhattan"), 3)
+
+
+def test_fit_plan_workset_initializer_settles_padding():
+    """Satellite: the shared FitPlan bound-state initializer — padding
+    rows are born settled (never active, never scored), real rows start
+    with the vacuous full-rescore bounds."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.distance import DistanceMeasure
+    from flink_ml_tpu.models.clustering.kmeans import _fit_plan
+    from flink_ml_tpu.parallel.mesh import default_mesh
+
+    euclid = DistanceMeasure.get_instance("euclidean")
+    plan = _fit_plan(100, 4, 3, euclid, default_mesh(), workset=True)
+    assert plan.impl == "xla" and plan.row_multiple == 1
+    pad_mask = jnp.asarray([1.0, 1.0, 1.0, 0.0, 0.0])
+    ws = plan.init_workset(pad_mask)
+    np.testing.assert_array_equal(np.asarray(ws.mask), [1, 1, 1, 0, 0])
+    assert np.all(np.isinf(np.asarray(ws.bounds["upper"])))
+    assert np.all(np.asarray(ws.bounds["lower"]) == -np.inf)
+    np.testing.assert_array_equal(np.asarray(ws.bounds["assign"]),
+                                  np.zeros(5))

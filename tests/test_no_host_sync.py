@@ -95,3 +95,20 @@ def test_checker_covers_online_package():
     assert {"driver.py", "publish.py", "delta.py"} <= names
     for path in visited:
         assert chs.check_file(path) == []
+
+
+def test_checker_covers_iteration_package():
+    """ISSUE 9 satellite: the iteration runtime joined the scanned roots
+    — the workset while_loop driver's whole value is zero host
+    round-trips per round, so a host sync hiding in its scan/while step
+    bodies would re-serialize every epoch.  Assert the root is
+    registered AND that the walk actually visits its modules (a
+    registered-but-empty root would silently guard nothing)."""
+    assert "flink_ml_tpu/iteration" in chs.SCAN_ROOTS
+    visited = [p for p in chs._module_paths()
+               if os.sep + os.path.join("flink_ml_tpu", "iteration") + os.sep
+               in p]
+    names = {os.path.basename(p) for p in visited}
+    assert {"core.py", "body.py", "checkpoint.py"} <= names
+    for path in visited:
+        assert chs.check_file(path) == []
