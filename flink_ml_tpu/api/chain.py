@@ -27,14 +27,18 @@ on a host-resident Table.  This module removes that boundary:
   tokenizers, GBT — see ``gbt_stage.py``) break the chain and run
   stagewise between segments.
 
-- **Compile sharing.**  The segment runner is a single module-level
-  ``jax.jit`` whose static argument is the tuple of per-stage
-  ``(fn, static)`` pairs and whose params are runtime device arrays
-  (device-put once at plan build — no per-call re-transfer, and NOT
-  baked as XLA constants).  Two plans with the same stage types, column
-  names, and shapes — e.g. the per-fold pipelines of a CrossValidator,
-  or consecutive hot-swapped model generations — therefore share one
-  compiled executable per (schema, bucket).
+- **Compile sharing.**  The segment runner is THE kernel registry's
+  shared plan-static jit (``kernels/registry.py`` — one ``jax.jit``
+  whose static argument is the tuple of per-stage ``(fn, static)``
+  pairs and whose params are runtime device arrays, device-put once at
+  plan build — no per-call re-transfer, and NOT baked as XLA
+  constants).  Two plans with the same stage types, column names, and
+  shapes — e.g. the per-fold pipelines of a CrossValidator, or
+  consecutive hot-swapped model generations — therefore share one
+  compiled executable per (schema, bucket), and so do the OTHER
+  consumers of the same surface: the serving executors and the models'
+  standalone transforms dispatch identical single-stage plans, with
+  compile/cache-hit accounting on ``kernels.registry.kernel_stats``.
 
 - **Bit-exactness.**  Every ported kernel mirrors the stage's stagewise
   arithmetic expression at the same f32 precision (host-side exact-compare
@@ -64,12 +68,14 @@ import jax
 import numpy as np
 
 from ..data.table import Table
+from ..kernels.registry import dispatch as _kernel_dispatch
+from ..kernels.registry import dispatch_count  # noqa: F401  (re-export)
 from ..utils.padding import DEFAULT_MIN_BUCKET, pad_rows_to_bucket
 
 __all__ = ["StageKernel", "ChainConfig", "CompiledSegment",
            "CompiledPipeline", "UnsafeColumnValues", "apply_kernel",
            "apply_kernel_or_none", "as_matrix", "numeric_entry",
-           "compile_pipeline",
+           "compile_pipeline", "run_kernel",
            "chain_disabled", "dispatch_count", "f32_ceil", "f32_floor"]
 
 
@@ -178,18 +184,6 @@ class chain_disabled:
 
 
 # --------------------------------------------------------------------------
-# dispatch accounting (bench_pipeline's A/B evidence)
-# --------------------------------------------------------------------------
-
-_DISPATCHES = [0]
-
-
-def dispatch_count() -> int:
-    """Fused jitted-program invocations so far (one per segment run)."""
-    return _DISPATCHES[0]
-
-
-# --------------------------------------------------------------------------
 # exact f32 comparison surrogates
 # --------------------------------------------------------------------------
 
@@ -221,49 +215,23 @@ def f32_floor(x: np.ndarray) -> np.ndarray:
 # --------------------------------------------------------------------------
 # the shared segment runner — ONE jit for every plan
 # --------------------------------------------------------------------------
-
-def _run_segment(plan: tuple, params_seq: tuple, one, cols: Dict[str, Any]):
-    import jax.numpy as jnp
-
-    out = dict(cols)
-    for (fn, static), params in zip(plan, params_seq):
-        produced = fn(static, params, out)
-        # Rounding barrier: multiply every float output by a RUNTIME 1.0.
-        # Without it LLVM contracts elementwise chains across the stage
-        # boundary (a trailing mul fused into the next stage's add/sub as
-        # one fma), skipping the intermediate rounding the stagewise path
-        # performs — 1-ulp drift that breaks bit-exactness.  The compiler
-        # cannot fold the mul (the value is a runtime argument), yet any
-        # contraction THROUGH it is value-identical: fma(t, 1, c) rounds
-        # to exactly t + c.  (jax.lax.optimization_barrier does not help
-        # here — XLA duplicates producers into consumer fusions across
-        # it.)  Integer columns are exact and pass through untouched.
-        out.update({
-            name: col * one
-            if jnp.issubdtype(jnp.result_type(col), jnp.inexact) else col
-            for name, col in produced.items()})
-    return out
+# The runner itself (the plan-static jit with the rounding barrier) moved
+# to kernels/registry.py: it is THE repo-wide dispatch surface now, shared
+# with the serving executors and the models' own predict entry points, so
+# the same (plan, schema, bucket) warmed by any consumer is a compile-cache
+# hit for the others.  This module keeps the chain-facing helpers.
 
 
-# static_argnums=0: the plan tuple of (fn, static) pairs IS the program
-# identity.  params_seq are runtime device args — a CrossValidator's k
-# fold models (same stage classes, same column names, different fitted
-# arrays) all hit this one cache entry per (schema, bucket).
-_SEGMENT_JIT = jax.jit(_run_segment, static_argnums=(0,))
-
-_ONE = np.float32(1.0)   # the runtime rounding-barrier operand
-
-
-def apply_kernel(kernel: StageKernel, table: Table, *,
-                 dtype=np.float32,
-                 min_bucket: int = DEFAULT_MIN_BUCKET) -> Dict[str, np.ndarray]:
-    """Run ONE stage's kernel stagewise (a single-stage segment).
-
-    Ported stages whose legacy transform was host-f64 numpy route their
-    standalone ``transform`` through this, so the stagewise and fused
-    paths literally share one compiled expression — bit-exactness between
-    them is by construction, and the stage's offline transform gains the
-    bucket-padded zero-retrace behavior of the predict entry points.
+def run_kernel(kernel: StageKernel, table: Table, *,
+               params: Any = None, dtype=np.float32,
+               min_bucket: int = DEFAULT_MIN_BUCKET,
+               op: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Run ONE stage's kernel as a single-stage plan through the shared
+    registry dispatch (normalize -> pre -> bucket-pad -> dispatch ->
+    fetch -> post).  ``params`` overrides ``kernel.params`` with
+    already-device-resident arrays (the serving executors device-put
+    once per generation instead of re-transferring per request); ``op``
+    labels the registry's per-op counters.
 
     Raises :class:`UnsafeColumnValues` when a consumed integer column
     carries values outside the f32-exact range — callers fall back to
@@ -275,13 +243,26 @@ def apply_kernel(kernel: StageKernel, table: Table, *,
     padded, n = pad_rows_to_bucket(tuple(host.values()),
                                    min_bucket=min_bucket)
     cols = dict(zip(host, padded))
-    _DISPATCHES[0] += 1
-    out = _SEGMENT_JIT(((kernel.fn, kernel.static),), (kernel.params,),
-                       _ONE, cols)
+    out = _kernel_dispatch(((kernel.fn, kernel.static),),
+                           (kernel.params if params is None else params,),
+                           cols, op=op)
     fetched = {name: np.asarray(out[name])[:n] for name in kernel.produces}
     if kernel.post is not None:
         fetched.update(kernel.post(fetched))
     return fetched
+
+
+def apply_kernel(kernel: StageKernel, table: Table, *,
+                 dtype=np.float32,
+                 min_bucket: int = DEFAULT_MIN_BUCKET) -> Dict[str, np.ndarray]:
+    """Run ONE stage's kernel stagewise (a single-stage segment).
+
+    Ported stages whose legacy transform was host-f64 numpy route their
+    standalone ``transform`` through this, so the stagewise and fused
+    paths literally share one compiled expression — bit-exactness between
+    them is by construction, and the stage's offline transform gains the
+    bucket-padded zero-retrace behavior of the predict entry points."""
+    return run_kernel(kernel, table, dtype=dtype, min_bucket=min_bucket)
 
 
 #: integers beyond +-2^24 are not exactly representable in the f32 the
@@ -456,8 +437,7 @@ class CompiledSegment:
             cols = dict(zip(host, padded))
         else:
             cols = {}
-        _DISPATCHES[0] += 1
-        out = _SEGMENT_JIT(self.plan, self.params, _ONE, cols)
+        out = _kernel_dispatch(self.plan, self.params, cols)
         fetched = {name: np.asarray(out[name])[:n]
                    for name in self.fetch_cols}
         for post in self.posts:

@@ -1,0 +1,12 @@
+from .registry import (  # noqa: F401
+    KernelEntry,
+    KernelStats,
+    backends,
+    dispatch,
+    dispatch_count,
+    kernel_stats,
+    lookup,
+    ops,
+    register_kernel,
+    tpu_only,
+)

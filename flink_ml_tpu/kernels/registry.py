@@ -1,0 +1,370 @@
+"""Unified kernel registry — ONE compiled surface for pipelines, serving,
+and training (ROADMAP item 5).
+
+Three kernel notions grew up independently in this repo: chain
+``StageKernel`` segments (``api/chain.py``, PR 4), serving bucketed
+executors (``serving/executor.py``, PR 2), and the ``ops/`` Pallas
+kernels — each with its own dispatch, padding, and caching rules.  This
+module collapses them into one registry with two faces:
+
+- **Implementation lookup** (:func:`lookup`): ``(op, schema-signature,
+  backend) -> KernelEntry``.  Training step builders resolve their hot
+  path here instead of branching on ``use_pallas`` by hand
+  (``models/common/sgd.py``'s ELL path, GBT's histogram impl, KMeans'
+  fit plan, Wide&Deep's routed table gradient).  A Pallas implementation
+  registered once is picked up by every consumer; the XLA lowering
+  registered for the same op is the automatic non-TPU fallback (A/B
+  parity asserted in ``tests/test_kernels.py``'s matrix).
+
+- **Dispatch surface** (:func:`dispatch`): THE shared plan-static jit
+  (moved here from ``api/chain.py``'s segment runner).  A "plan" is a
+  tuple of ``(fn, static)`` stage pairs with params as runtime device
+  arguments, so chain segments, the specialized serving executors, and
+  the models' own predict entry points all hit ONE compile cache: the
+  same ``(op, schema, bucket)`` warmed by any consumer is a cache hit
+  for the others (lowering-counter-asserted).
+
+Padding is NOT re-decided per consumer: every registered kernel names
+one of the two documented contracts in ``utils/padding.py`` — the
+masked pad-to-multiple rule (``pad_rows_with_mask``) or the maskless
+zero-fill block rule (``pad_rows_to_block`` + the kernel's own
+pad-correction), and the dispatch surface pads rows to the shared
+power-of-two buckets (``pad_rows_to_bucket``) exactly as the predict
+entry points always did.
+
+Observability: compile-count / cache-hit / dispatch-latency gauges live
+on :data:`kernel_stats` and publish into any ``MetricGroup`` (serving
+endpoints re-export them per batch; ``bench.py::bench_kernels`` reports
+them), so cross-consumer compile reuse — CV folds, hot-swap
+generations, fused serving — is a measured number.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+__all__ = [
+    "KernelEntry",
+    "KernelStats",
+    "backends",
+    "dispatch",
+    "dispatch_count",
+    "kernel_stats",
+    "lookup",
+    "ops",
+    "register_kernel",
+    "tpu_only",
+]
+
+
+def tpu_only() -> bool:
+    """The default availability gate for Pallas/MXU-shaped entries."""
+    return jax.default_backend() == "tpu"
+
+
+@dataclass(frozen=True)
+class KernelEntry:
+    """One registered implementation of an op on one backend.
+
+    ``fn``'s calling convention is per-``convention``:
+
+    - ``"impl"`` — a raw device function; training step builders call
+      it inside their own jitted step/scan (the enclosing program is
+      the executable).  Most impl ops register ONE uniform signature
+      across backends (the ELL ops, ``routed_table_grad``); the KMeans
+      PLANNING ops intentionally do not — their backends take genuinely
+      different operands (mask vs maskless contract, a measure
+      singleton vs euclidean-only), so the lookup is a plan decision
+      and the single backend branch lives NEXT TO the registration
+      (``models/clustering/kmeans.py``), never at scattered call
+      sites.  An op's calling convention is documented at its
+      registration.
+    - ``"stage"`` — the chain ``StageKernel`` convention
+      ``fn(static, params, cols) -> {name: array}``; dispatched through
+      the shared plan jit (:func:`dispatch`), where the ``(fn, static)``
+      pair IS the compiled-program identity shared across consumers.
+
+    ``supports(sig)`` is the shape/schema contract (e.g. the fused ELL
+    kernels need ``rows % 8 == 0``); ``available()`` is the backend
+    gate (Pallas entries default to TPU-only).  A *forced* backend
+    lookup bypasses ``available`` — tests and bench A/B legs run Pallas
+    kernels in interpret mode on CPU — but never ``supports``: a shape
+    the kernel cannot express must fail loudly, not fall back silently.
+    """
+
+    op: str
+    backend: str
+    fn: Callable
+    priority: int = 0
+    supports: Optional[Callable[[tuple], bool]] = None
+    available: Optional[Callable[[], bool]] = None
+    convention: str = "impl"   # "impl" | "stage"
+
+    def supports_sig(self, sig: tuple) -> bool:
+        return self.supports is None or bool(self.supports(sig))
+
+    def is_available(self) -> bool:
+        return self.available is None or bool(self.available())
+
+
+_REGISTRY: Dict[str, Dict[str, KernelEntry]] = {}
+_REG_LOCK = threading.Lock()
+# Catalog-load state has its OWN (reentrant) lock: the import must not
+# run under _REG_LOCK — the catalog's modules call register_kernel,
+# which takes it.  RLock so a registering module that itself looks
+# something up at import time cannot self-deadlock.
+_CATALOG_LOCK = threading.RLock()
+_CATALOG_LOADED = [False]
+
+
+def _ensure_catalog() -> None:
+    """Import the modules that register kernels (idempotent, lazy — at
+    first lookup, not at package import, so there is no import cycle
+    between ``kernels`` and the model/op modules that register into
+    it).  Concurrent first lookups serialize on the catalog lock so no
+    thread ever reads a half-populated registry, and the loaded flag
+    only latches AFTER a successful import — a transient import failure
+    surfaces on every lookup until it actually succeeds, instead of
+    permanently reporting 'unknown kernel op'."""
+    if _CATALOG_LOADED[0]:
+        return
+    with _CATALOG_LOCK:
+        if _CATALOG_LOADED[0]:
+            return
+        from . import catalog  # noqa: F401  (imports register as a side effect)
+        _CATALOG_LOADED[0] = True
+
+
+def register_kernel(op: str, backend: str, fn: Callable, *,
+                    priority: int = 0,
+                    supports: Optional[Callable[[tuple], bool]] = None,
+                    available: Optional[Callable[[], bool]] = None,
+                    convention: str = "impl") -> KernelEntry:
+    """Register (or replace — module reloads must not duplicate) the
+    implementation of ``op`` on ``backend``."""
+    if convention not in ("impl", "stage"):
+        raise ValueError(f"unknown convention {convention!r}")
+    entry = KernelEntry(op=op, backend=backend, fn=fn, priority=priority,
+                        supports=supports, available=available,
+                        convention=convention)
+    with _REG_LOCK:
+        _REGISTRY.setdefault(op, {})[backend] = entry
+    return entry
+
+
+def ops() -> Tuple[str, ...]:
+    _ensure_catalog()
+    return tuple(sorted(_REGISTRY))
+
+
+def backends(op: str) -> Tuple[str, ...]:
+    _ensure_catalog()
+    if op not in _REGISTRY:
+        raise KeyError(f"unknown kernel op {op!r}; registered: {ops()}")
+    return tuple(sorted(_REGISTRY[op]))
+
+
+def lookup(op: str, sig: tuple = (), *,
+           backend: Optional[str] = None) -> KernelEntry:
+    """Resolve ``(op, schema-signature)`` to the best registered entry.
+
+    ``backend`` forces a specific implementation (the bench A/B legs and
+    the tests' XLA oracles): availability is bypassed — the caller owns
+    running e.g. a Pallas kernel in interpret mode — but a PROVIDED
+    ``sig`` still gates through ``supports``, so a shape outside the
+    kernel's contract raises instead of silently computing the wrong
+    thing.  A forced lookup with no sig returns the entry unchecked
+    (the parity matrix probes kernels below their planning thresholds
+    on purpose; the kernel's own shape validation still applies at call
+    time)."""
+    _ensure_catalog()
+    table = _REGISTRY.get(op)
+    if table is None:
+        raise KeyError(f"unknown kernel op {op!r}; registered: {ops()}")
+    if backend is not None:
+        entry = table.get(backend)
+        if entry is None:
+            raise KeyError(
+                f"op {op!r} has no backend {backend!r}; registered: "
+                f"{tuple(sorted(table))}")
+        if sig != () and not entry.supports_sig(sig):
+            raise ValueError(
+                f"op {op!r} backend {backend!r} does not support "
+                f"signature {sig!r}")
+        return entry
+    cands = [e for e in table.values()
+             if e.is_available() and e.supports_sig(sig)]
+    if not cands:
+        raise ValueError(
+            f"no available backend of op {op!r} supports signature "
+            f"{sig!r} (registered: {tuple(sorted(table))})")
+    # deterministic: priority desc, backend name as the tiebreak
+    cands.sort(key=lambda e: (-e.priority, e.backend))
+    return cands[0]
+
+
+# --------------------------------------------------------------------------
+# observability
+# --------------------------------------------------------------------------
+
+class KernelStats:
+    """Dispatcher-level accounting: how many distinct ``(plan, shapes)``
+    programs compiled, how often later dispatches reused one, and what a
+    dispatch costs wall-clock.
+
+    ``compiles`` mirrors the shared jit's cache keying (plan identity +
+    operand shapes/dtypes), so "second consumer was a cache hit" is a
+    gauge — not only a lowering-counter assertion buried in tests.
+    Latency is time-to-return of the (async) dispatch: steady-state it
+    is the dispatch overhead, on a cold key it includes the compile
+    (which is exactly what an operator wants to see spike)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.compiles = 0
+        self.cache_hits = 0
+        self.dispatches = 0
+        self._lat_ema_ms = 0.0
+        self._last_ms = 0.0
+        self.per_op: Dict[str, Dict[str, int]] = {}
+
+    def record(self, op: str, *, compiled: bool, seconds: float) -> None:
+        ms = seconds * 1e3
+        with self._lock:
+            self.dispatches += 1
+            if compiled:
+                self.compiles += 1
+            else:
+                self.cache_hits += 1
+            self._last_ms = ms
+            self._lat_ema_ms = (0.8 * self._lat_ema_ms + 0.2 * ms
+                                if self._lat_ema_ms else ms)
+            rec = self.per_op.setdefault(
+                op, {"dispatches": 0, "compiles": 0, "cache_hits": 0})
+            rec["dispatches"] += 1
+            rec["compiles" if compiled else "cache_hits"] += 1
+
+    @property
+    def dispatch_latency_ms(self) -> float:
+        return self._lat_ema_ms
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "compiles": self.compiles,
+                "cache_hits": self.cache_hits,
+                "dispatches": self.dispatches,
+                "dispatch_latency_ms": round(self._lat_ema_ms, 4),
+                "last_dispatch_ms": round(self._last_ms, 4),
+                "per_op": {k: dict(v) for k, v in self.per_op.items()},
+            }
+
+    def publish(self, group) -> None:
+        """Refresh gauges on ``group`` (the ``PrefetchStats.publish``
+        idiom): serving endpoints re-export the registry's counters into
+        their own metric subtree, ``bench.py`` into its report."""
+        snap = self.snapshot()
+        for name in ("compiles", "cache_hits", "dispatches",
+                     "dispatch_latency_ms", "last_dispatch_ms"):
+            group.gauge(name).set(snap[name])
+        group.gauge("ops_seen").set(len(snap["per_op"]))
+
+
+#: THE process-wide stats instance (one dispatch surface, one ledger).
+kernel_stats = KernelStats()
+
+
+# --------------------------------------------------------------------------
+# the shared dispatch surface — ONE jit for every plan
+# (moved verbatim from api/chain.py, which now delegates here)
+# --------------------------------------------------------------------------
+
+def _run_plan(plan: tuple, params_seq: tuple, one, cols: Dict[str, Any]):
+    import jax.numpy as jnp
+
+    out = dict(cols)
+    for (fn, static), params in zip(plan, params_seq):
+        produced = fn(static, params, out)
+        # Rounding barrier: multiply every float output by a RUNTIME 1.0.
+        # Without it LLVM contracts elementwise chains across the stage
+        # boundary (a trailing mul fused into the next stage's add/sub as
+        # one fma), skipping the intermediate rounding the stagewise path
+        # performs — 1-ulp drift that breaks bit-exactness.  The compiler
+        # cannot fold the mul (the value is a runtime argument), yet any
+        # contraction THROUGH it is value-identical: fma(t, 1, c) rounds
+        # to exactly t + c.  (jax.lax.optimization_barrier does not help
+        # here — XLA duplicates producers into consumer fusions across
+        # it.)  Integer columns are exact and pass through untouched.
+        out.update({
+            name: col * one
+            if jnp.issubdtype(jnp.result_type(col), jnp.inexact) else col
+            for name, col in produced.items()})
+    return out
+
+
+_ONE = np.float32(1.0)   # the runtime rounding-barrier operand
+
+_JIT_LOCK = threading.Lock()
+_PLAN_JIT: list = []
+
+
+def _plan_jit() -> Callable:
+    """The lazily-built shared jit.  static_argnums=0: the plan tuple of
+    (fn, static) pairs IS the program identity; params/cols are runtime
+    device args — a CrossValidator's k fold models, hot-swapped serving
+    generations, and the models' own predict entry points all hit one
+    cache entry per (plan, schema, bucket).  On TPU the column dict is
+    donated: every consumer's cols are per-call transfer buffers (chain
+    segments re-pad per batch, serving pads per request), dead after the
+    call — donation lets XLA reuse the HBM allocation.  CPU ignores
+    donation, so it is skipped there to avoid spurious warnings (the
+    stance ``serving/executor.py`` always took)."""
+    if not _PLAN_JIT:
+        with _JIT_LOCK:
+            if not _PLAN_JIT:
+                donate = (3,) if tpu_only() else ()
+                _PLAN_JIT.append(jax.jit(_run_plan, static_argnums=(0,),
+                                         donate_argnums=donate))
+    return _PLAN_JIT[0]
+
+
+_SEEN_KEYS: set = set()
+_DISPATCHES = [0]
+
+
+def _shape_key(params_seq, cols) -> tuple:
+    leaves, treedef = jax.tree_util.tree_flatten((params_seq, cols))
+    return (treedef,
+            tuple((np.shape(leaf), np.result_type(leaf).str)
+                  for leaf in leaves))
+
+
+def dispatch(plan: tuple, params_seq: tuple, cols: Dict[str, Any], *,
+             op: Optional[str] = None) -> Dict[str, Any]:
+    """Run ``plan`` over ``cols`` through THE shared jit, with compile /
+    cache-hit / latency accounting.  ``op`` labels the per-op counters
+    (defaults to the stage fns' names)."""
+    label = op or "+".join(fn.__name__ for fn, _ in plan)
+    key = (plan, _shape_key(params_seq, cols))
+    with _JIT_LOCK:
+        compiled = key not in _SEEN_KEYS
+        _SEEN_KEYS.add(key)
+        _DISPATCHES[0] += 1
+    t0 = time.perf_counter()
+    out = _plan_jit()(plan, params_seq, _ONE, cols)
+    kernel_stats.record(label, compiled=compiled,
+                        seconds=time.perf_counter() - t0)
+    return out
+
+
+def dispatch_count() -> int:
+    """Shared-jit invocations so far (one per segment/kernel run) — the
+    bench_pipeline A/B evidence, previously ``api.chain.dispatch_count``."""
+    return _DISPATCHES[0]

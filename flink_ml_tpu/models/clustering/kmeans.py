@@ -121,10 +121,12 @@ class KMeansParams(KMeansModelParams, HasSeed, HasMaxIter):
         "Settled points keep cached assignments, shrinking the points "
         "SCORED per round (the report/bench accounting; the fused "
         "program still evaluates dense shapes, so the wall-clock win "
-        "today is the early exit).  Pins the XLA body — final centroids "
-        "are bit-identical to the XLA BSP fit (first-index argmin; "
-        "tiePolicy and the Pallas kernel, whose f32 reduction order "
-        "differs, do not apply).  The fit records a per-round "
+        "today is the early exit).  Off TPU the body is XLA — final "
+        "centroids bit-identical to the XLA BSP fit (first-index "
+        "argmin; tiePolicy does not apply).  On TPU the registry plans "
+        "the fused scoring+stats kernel (op kmeans_workset_update) "
+        "above the Pallas row threshold: same assignments, stats equal "
+        "to f32 summation order.  The fit records a per-round "
         "convergence report in estimator.last_workset_report.",
         default=False)
 
@@ -321,9 +323,41 @@ def workset_points_scored(active_fraction, n_real: int,
 _WS_BOUND_SLACK = 1e-5
 
 
-def kmeans_workset_epoch_step(measure: DistanceMeasure, k: int):
+def kmeans_workset_update_xla(measure: DistanceMeasure, k: int, points,
+                              centroids, prev_assign, active, pad_mask):
+    """XLA backend of registry op ``kmeans_workset_update`` — the
+    bound-filtered scoring + stats of one workset round, and the parity
+    oracle the fused Pallas kernel is matrix-tested against.  Returns
+    ``(assign, d_best, d_second, sums, counts)`` with ``assign`` already
+    merged under the active mask (the settled points' cached
+    assignments); ``d_best``/``d_second`` are the FRESH per-point
+    distances — the caller keeps its old bounds where settled."""
+    dists = measure.pairwise(points, centroids)             # (n, k)
+    fresh = jnp.argmin(dists, axis=1).astype(jnp.int32)
+    is_min = jnp.arange(k, dtype=jnp.int32)[None, :] == fresh[:, None]
+    d_best = jnp.min(dists, axis=1)
+    d_second = jnp.min(jnp.where(is_min, jnp.inf, dists), axis=1)
+    assign = jnp.where(active > 0, fresh, prev_assign).astype(jnp.int32)
+    sums, counts = _stats_from_assign(k, points, pad_mask, assign)
+    return assign, d_best, d_second, sums, counts
+
+
+def kmeans_workset_epoch_step(measure: DistanceMeasure, k: int, *,
+                              block_n: Optional[int] = None,
+                              interpret: bool = False):
     """One bound-filtered Lloyd's iteration as an ``iterate`` workset body
     (Hamerly 2010 adapted to the device-resident mask).
+
+    ``block_n`` switches the scoring+stats block onto the fused Pallas
+    kernel (``ops/kmeans_pallas.py::kmeans_workset_update`` — registry
+    op ``kmeans_workset_update``): distances, first-index argmin, the
+    second-best pass, the cached-assignment merge, AND the stats reduce
+    run as one VMEM kernel, so the (n, k) intermediates never touch HBM.
+    Per-point outputs are expression-identical to the XLA block below;
+    the stats accumulate tile-sequentially (f32-summation-order
+    equivalent, not bitwise — the registry plans it only on TPU, so the
+    CPU tier's bit-exactness contract vs BSP is untouched).  The bound
+    decay, settle detection, and centroid update are shared verbatim.
 
     Per-point bound state rides ``workset.bounds``: the cached assignment,
     an UPPER bound on the distance to the assigned centroid, and a LOWER
@@ -358,21 +392,24 @@ def kmeans_workset_epoch_step(measure: DistanceMeasure, k: int):
         points, pad_mask = data
         active = ws.mask                                    # (n,) f32 0/1
         prev_assign = ws.bounds["assign"]
-        dists = measure.pairwise(points, centroids)         # (n, k)
-        fresh = jnp.argmin(dists, axis=1).astype(jnp.int32)
-        is_min = jnp.arange(k, dtype=jnp.int32)[None, :] == fresh[:, None]
-        d_best = jnp.min(dists, axis=1)
-        d_second = jnp.min(jnp.where(is_min, jnp.inf, dists), axis=1)
+        if block_n is not None:
+            from ...ops.kmeans_pallas import kmeans_workset_update
 
-        # merge: active points take the fresh score, settled points keep
-        # their cached assignment/bounds (provably identical)
+            assign, d_best, d_second, sums, counts = kmeans_workset_update(
+                points, centroids, prev_assign, active, pad_mask,
+                block_n=block_n, interpret=interpret)
+        else:
+            assign, d_best, d_second, sums, counts = \
+                kmeans_workset_update_xla(measure, k, points, centroids,
+                                          prev_assign, active, pad_mask)
         on = active > 0
-        assign = jnp.where(on, fresh, prev_assign).astype(jnp.int32)
+        # merge: active points take the fresh score, settled points keep
+        # their cached assignment/bounds (provably identical); assign is
+        # already merged by the scoring fn, so the flip count over it
+        # equals the fresh-vs-cached count (inactive terms are masked)
         upper = jnp.where(on, d_best, ws.bounds["upper"])
         lower = jnp.where(on, d_second, ws.bounds["lower"])
-        changed = jnp.sum(active * (fresh != prev_assign))
-
-        sums, counts = _stats_from_assign(k, points, pad_mask, assign)
+        changed = jnp.sum(active * (assign != prev_assign))
         new_centroids = _update_centroids(centroids, sums, counts)
 
         drift = jnp.sqrt(jnp.maximum(
@@ -446,17 +483,19 @@ _PALLAS_MIN_ROWS = 65536
 
 def _plan_fit_impl(n: int, d: int, k: int, measure: DistanceMeasure,
                    mesh) -> tuple:
-    """Pick (impl, block_n) for the fit loop.  Pallas requires TPU backend,
-    euclidean metric, and a viable block size."""
+    """Pick (impl, block_n) for the BSP fit loop via registry op
+    ``kmeans_update_stats`` (the Pallas entry's availability gate is the
+    TPU backend; its supports predicate is the euclidean metric, the
+    row-count threshold, and a viable VMEM block).  Padding rounds the
+    per-shard row count up to the block (n=None below), so any supported
+    block size works; pick_block_n takes the largest."""
+    from ...kernels.registry import lookup
     from ...ops import kmeans_pallas as kp
 
-    if (jax.default_backend() != "tpu" or measure.name != "euclidean"
-            or n < _PALLAS_MIN_ROWS):
-        return "xla", None
-    # Padding rounds the per-shard row count up to the block (n=None), so
-    # any supported block size works; pick_block_n takes the largest.
-    bn = kp.pick_block_n(None, d, k)
-    return ("pallas", bn) if bn is not None else ("xla", None)
+    entry = lookup("kmeans_update_stats", sig=(n, d, k, measure.name))
+    if entry.backend == "pallas":
+        return "pallas", kp.pick_block_n(None, d, k)
+    return "xla", None
 
 
 @dataclass(frozen=True)
@@ -496,12 +535,26 @@ class FitPlan:
 
 def _fit_plan(n: int, d: int, k: int, measure: DistanceMeasure, mesh, *,
               workset: bool = False) -> FitPlan:
-    """Build the shared :class:`FitPlan`.  The workset path pins the XLA
-    body (the Pallas stats kernel fuses away the per-point assignment the
-    bound cache needs) — everything else falls out of
-    :func:`_plan_fit_impl` exactly as before."""
-    impl, block_n = (("xla", None) if workset
-                     else _plan_fit_impl(n, d, k, measure, mesh))
+    """Build the shared :class:`FitPlan`.  The workset path plans via
+    registry op ``kmeans_workset_update``: the fused scoring+stats
+    Pallas kernel (PR 10) where available — TPU, euclidean, a viable
+    VMEM block, and a single-device data axis (the sharded composition
+    is future work) — else the XLA body, which is what every CPU tier
+    runs (impl ``"pallas_ws"`` pads by the MASKED contract: the kernel
+    takes the pad mask, so first-row fill stays safe).  The BSP path
+    falls out of :func:`_plan_fit_impl` exactly as before."""
+    if workset:
+        from ...kernels.registry import lookup
+        from ...ops import kmeans_pallas as kp
+
+        data_devs = int(mesh.shape.get("data", 1)) if mesh else 1
+        entry = lookup("kmeans_workset_update",
+                       sig=(n, d, k, measure.name, data_devs))
+        if entry.backend == "pallas":
+            block_n = kp.pick_block_n_workset(None, d, k)
+            return FitPlan("pallas_ws", block_n, block_n, "first_row", k, d)
+        return FitPlan("xla", None, 1, "first_row", k, d)
+    impl, block_n = _plan_fit_impl(n, d, k, measure, mesh)
     row_multiple, fill = ((block_n, "zero") if impl == "pallas"
                           else (1, "first_row"))
     return FitPlan(impl, block_n, row_multiple, fill, k, d)
@@ -686,7 +739,9 @@ class KMeans(KMeansParams, Estimator["KMeansModel"]):
 
         if workset_mode:
             result = iterate(
-                kmeans_workset_epoch_step(measure, k),
+                kmeans_workset_epoch_step(
+                    measure, k,
+                    block_n=block_n if impl == "pallas_ws" else None),
                 init_dev,
                 (points, mask),
                 max_epochs=self.get_max_iter(),
@@ -807,6 +862,19 @@ class KMeansModel(KMeansModelParams, Model):
     def transform(self, *inputs) -> List[Table]:
         (table,) = inputs
         self._require_model()
+        # numeric feature columns assign through the kernel registry's
+        # shared dispatch surface — the SAME (fn, static) plan the chain
+        # terminal and the serving executor run, so offline transform,
+        # fused pipelines, and serving share one compiled executable per
+        # (schema, bucket); object-dtype vector columns keep the legacy
+        # stack_vectors entry point below
+        from ...api.chain import apply_kernel_or_none
+
+        kernel = self.transform_kernel(table.schema())
+        cols = apply_kernel_or_none(kernel, table)
+        if cols is not None:
+            return [table.with_column(self.get_prediction_col(),
+                                      cols[self.get_prediction_col()])]
         measure = DistanceMeasure.get_instance(self.get_distance_measure())
         points = stack_vectors(table[self.get_features_col()]).astype(
             np.float32)
@@ -831,3 +899,54 @@ class KMeansModel(KMeansModelParams, Model):
         data = persist.load_model_arrays(path, "model")
         model._centroids = data["centroids"].astype(np.float32)
         return model
+
+
+# ---------------------------------------------------------------------------
+# kernel-registry entries.  ``kmeans_assign`` (stage convention) is the
+# transform/serving/chain dispatch op; ``kmeans_update_stats`` and
+# ``kmeans_workset_update`` are the fit-planning ops whose supports
+# predicates carry THIS model's planning policy (euclidean metric, the
+# Pallas row-count threshold, viable VMEM blocks; the workset kernel
+# additionally requires a single-device data axis — its sharded
+# composition is future work).
+# ---------------------------------------------------------------------------
+
+def _pallas_stats_supported(sig: tuple) -> bool:
+    from ...ops import kmeans_pallas as kp
+
+    if len(sig) != 4:       # no/foreign sig: never auto-select pallas
+        return False
+    n, d, k, measure_name = sig
+    return (measure_name == "euclidean" and n >= _PALLAS_MIN_ROWS
+            and kp.pick_block_n(None, d, k) is not None)
+
+
+def _pallas_workset_supported(sig: tuple) -> bool:
+    from ...ops import kmeans_pallas as kp
+
+    if len(sig) != 5:       # no/foreign sig: never auto-select pallas
+        return False
+    n, d, k, measure_name, data_devs = sig
+    return (measure_name == "euclidean" and n >= _PALLAS_MIN_ROWS
+            and data_devs == 1
+            and kp.pick_block_n_workset(None, d, k) is not None)
+
+
+def _register_kmeans_kernels() -> None:
+    from ...kernels.registry import register_kernel, tpu_only
+    from ...ops import kmeans_pallas as kp
+
+    register_kernel("kmeans_assign", "xla", _kmeans_chain_kernel,
+                    convention="stage")
+    register_kernel("kmeans_update_stats", "pallas", kp.kmeans_update_stats,
+                    priority=10, supports=_pallas_stats_supported,
+                    available=tpu_only)
+    register_kernel("kmeans_update_stats", "xla", _assign_stats)
+    register_kernel("kmeans_workset_update", "pallas",
+                    kp.kmeans_workset_update, priority=10,
+                    supports=_pallas_workset_supported, available=tpu_only)
+    register_kernel("kmeans_workset_update", "xla",
+                    kmeans_workset_update_xla)
+
+
+_register_kmeans_kernels()

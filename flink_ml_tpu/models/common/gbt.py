@@ -116,12 +116,14 @@ def apply_bins_device(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(jnp.isnan(X), edges.shape[1], count)
 
 
-#: histogram implementation: "segsum" (XLA segment_sum scatter-adds, the
-#: r1-r4 path) or "mxu" (double one-hot matmul — histogramming as MXU
-#: contractions, the KMeans-stats pattern applied to split finding).
-#: Module-level so the bench can measure both and a chip verdict can
-#: flip the default; both are exact up to f32 summation order.
-HIST_IMPL = "segsum"
+#: histogram implementation: "auto" (the kernel registry picks — MXU
+#: one-hot matmuls on TPU, where the systolic array beats segment_sum's
+#: per-element random accumulation, XLA segment_sum elsewhere),
+#: "segsum" (force the XLA scatter-adds, the r1-r4 path) or "mxu"
+#: (force the double one-hot matmul).  Module-level so the bench can
+#: measure both and a chip verdict can pin the default; both are exact
+#: up to f32 summation order.
+HIST_IMPL = "auto"
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "d", "bins"))
@@ -190,14 +192,30 @@ _HIST_IMPLS = {"segsum": _level_histograms_segsum,
                "mxu": _level_histograms_mxu}
 
 
+def resolve_hist_impl(name: str = None) -> str:
+    """Resolve a histogram impl name ("auto" -> the kernel registry's
+    pick for this backend; "segsum"/"mxu" force) to a concrete
+    ``_HIST_IMPLS`` key.  Unknown names raise KeyError — never a silent
+    fallback."""
+    name = HIST_IMPL if name is None else name
+    if name == "auto":
+        from ...kernels.registry import lookup
+
+        backend = lookup("gbt_level_histograms").backend
+        return {"xla": "segsum"}.get(backend, backend)
+    if name not in _HIST_IMPLS:
+        raise KeyError(name)
+    return name
+
+
 def _level_histograms(binned, node_ids, grad, hess, n_nodes: int,
                       d: int, bins: int):
     """Per-(node, feature, bin) grad/hess sums for one level — the
     ADDITIVE piece of split finding: the out-of-core trainer accumulates
     these over streamed batches and decides splits from the totals.
-    Dispatches on :data:`HIST_IMPL`."""
-    return _HIST_IMPLS[HIST_IMPL](binned, node_ids, grad, hess,
-                                  n_nodes, d, bins)
+    Dispatches on :data:`HIST_IMPL` through :func:`resolve_hist_impl`."""
+    return _HIST_IMPLS[resolve_hist_impl()](binned, node_ids, grad, hess,
+                                            n_nodes, d, bins)
 
 
 def _level_splits(g_hist, h_hist, reg_lambda: float,
@@ -260,8 +278,8 @@ def _build_level(binned, node_ids, grad, hess, n_nodes: int,
     new_node_ids (n,)).  ``node_ids`` are level-local in [0, n_nodes) with
     -1 marking rows already settled in a leaf.
     """
-    g_hist, h_hist = _HIST_IMPLS[hist_impl](binned, node_ids, grad, hess,
-                                            n_nodes, d, bins)
+    g_hist, h_hist = _HIST_IMPLS[resolve_hist_impl(hist_impl)](
+        binned, node_ids, grad, hess, n_nodes, d, bins)
     best_feature, best_bin, best_gain = _level_splits(
         g_hist, h_hist, reg_lambda, min_child_weight)
     new_ids = _apply_split(binned, node_ids, best_feature, best_bin,
@@ -416,7 +434,8 @@ def _chunk_level_histograms(binned_c, g_c, h_c, feature_rows,
         gh_acc, hh_acc = carry
         b, g, h = xs
         ids = _route_to_level(b, feature_rows, threshold_rows, level)
-        gh, hh = _HIST_IMPLS[hist_impl](b, ids, g, h, n_nodes, d, bins)
+        gh, hh = _HIST_IMPLS[resolve_hist_impl(hist_impl)](
+            b, ids, g, h, n_nodes, d, bins)
         return (gh_acc + gh, hh_acc + hh), None
 
     (g_hist, h_hist), _ = jax.lax.scan(scan_step, (g_init, h_init),
@@ -825,3 +844,24 @@ def predict_forest(X: np.ndarray, forest: Forest) -> np.ndarray:
             binned, forest.feature[t], forest.threshold[t],
             forest.value[t], depth)
     return pred[:n]
+
+
+# ---------------------------------------------------------------------------
+# kernel-registry entries: op ``gbt_level_histograms``.  The MXU form is
+# the TPU default (PR 10 hot path: histogramming as one-hot systolic
+# matmuls instead of segment_sum's per-element random accumulation —
+# the decision-forest-literature TPU-histogram trick); segsum stays the
+# registered XLA fallback and the forced oracle.  Both are exact up to
+# f32 summation order, feeding the streamed histogram carry unchanged
+# (accumulation over batches is a plain add either way).
+# ---------------------------------------------------------------------------
+
+def _register_gbt_kernels() -> None:
+    from ...kernels.registry import register_kernel, tpu_only
+
+    register_kernel("gbt_level_histograms", "mxu", _level_histograms_mxu,
+                    priority=10, available=tpu_only)
+    register_kernel("gbt_level_histograms", "xla", _level_histograms_segsum)
+
+
+_register_gbt_kernels()

@@ -272,6 +272,22 @@ class LinearModelBase(LinearModelParams, Model):
 
     def transform(self, *inputs) -> List[Table]:
         (table,) = inputs
+        self._require_model()
+        # dense features score through the kernel registry's shared
+        # dispatch surface — the SAME (fn, static) plan the chain
+        # terminal and the serving executor run, so offline transform,
+        # fused pipelines, and serving share one compiled executable per
+        # (schema, bucket).  Sparse/mixed layouts (and f32-unsafe int
+        # batches) keep their own entry points below.
+        from ...api.chain import apply_kernel_or_none
+
+        kernel = self.transform_kernel(table.schema())
+        cols = apply_kernel_or_none(kernel, table)
+        if cols is not None:
+            out = table
+            for name in (n for n in cols if n not in kernel.produces):
+                out = out.with_column(name, cols[name])
+            return [out]
         m = self._margins(table)
         out = table.with_column(self.get_prediction_col(), self._decision(m))
         raw_col = self.get_raw_prediction_col()
@@ -408,3 +424,21 @@ class LinearEstimatorBase(LinearEstimatorParams, Estimator):
     @classmethod
     def load(cls, path: str):
         return persist.load_stage_param(path)
+
+
+# ---------------------------------------------------------------------------
+# kernel-registry entry: op ``linear_margins`` (stage convention).  The
+# chain-terminal kernel fn IS the registered implementation — offline
+# transform, fused pipelines, and the serving executor all dispatch this
+# one (fn, static) plan through the registry's shared jit, so any
+# consumer's warm-up is a compile-cache hit for the others.
+# ---------------------------------------------------------------------------
+
+def _register_linear_kernels() -> None:
+    from ...kernels.registry import register_kernel
+
+    register_kernel("linear_margins", "xla", _linear_chain_kernel,
+                    convention="stage")
+
+
+_register_linear_kernels()

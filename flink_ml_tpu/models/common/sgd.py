@@ -495,20 +495,15 @@ _BLOCK_LANES = 128
 _GATHER_LANES = 256
 
 
-def _use_blocked(d: int) -> bool:
-    return d % _BLOCK_LANES == 0 and d >= _BLOCK_LANES
-
-
-def _blocked_gather(w: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
-    """``w[idx]`` via lane-aligned row-gather + one-hot lane select."""
-    d = w.shape[0]
-    lanes = (_GATHER_LANES if d % _GATHER_LANES == 0 and d >= _GATHER_LANES
-             else _BLOCK_LANES)
-    flat = idx.reshape(-1)
-    hi, lo = flat // lanes, flat % lanes
-    onehot = lo[:, None] == jnp.arange(lanes, dtype=lo.dtype)[None, :]
-    rows = w.reshape(-1, lanes)[hi]
-    return jnp.sum(jnp.where(onehot, rows, 0), axis=-1).reshape(idx.shape)
+# the blocked-gather half lives in ops/ell_scatter.py now (the kernel
+# layer owns device-kernel helpers; model code imports DOWN, never the
+# reverse) — re-bound here under the historical names for the updates
+# below and for tests that exercise them through this module
+from ...ops.ell_scatter import (  # noqa: E402
+    blocked_gather as _blocked_gather,
+    gather_weights as _gather_weights,
+    use_blocked as _use_blocked,
+)
 
 
 def _blocked_scatter_add(w: jnp.ndarray, idx: jnp.ndarray,
@@ -520,10 +515,6 @@ def _blocked_scatter_add(w: jnp.ndarray, idx: jnp.ndarray,
     w2 = w.reshape(-1, _BLOCK_LANES).at[hi].add(
         updates_flat[:, None] * onehot)
     return w2.reshape(-1)
-
-
-def _gather_weights(w: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
-    return _blocked_gather(w, idx) if _use_blocked(w.shape[0]) else w[idx]
 
 
 def _scatter_add_weights(w: jnp.ndarray, idx: jnp.ndarray,
@@ -641,31 +632,30 @@ def _extended_r(r: jnp.ndarray) -> jnp.ndarray:
         [r, jnp.zeros((_ext_len(batch) - batch,), jnp.float32)])
 
 
-def _ell_margin(use_pallas, precision, w, batch, src, pos, mask, ovf_idx,
+def _ell_margin(backend, precision, w, batch, src, pos, mask, ovf_idx,
                 ovf_src, heavy_idx, heavy_cnt, val_ell=None, ovf_val=None):
     """Per-sample categorical margin ``sum_j v_j * w[idx_j]`` computed
     over the SAME ELL routing the scatter uses — the forward half of the
     r4 kernel plan (the ``w[cat]`` gather measured ~3.4 ms of the 7.79 ms
     bench-shape step; the Mosaic margin kernel replaces it with one-hot
-    MXU contractions).  In-grid slots via :func:`ops.ell_margin_fused`
-    (or the XLA twin off-TPU), overflow via a tiny gather + extended-
-    table scatter-add (pad entries carry ``ovf_src == batch`` and land
-    in the discarded pad), heavy hitters via one ``(H,) @ (H, batch)``
-    matvec."""
-    from ...ops.ell_scatter import ell_margin_fused, ell_margin_xla
+    MXU contractions).  The in-grid implementation resolves from the
+    kernel registry (op ``ell_margin``: the fused Mosaic kernel on TPU
+    grids divisible into 8-row blocks, the XLA twin otherwise;
+    ``backend`` forces one — tests pass ``"xla"`` for the oracle).
+    Overflow via a tiny gather + extended-table scatter-add (pad entries
+    carry ``ovf_src == batch`` and land in the discarded pad), heavy
+    hitters via one ``(H,) @ (H, batch)`` matvec."""
+    from ...kernels.registry import lookup
 
-    m_len = _ext_len(batch)
-    if use_pallas and src.shape[0] % 8 == 0:
-        mext = ell_margin_fused(w, src, pos, mask, m_len=m_len,
-                                val=val_ell, precision=precision)
-    else:
-        mext = ell_margin_xla(w, src, pos, mask, m_len, val=val_ell)
+    entry = lookup("ell_margin", sig=(int(src.shape[0]),), backend=backend)
+    mext = entry.fn(w, src, pos, mask, m_len=_ext_len(batch),
+                    val=val_ell, precision=precision)
     o = w[ovf_idx] if ovf_val is None else ovf_val * w[ovf_idx]
     mext = mext.at[ovf_src].add(o, mode="drop")
     return mext[:batch] + w[heavy_idx] @ heavy_cnt.astype(jnp.float32)
 
 
-def _apply_ell_categorical(use_pallas, precision, lr, w, r, r_ext, src,
+def _apply_ell_categorical(backend, precision, lr, w, r, r_ext, src,
                            pos, mask, ovf_idx, ovf_src, heavy_idx,
                            heavy_cnt, val_ell=None, ovf_val=None):
     """THE single copy of the ELL gradient application shared by the
@@ -675,31 +665,27 @@ def _apply_ell_categorical(use_pallas, precision, lr, w, r, r_ext, src,
     per-slot updates; padding entries carry zero counts and add 0 at
     w[0]).
 
-    On TPU (``use_pallas``) the slot gather + scatter run as ONE fused
-    Mosaic kernel — the r4 ablation measured the standalone XLA u-gather
-    as the dominant step cost (~5.6 ms of a 7.79 ms step; fused step
-    6.53 ms vs 8.92 ms XLA oracle) — with a per-shape fallback to the
-    gather + scatter-kernel pair when the grid doesn't divide into the
-    fused kernel's 8-row blocks."""
-    from ...ops.ell_scatter import (ell_scatter_apply,
-                                    ell_scatter_apply_fused,
-                                    ell_scatter_apply_xla)
+    The in-grid implementation resolves from the kernel registry (op
+    ``ell_scatter_apply``): on TPU the slot gather + scatter run as ONE
+    fused Mosaic kernel — the r4 ablation measured the standalone XLA
+    u-gather as the dominant step cost (~5.6 ms of a 7.79 ms step;
+    fused step 6.53 ms vs 8.92 ms XLA oracle) — with the gather +
+    scatter-kernel pair as the registered fallback when the grid
+    doesn't divide into the fused kernel's 8-row blocks, and the pure
+    XLA lowering off TPU (``backend`` forces one)."""
+    from ...kernels.registry import lookup
 
-    if use_pallas and src.shape[0] % 8 == 0:
-        w = ell_scatter_apply_fused(w, r_ext, src, pos, mask, lr=lr,
-                                    val=val_ell, precision=precision)
-    else:
-        g = _gather_weights(r_ext, src)
-        u = (-lr) * (g if val_ell is None else val_ell * g)
-        apply_ell = ell_scatter_apply if use_pallas else ell_scatter_apply_xla
-        w = apply_ell(w, u, pos, mask)
+    entry = lookup("ell_scatter_apply", sig=(int(src.shape[0]),),
+                   backend=backend)
+    w = entry.fn(w, r_ext, src, pos, mask, lr=lr, val=val_ell,
+                 precision=precision)
     o = r_ext[ovf_src] if ovf_val is None else ovf_val * r_ext[ovf_src]
     w = w.at[ovf_idx].add((-lr) * o)
     return w.at[heavy_idx].add((-lr) * (heavy_cnt.astype(jnp.float32) @ r))
 
 
 def _mixed_update_ell(loss_fn: LossFn, config: SGDConfig,
-                      use_pallas: bool = True):
+                      backend=None):
     """Kernel-planned twin of :func:`_mixed_update`: same loss/
     regularization algebra, but BOTH halves of the categorical work —
     the forward margin gather and the backward scatter — go through the
@@ -720,7 +706,7 @@ def _mixed_update_ell(loss_fn: LossFn, config: SGDConfig,
         w, b = params["w"], params["b"]
         n_dense = dense.shape[-1]
         margin = (dense @ w[:n_dense]
-                  + _ell_margin(use_pallas, config.ell_precision,
+                  + _ell_margin(backend, config.ell_precision,
                                 w, dense.shape[0], src, pos,
                                 mask, ovf_idx, ovf_src, heavy_idx,
                                 heavy_cnt) + b)
@@ -730,7 +716,7 @@ def _mixed_update_ell(loss_fn: LossFn, config: SGDConfig,
 
         def apply_grad(w):
             w = _apply_ell_categorical(
-                use_pallas, config.ell_precision, lr, w, r, r_ext, src,
+                backend, config.ell_precision, lr, w, r, r_ext, src,
                 pos, mask, ovf_idx, ovf_src, heavy_idx, heavy_cnt)
             return w.at[:n_dense].add(-lr * (r @ dense))
 
@@ -750,7 +736,7 @@ def _shard_map(fn, mesh, in_specs, out_specs):
 
 
 def _mixed_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
-                              num_features: int, use_pallas: bool = True):
+                              num_features: int, backend=None):
     """Data-parallel twin of :func:`_mixed_update_ell` (VERDICT r3 task 4:
     the pod-scale ELL path).  Each device routes only ITS batch shard's
     categorical slots through a device-LOCAL ELL grid — the layout stacks
@@ -772,7 +758,7 @@ def _mixed_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
         # device dim; r_l is this device's residual shard
         r_ext = _extended_r(r_l)
         delta = _apply_ell_categorical(
-            use_pallas, config.ell_precision, lr,
+            backend, config.ell_precision, lr,
             jnp.zeros((num_features,), jnp.float32), r_l,
             r_ext, src[0], pos[0], mask[0], ovf_idx[0], ovf_src[0],
             heavy_idx[0], heavy_cnt[0])
@@ -790,7 +776,7 @@ def _mixed_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
         # replicated — no collective needed, margins reassemble over
         # 'data' (the local batch size is heavy_cnt's trailing dim)
         return _ell_margin(
-            use_pallas, config.ell_precision, w, heavy_cnt.shape[-1],
+            backend, config.ell_precision, w, heavy_cnt.shape[-1],
             src[0], pos[0], mask[0], ovf_idx[0], ovf_src[0],
             heavy_idx[0], heavy_cnt[0])
 
@@ -820,7 +806,7 @@ def _mixed_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
 
 
 def _sparse_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
-                               num_features: int, use_pallas: bool = True):
+                               num_features: int, backend=None):
     """Values-aware twin of :func:`_mixed_update_ell_sharded` for the
     generic (indices, values) layout — the same device-local-grid + psum
     scatter, with per-slot updates ``-lr * value * r`` carried by the
@@ -834,7 +820,7 @@ def _sparse_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
                      heavy_idx, heavy_cnt):
         r_ext = _extended_r(r_l)
         delta = _apply_ell_categorical(
-            use_pallas, config.ell_precision, lr,
+            backend, config.ell_precision, lr,
             jnp.zeros((num_features,), jnp.float32), r_l,
             r_ext, src[0], pos[0], mask[0], ovf_idx[0], ovf_src[0],
             heavy_idx[0], heavy_cnt[0], val_ell=val[0], ovf_val=ovf_val[0])
@@ -850,7 +836,7 @@ def _sparse_update_ell_sharded(loss_fn: LossFn, config: SGDConfig, mesh,
         # same stance as _mixed_update_ell_sharded: local layout covers
         # local samples, w replicated, margins reassemble over 'data'
         return _ell_margin(
-            use_pallas, config.ell_precision, w, heavy_cnt.shape[-1],
+            backend, config.ell_precision, w, heavy_cnt.shape[-1],
             src[0], pos[0], mask[0], ovf_idx[0], ovf_src[0],
             heavy_idx[0], heavy_cnt[0], val_ell=val[0],
             ovf_val=ovf_val[0])
@@ -926,8 +912,7 @@ def sgd_fit_sparse(loss_fn: LossFn, indices: np.ndarray, values: np.ndarray,
             lay.src, lay.pos, lay.mask, lay.val, lay.ovf_idx, lay.ovf_src,
             lay.ovf_val, lay.heavy_idx, lay.heavy_cnt))
         update = _sparse_update_ell_sharded(
-            loss_fn, config, mesh, num_features,
-            use_pallas=jax.default_backend() == "tpu")
+            loss_fn, config, mesh, num_features)
     elif impl == "ell":
         from ...ops.ell_scatter import ell_layout
 
@@ -935,8 +920,7 @@ def sgd_fit_sparse(loss_fn: LossFn, indices: np.ndarray, values: np.ndarray,
         extra = (layout.src, layout.pos, layout.mask, layout.val,
                  layout.ovf_idx, layout.ovf_src, layout.ovf_val,
                  layout.heavy_idx, layout.heavy_cnt)
-        update = _sparse_update_ell(
-            loss_fn, config, use_pallas=jax.default_backend() == "tpu")
+        update = _sparse_update_ell(loss_fn, config)
     else:
         extra = ()
         update = _sparse_update(loss_fn, config)
@@ -1015,7 +999,7 @@ def plan_mixed_impl(num_features: int, mesh, steps: int = 1,
 
 
 def _sparse_update_ell(loss_fn: LossFn, config: SGDConfig,
-                       use_pallas: bool = True):
+                       backend=None):
     """Kernel-planned twin of :func:`_sparse_update` for the generic
     (indices, values) layout: per-slot updates are ``-lr * value * r``,
     carried by the layout's value arrays (``EllLayout.val`` /
@@ -1027,7 +1011,7 @@ def _sparse_update_ell(loss_fn: LossFn, config: SGDConfig,
     def update(params, src, pos, mask, val_ell, ovf_idx,
                ovf_src, ovf_val, heavy_idx, heavy_cnt, yb, wb):
         w, b = params["w"], params["b"]
-        margin = _ell_margin(use_pallas, config.ell_precision, w,
+        margin = _ell_margin(backend, config.ell_precision, w,
                              yb.shape[0], src, pos, mask,
                              ovf_idx, ovf_src, heavy_idx, heavy_cnt,
                              val_ell=val_ell, ovf_val=ovf_val) + b
@@ -1037,7 +1021,7 @@ def _sparse_update_ell(loss_fn: LossFn, config: SGDConfig,
 
         def apply_grad(w):
             return _apply_ell_categorical(
-                use_pallas, config.ell_precision, lr, w, r, r_ext, src,
+                backend, config.ell_precision, lr, w, r, r_ext, src,
                 pos, mask, ovf_idx, ovf_src, heavy_idx, heavy_cnt,
                 val_ell=val_ell, ovf_val=ovf_val)
 
@@ -1200,8 +1184,7 @@ def sgd_fit_mixed(loss_fn: LossFn, dense_features: np.ndarray,
             lay.src, lay.pos, lay.mask, lay.ovf_idx, lay.ovf_src,
             lay.heavy_idx, lay.heavy_cnt))
         update = _mixed_update_ell_sharded(
-            loss_fn, config, mesh, num_features,
-            use_pallas=jax.default_backend() == "tpu")
+            loss_fn, config, mesh, num_features)
     elif impl == "ell":
         # one-time static routing of every step's categorical slots
         # (amortised over max_epochs replays of the same epoch tensor)
@@ -1211,8 +1194,7 @@ def sgd_fit_mixed(loss_fn: LossFn, dense_features: np.ndarray,
         extra = (layout.src, layout.pos, layout.mask,
                  layout.ovf_idx, layout.ovf_src,
                  layout.heavy_idx, layout.heavy_cnt)
-        update = _mixed_update_ell(
-            loss_fn, config, use_pallas=jax.default_backend() == "tpu")
+        update = _mixed_update_ell(loss_fn, config)
     elif impl == "sharded":
         # weight sharded over the model axis (2^24+ hash spaces never
         # replicate); see _mixed_update_sharded
@@ -1570,11 +1552,9 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                                else "dense-stream")))
     if stream_sharded:
         update = _mixed_update_ell_sharded(
-            loss_fn, config, mesh, num_features,
-            use_pallas=jax.default_backend() == "tpu")
+            loss_fn, config, mesh, num_features)
     elif stream_ell:
-        update = _mixed_update_ell(
-            loss_fn, config, use_pallas=jax.default_backend() == "tpu")
+        update = _mixed_update_ell(loss_fn, config)
     elif gr is not None:
         update = _linear_update_reduced(loss_fn, config, mesh)
     else:

@@ -549,6 +549,21 @@ class WideDeepModel(WideDeepParams, Model):
     def transform(self, *inputs) -> List[Table]:
         (table,) = inputs
         self._require_model()
+        # the kernel registry's shared dispatch surface (the chain
+        # terminal's (fn, static) plan): offline transform, fused
+        # pipelines, and serving share one compiled executable per
+        # (schema, bucket); the in-kernel id offset is an exact int add,
+        # the range check runs as the kernel's host pre exactly like
+        # _validate_cat_ids
+        from ...api.chain import apply_kernel_or_none
+
+        kernel = self.transform_kernel(table.schema())
+        cols = apply_kernel_or_none(kernel, table)
+        if cols is not None:
+            out = table
+            for name in (n for n in cols if n not in kernel.produces):
+                out = out.with_column(name, cols[name])
+            return [out]
         dense = np.asarray(table[self.DENSE_FEATURES_COL],
                            np.float32)
         cat = np.asarray(table[self.CAT_FEATURES_COL], np.int32)
@@ -665,6 +680,11 @@ def _make_train_ops(params, lr: float, lazy: bool, route=None,
             raise ValueError(
                 "routed table gradients are a dense-Adam path; disable "
                 "lazyEmbeddingOptimizer or set routedEmbeddingGrad='off'")
+        # registry op ``routed_table_grad``, resolved ONCE at step-build:
+        # the fused Mosaic fold (ops/emb_grad_pallas.py) on TPU, the XLA
+        # routed path elsewhere — the step body never branches on backend
+        route_apply = route.resolve_apply()
+
         def batch_step(params, opt_state, dense, cat_ids, labels, mask,
                        *route_arrays):
             _, rest = split(params)
@@ -681,9 +701,9 @@ def _make_train_ops(params, lr: float, lazy: bool, route=None,
             emb_dim = emb_rows.shape[-1]
             grads = {
                 **g_rest,
-                "emb": route.apply(g_emb.reshape(-1, emb_dim),
+                "emb": route_apply(g_emb.reshape(-1, emb_dim),
                                    *route_arrays),
-                "wide_cat": route.apply(g_wide.reshape(-1),
+                "wide_cat": route_apply(g_wide.reshape(-1),
                                         *route_arrays),
             }
             updates, opt_state = opt.update(grads, opt_state, params)
@@ -954,3 +974,19 @@ def _build_reduced_sharded_step(mesh, gr, sharded_params, opt, opt_state,
 
     return (train_step, sharded_params, opt, opt_state, shard_batch_fn,
             gr_state0)
+
+
+# ---------------------------------------------------------------------------
+# kernel-registry entry: op ``widedeep_scores`` (stage convention) — the
+# chain-terminal sigmoid(forward) plan shared by offline transform,
+# fused pipelines, and the serving executor.
+# ---------------------------------------------------------------------------
+
+def _register_widedeep_kernels() -> None:
+    from ...kernels.registry import register_kernel
+
+    register_kernel("widedeep_scores", "xla", _widedeep_chain_kernel,
+                    convention="stage")
+
+
+_register_widedeep_kernels()

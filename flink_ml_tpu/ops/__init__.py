@@ -4,6 +4,10 @@ from .emb_grad import (  # noqa: F401
     routed_table_grad,
     routed_table_grad_gather,
 )
+from .emb_grad_pallas import (  # noqa: F401
+    fold_runs_fused,
+    routed_table_grad_gather_fused,
+)
 from .ell_scatter import (  # noqa: F401
     EllLayout,
     ell_layout,
@@ -13,8 +17,10 @@ from .ell_scatter import (  # noqa: F401
 from .kmeans_pallas import (  # noqa: F401
     kmeans_assign_reduce,
     kmeans_update_stats,
+    kmeans_workset_update,
     pad_correction,
     pick_block_n,
+    pick_block_n_workset,
     supported,
     update_stats_sharded,
 )

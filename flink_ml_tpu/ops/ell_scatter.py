@@ -832,3 +832,102 @@ def ell_margin_fused(w: jnp.ndarray, src: jnp.ndarray, pos: jnp.ndarray,
         interpret=interpret,
     )(*operands)
     return out.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# kernel-registry entries (kernels/registry.py): the ELL hot paths under
+# ONE uniform signature per op, so the training step builders resolve
+# their implementation with a lookup instead of branching on a
+# ``use_pallas`` flag by hand.  Backend selection mirrors the legacy
+# branches exactly: fully-fused Mosaic when the table grid divides into
+# 8-row blocks, the gather + Mosaic-scatter pair otherwise, pure XLA off
+# TPU (and as the forced oracle).
+# ---------------------------------------------------------------------------
+
+def ell_margin_xla_entry(w, src, pos, mask, *, m_len: int, val=None,
+                         precision: str = "default", interpret: bool = False):
+    """XLA backend of op ``ell_margin`` (registry signature; ``precision``
+    and ``interpret`` are Mosaic knobs the XLA lowering has no use for —
+    it always accumulates in f32)."""
+    return ell_margin_xla(w, src, pos, mask, m_len, val=val)
+
+
+# -- lane-blocked weight gather ---------------------------------------------
+# Shared with the model layer (sgd.py re-imports these): ops/ owns the
+# device-kernel helpers, models look them up — never the other way
+# around (an ops -> models import would cycle through the kernels
+# catalog the moment a lazy import is hoisted).  Blocked and elementwise
+# paths produce bitwise-equal values; blocking only changes the lowering
+# (lane-aligned row-gather + one-hot lane select instead of XLA's
+# per-element gather).
+
+_GATHER_LANES = 256
+
+
+def use_blocked(d: int) -> bool:
+    return d % _LANES == 0 and d >= _LANES
+
+
+def blocked_gather(w, idx):
+    """``w[idx]`` via lane-aligned row-gather + one-hot lane select."""
+    d = w.shape[0]
+    lanes = (_GATHER_LANES if d % _GATHER_LANES == 0 and d >= _GATHER_LANES
+             else _LANES)
+    flat = idx.reshape(-1)
+    hi, lo = flat // lanes, flat % lanes
+    onehot = lo[:, None] == jnp.arange(lanes, dtype=lo.dtype)[None, :]
+    rows = w.reshape(-1, lanes)[hi]
+    return jnp.sum(jnp.where(onehot, rows, 0), axis=-1).reshape(idx.shape)
+
+
+def gather_weights(w, idx):
+    return blocked_gather(w, idx) if use_blocked(w.shape[0]) else w[idx]
+
+
+def _ell_pair_update(r_ext, src, lr, val):
+    g = gather_weights(r_ext, src)
+    return (-lr) * (g if val is None else val * g)
+
+
+def ell_scatter_apply_pair(w, r_ext, src, pos, mask, *, lr, val=None,
+                           precision: str = "default",
+                           interpret: bool = False):
+    """``pallas-pair`` backend of op ``ell_scatter_apply``: the XLA slot
+    gather feeding the Mosaic csum/pick scatter kernel — the fallback for
+    table grids the 8-row fused kernel cannot block."""
+    return ell_scatter_apply(w, _ell_pair_update(r_ext, src, lr, val),
+                             pos, mask, interpret=interpret)
+
+
+def ell_scatter_apply_xla_entry(w, r_ext, src, pos, mask, *, lr, val=None,
+                                precision: str = "default",
+                                interpret: bool = False):
+    """XLA backend of op ``ell_scatter_apply`` (gather + csum/pick in pure
+    XLA — the CPU path and the parity oracle)."""
+    return ell_scatter_apply_xla(w, _ell_pair_update(r_ext, src, lr, val),
+                                 pos, mask)
+
+
+def _fused_blockable(sig: tuple) -> bool:
+    """Shape contract of the fused ELL kernels: ``sig = (table_rows,)``
+    must divide into the 8-row Mosaic grid blocks."""
+    return bool(sig) and sig[0] % _FUSED_BLOCK_ROWS == 0
+
+
+def _register_ell_kernels() -> None:
+    from ..kernels.registry import register_kernel, tpu_only
+
+    register_kernel("ell_margin", "pallas", ell_margin_fused,
+                    priority=20, supports=_fused_blockable,
+                    available=tpu_only)
+    register_kernel("ell_margin", "xla", ell_margin_xla_entry)
+    register_kernel("ell_scatter_apply", "pallas", ell_scatter_apply_fused,
+                    priority=30, supports=_fused_blockable,
+                    available=tpu_only)
+    register_kernel("ell_scatter_apply", "pallas-pair",
+                    ell_scatter_apply_pair, priority=20,
+                    available=tpu_only)
+    register_kernel("ell_scatter_apply", "xla", ell_scatter_apply_xla_entry)
+
+
+_register_ell_kernels()

@@ -119,7 +119,8 @@ class EmbGradRoute:
 
     def apply(self, g_flat, *step_arrays):
         """Dense table gradient from one step's slice (either
-        placement)."""
+        placement) — the XLA lowering of registry op
+        ``routed_table_grad``."""
         if self.placement == "gather":
             order, sid, pos_map = step_arrays
             return routed_table_grad_gather(
@@ -129,6 +130,31 @@ class EmbGradRoute:
         return routed_table_grad(
             g_flat, order, sid, out_pos, out_ids,
             num_rows=self.num_rows, fold_passes=self.fold_passes)
+
+    def kernel_sig(self) -> tuple:
+        """The ``(placement, fold_passes, slots_per_step)`` schema
+        signature registry op ``routed_table_grad`` selects backends
+        on."""
+        return (self.placement, self.fold_passes, int(self.order.shape[1]))
+
+    def resolve_apply(self, backend: Optional[str] = None):
+        """Registry-resolved per-step apply: ``fn(g_flat, *step_arrays)``.
+
+        The training step builders (``widedeep._make_train_ops``) call
+        this ONCE at step-build time instead of hardcoding the XLA
+        lowering — on TPU the fused Mosaic fold
+        (``ops/emb_grad_pallas.py``) is picked up automatically, off TPU
+        (or with ``backend="xla"`` forced) this is exactly
+        :meth:`apply`."""
+        from ..kernels.registry import lookup
+
+        entry = lookup("routed_table_grad", sig=self.kernel_sig(),
+                       backend=backend)
+
+        def apply_fn(g_flat, *step_arrays):
+            return entry.fn(self, g_flat, *step_arrays)
+
+        return apply_fn
 
 
 def emb_grad_route(cat_steps: np.ndarray, num_rows: int,
@@ -264,3 +290,25 @@ def routed_table_grad_gather(g_flat: jnp.ndarray, order: jnp.ndarray,
     g_ext, squeeze = _folded_ext(g_flat, order, sorted_ids, fold_passes)
     out = jnp.take(g_ext, pos_map, axis=0)
     return out[:, 0] if squeeze else out
+
+
+# ---------------------------------------------------------------------------
+# kernel-registry entry (XLA backend of op ``routed_table_grad``; the
+# fused Mosaic fold registers the "pallas" backend from
+# ``ops/emb_grad_pallas.py``).  The registry signature is
+# ``fn(route, g_flat, *step_arrays)`` so one entry serves every payload
+# width — the (S, E) embedding rows and the (S,) wide-scalar table alike.
+# ---------------------------------------------------------------------------
+
+def routed_apply_xla(route: EmbGradRoute, g_flat, *step_arrays):
+    """XLA backend of op ``routed_table_grad``."""
+    return EmbGradRoute.apply(route, g_flat, *step_arrays)
+
+
+def _register_emb_grad_kernels() -> None:
+    from ..kernels.registry import register_kernel
+
+    register_kernel("routed_table_grad", "xla", routed_apply_xla)
+
+
+_register_emb_grad_kernels()

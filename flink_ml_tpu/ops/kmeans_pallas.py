@@ -23,12 +23,17 @@ bottleneck at d=64, the VPU passes over the (block_n, k) tile are.)
 
 Design notes:
 
-- **No mask input.**  Padding rows must be exact zeros.  A zero row scores
-  ``||c||^2`` against every centroid, so all padding lands on the centroid
-  nearest the origin and contributes nothing to ``sums``; the caller
-  subtracts the padding count from that one cluster (:func:`pad_correction`)
-  — an exact fix that saves one HBM read + one (block_n, k) VPU pass over
-  keeping a mask.
+- **No mask input.**  Padding rows must be exact zeros — the MASKLESS
+  kernel padding contract of ``utils/padding.py`` (``pad_rows_to_block``
+  zero-fill + :func:`require_block_rows` divisibility; the shared rule
+  every registered kernel pads by, not a module-local convention).  A
+  zero row scores ``||c||^2`` against every centroid, so all padding
+  lands on the centroid nearest the origin and contributes nothing to
+  ``sums``; the caller subtracts the padding count from that one cluster
+  (:func:`pad_correction`) — an exact fix that saves one HBM read + one
+  (block_n, k) VPU pass over keeping a mask.  (The workset kernel below
+  instead uses the MASKED contract: it needs the pad mask anyway to
+  merge cached assignments, see :func:`kmeans_workset_update`.)
 - **tie_policy="fast"** assigns a point to *every* centroid at exactly the
   minimum distance (``scores <= min``).  For continuous f32 data exact ties
   are measure-zero; the known benign case is duplicated centroids, which
@@ -64,38 +69,56 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils.padding import require_block_rows
+
 __all__ = [
     "kmeans_assign_reduce",
     "kmeans_update_stats",
+    "kmeans_workset_update",
     "update_stats_sharded",
     "pad_correction",
     "pick_block_n",
+    "pick_block_n_workset",
     "supported",
+    "workset_supported",
 ]
 
 _VMEM_BUDGET = 12 * 1024 * 1024  # leave headroom below the ~16 MB/core VMEM
 
 
+def _stats_tile_bytes(d: int, k: int, block_n: int) -> int:
+    """THE per-tile VMEM model of the stats kernels: one (block_n, k) f32
+    score tile + a (block_n, d) points tile + the (k, d)/(k,)
+    accumulators.  One score-sized tile is the right model: Mosaic
+    reuses the buffer across the compare/one-hot chain (empirically
+    block_n=8192, k=256, d=64 compiles and runs on v5e).  Every
+    supported()/pick_block_n variant in this module derives from this
+    ONE formula."""
+    return block_n * k * 4 + block_n * d * 4 + k * d * 4 + k * 4
+
+
 def supported(d: int, k: int, block_n: int = 8192) -> bool:
-    """True if a (block_n, k) f32 score tile + (block_n, d) points tile +
-    (k, d) accumulators fit the VMEM budget.  One score-sized tile is the
-    right model: Mosaic reuses the buffer across the compare/one-hot chain
-    (empirically block_n=8192, k=256, d=64 compiles and runs on v5e)."""
-    tile = block_n * k * 4 + block_n * d * 4 + k * d * 4 + k * 4
-    return tile <= _VMEM_BUDGET
+    """True if the stats-kernel tile (:func:`_stats_tile_bytes`) fits the
+    VMEM budget."""
+    return _stats_tile_bytes(d, k, block_n) <= _VMEM_BUDGET
 
 
-def pick_block_n(n: Optional[int], d: int, k: int) -> Optional[int]:
-    """Largest power-of-two block (<= 8192, >= 128) that fits the VMEM
-    budget, and — when ``n`` is given — divides ``n``.  Pass ``n=None`` when
-    the caller zero-pads to the block anyway (the estimator does).  None if
-    nothing fits (caller falls back to XLA)."""
+def _pick_block(n: Optional[int], fits) -> Optional[int]:
+    """Largest power-of-two block (<= 8192, >= 128) satisfying ``fits``
+    and — when ``n`` is given — dividing ``n``; None if nothing works
+    (caller falls back to XLA)."""
     bn = 8192
     while bn >= 128:
-        if (n is None or n % bn == 0) and supported(d, k, bn):
+        if (n is None or n % bn == 0) and fits(bn):
             return bn
         bn //= 2
     return None
+
+
+def pick_block_n(n: Optional[int], d: int, k: int) -> Optional[int]:
+    """Largest viable stats-kernel block.  Pass ``n=None`` when the
+    caller zero-pads to the block anyway (the estimator does)."""
+    return _pick_block(n, lambda bn: supported(d, k, bn))
 
 
 def _stats_kernel(tie_policy: str, compute_dtype):
@@ -162,10 +185,10 @@ def _assign_kernel(points_ref, cent_ref, c2_ref,
     counts_ref[:] += jnp.sum(onehot, axis=0)
 
 
-def _check_block(n: int, block_n: int) -> None:
-    if n % block_n:
-        raise ValueError(f"n={n} must be a multiple of block_n={block_n} "
-                         "(zero-pad the points)")
+def _check_block(n: int, block_n: int, op: str = "kmeans_pallas") -> None:
+    # the shared registered-kernel invariant (utils/padding.py), not a
+    # module-local rule: every blocked kernel raises the same message
+    require_block_rows(n, block_n, op=op)
 
 
 @functools.partial(jax.jit,
@@ -304,3 +327,141 @@ def update_stats_sharded(points: jnp.ndarray, centroids: jnp.ndarray,
     return shard_map_fn(shard_fn, mesh=mesh,
                         in_specs=(P("data", None), P(None, None)),
                         out_specs=(P(None, None), P(None)))(points, centroids)
+
+
+# ---------------------------------------------------------------------------
+# Fused workset assign+update (PR 10 hot path): one VMEM pass per tile
+# computes the Hamerly scoring (distances, first-index argmin, best and
+# second-best distances), merges with the cached assignment under the
+# active mask, AND accumulates the Lloyd's statistics — the (n, k)
+# distance matrix, the is_min compare tiles, and the (n, k) one-hot all
+# live and die in VMEM instead of round-tripping HBM between the scoring
+# expression and the stats einsum of the XLA workset body
+# (``models/clustering/kmeans.py::kmeans_workset_epoch_step``).
+# ---------------------------------------------------------------------------
+
+def workset_supported(d: int, k: int, block_n: int = 8192) -> bool:
+    """VMEM model of :func:`kmeans_workset_update`: the shared stats-tile
+    footprint (:func:`_stats_tile_bytes`) plus the per-tile
+    assign/bound/mask vectors (~6 lane vectors of block_n f32/i32)."""
+    extra = 6 * block_n * 4
+    return _stats_tile_bytes(d, k, block_n) + extra <= _VMEM_BUDGET
+
+
+def pick_block_n_workset(n: Optional[int], d: int, k: int) -> Optional[int]:
+    """Largest viable workset-kernel block (``n=None`` when the caller
+    pads to the block — the estimator does)."""
+    return _pick_block(n, lambda bn: workset_supported(d, k, bn))
+
+
+def _workset_kernel(k: int):
+    def kern(points_ref, cent_ref, c2_ref, prev_ref, active_ref, padm_ref,
+             assign_ref, dbest_ref, dsec_ref, sums_ref, counts_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            sums_ref[:] = jnp.zeros_like(sums_ref)
+            counts_ref[:] = jnp.zeros_like(counts_ref)
+
+        pts = points_ref[:]
+        # EXPRESSION-identical to EuclideanDistanceMeasure.pairwise (the
+        # XLA workset body's scoring): the bound cache decays in TRUE
+        # distance space, so the kernel must emit root distances, and
+        # matching the expression keeps the per-row results bit-identical
+        # to the XLA body in interpret mode (the parity oracle).
+        p2 = jnp.sum(pts * pts, axis=-1, keepdims=True)          # (bn, 1)
+        cross = jnp.dot(pts, cent_ref[:].T,
+                        preferred_element_type=jnp.float32)      # (bn, k)
+        dists = jnp.sqrt(jnp.maximum(p2 - 2.0 * cross + c2_ref[:], 0.0))
+        mins = jnp.min(dists, axis=1, keepdims=True)
+        is_min = dists <= mins
+        # first-index argmin WITHOUT an argmin loop (the stats-kernel
+        # trick): smallest tied column index via iota + row-min
+        iota = jax.lax.broadcasted_iota(jnp.int32, dists.shape, 1)
+        fresh = jnp.min(jnp.where(is_min, iota, k), axis=1)      # (bn,)
+        d_sec = jnp.min(jnp.where(iota == fresh[:, None],
+                                  jnp.inf, dists), axis=1)
+
+        # merge: active points take the fresh score, settled points keep
+        # their cached assignment (provably identical, see the body doc)
+        on = active_ref[:] > 0
+        assign = jnp.where(on, fresh, prev_ref[:]).astype(jnp.int32)
+        assign_ref[:] = assign
+        dbest_ref[:] = mins[:, 0]
+        dsec_ref[:] = d_sec
+
+        onehot = (iota == assign[:, None]).astype(jnp.float32)
+        onehot = onehot * padm_ref[:][:, None]        # masked contract
+        sums_ref[:] += jnp.dot(onehot.T, pts,
+                               preferred_element_type=jnp.float32)
+        counts_ref[:] += jnp.sum(onehot, axis=0)
+
+    return kern
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def kmeans_workset_update(points: jnp.ndarray, centroids: jnp.ndarray,
+                          prev_assign: jnp.ndarray, active: jnp.ndarray,
+                          pad_mask: jnp.ndarray, *, block_n: int = 2048,
+                          interpret: bool = False):
+    """Fused bound-filtered scoring + stats for one workset Lloyd's round:
+    ``(points (n, d), centroids (k, d), prev_assign (n,) i32,
+    active (n,) f32 0/1, pad_mask (n,) f32 0/1) ->
+    (assign (n,) i32, d_best (n,), d_second (n,), sums (k, d),
+    counts (k,))``.
+
+    ``assign`` is already MERGED (fresh first-index argmin where active,
+    the cached assignment elsewhere); ``d_best``/``d_second`` are the
+    FRESH per-point best/second-best root distances — the caller keeps
+    its old bounds where the point was settled, then applies the drift
+    decay exactly as the XLA body does.  Stats are masked by
+    ``pad_mask`` (the MASKED padding contract,
+    ``utils/padding.py::pad_rows_with_mask(multiple=block_n)``) — no
+    pad-correction step, unlike the maskless BSP stats kernel.
+
+    Parity: per-row outputs are expression-identical to the XLA workset
+    body; ``sums`` accumulate tile-sequentially, so they match the XLA
+    einsum to f32 summation order (allclose, not bitwise — asserted in
+    the cross-backend matrix of ``tests/test_kernels.py``).  Euclidean
+    only (the bounds need root distances)."""
+    n, d = points.shape
+    k = centroids.shape[0]
+    _check_block(n, block_n, op="kmeans_workset_update")
+    c2 = jnp.sum(centroids * centroids, axis=1)[None, :]
+
+    return pl.pallas_call(
+        _workset_kernel(k),
+        grid=(n // block_n,),
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_n,), lambda i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_n,), lambda i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_n,), lambda i: (i,),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n,), lambda i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_n,), lambda i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_n,), lambda i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n,), jnp.int32),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((k, d), jnp.float32),
+            jax.ShapeDtypeStruct((k,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(points, centroids, c2, prev_assign.astype(jnp.int32),
+      active.astype(jnp.float32), pad_mask.astype(jnp.float32))

@@ -15,22 +15,25 @@ The adapter's contract:
   score function for the specialized families; pad rows are inert in
   every row-independent predict, so serving a request returns exactly the
   rows offline ``transform`` would.
-- **Donated inputs.**  The specialized executors donate the padded feature
-  buffer to the jitted score on TPU backends (the per-request transfer
-  buffer is dead after the call — donation lets XLA reuse the HBM
-  allocation instead of holding both).  Donation is skipped on backends
-  that ignore it (CPU) to avoid spurious warnings.
+- **One compiled surface.**  The specialized executors dispatch their
+  model's chain-kernel ``(fn, static)`` plan through the kernel
+  registry's shared plan-static jit (``kernels/registry.py``) — the
+  same executable the fused pipelines and the models' own ``transform``
+  entry points run, so warm-up anywhere is a compile-cache hit
+  everywhere, and the registry's compile/cache-hit gauges account it.
+  On TPU the shared jit donates the padded column dict (the per-request
+  transfer buffer is dead after the call — donation lets XLA reuse the
+  HBM allocation instead of holding both); donation is skipped on
+  backends that ignore it (CPU) to avoid spurious warnings.
 """
 
 from __future__ import annotations
 
 import copy
-import threading
 
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+from typing import Any, Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..data.table import Table
@@ -40,30 +43,18 @@ from ..utils.padding import (
     DEFAULT_MIN_BUCKET,
     bucket_rows,
     bucket_sizes,
-    pad_rows_to_bucket,
 )
 
 __all__ = ["ServableModel", "make_servable"]
 
 
-# One jit per (name) shared by every servable instance — deploys of new
-# model versions hit the same compile cache, so a hot-swap warm-up only
-# pays tracing for shapes the process has never seen.
-_JIT_CACHE: Dict[str, Callable] = {}
-_JIT_LOCK = threading.Lock()
-
-
-def _serving_jit(name: str, fn: Callable, donate_argnums: Tuple[int, ...],
-                 static_argnums: Tuple[int, ...] = ()) -> Callable:
-    with _JIT_LOCK:
-        cached = _JIT_CACHE.get(name)
-        if cached is None:
-            donate = (donate_argnums
-                      if jax.default_backend() == "tpu" else ())
-            cached = jax.jit(fn, donate_argnums=donate,
-                             static_argnums=static_argnums)
-            _JIT_CACHE[name] = cached
-    return cached
+# The per-family serving jits collapsed into the kernel registry's ONE
+# dispatch surface (kernels/registry.py, PR 10): the specialized
+# executors below run their model's chain-kernel (fn, static) plan
+# through the same plan-static jit the fused pipelines and the models'
+# own predict entry points use, so a shape warmed by ANY consumer is a
+# compile-cache hit for serving (and vice versa).  Donation of the
+# per-request transfer buffer on TPU moved into the shared jit.
 
 
 class ServableModel:
@@ -179,95 +170,91 @@ class ServableModel:
 
 # -- specialized executors ---------------------------------------------------
 
-def _linear_margins(X, w, b):
-    from ..models.common.linear import _stable_margins
+class _KernelServable(ServableModel):
+    """Families whose model exposes a chain ``transform_kernel``: serving
+    runs that kernel's ``(fn, static)`` plan through the kernel
+    registry's shared dispatch surface (``api/chain.py::run_kernel``).
 
-    return _stable_margins(X, w, b)
-
-
-class _LinearServable(ServableModel):
-    """Linear family (LogisticRegression / LinearRegression / LinearSVC):
-    dense features score through a donated-input jitted margin; sparse and
-    mixed layouts fall back to the model's own (bucket-routed) transform."""
+    The plan is built once per generation from the EXAMPLE schema and
+    its params are device-put once, so steady-state requests pay one
+    dispatch with zero host->device param traffic — and because the
+    compiled program identity is the same (fn, static) pair the fused
+    pipelines and the model's own ``transform`` dispatch, a bucket
+    warmed by any consumer is a compile-cache hit here (and a serving
+    warm-up pre-compiles the offline paths).  ``rebind`` (the
+    continuous-learning delta-publish fast path) rebuilds only the
+    cached params — same plan, same shapes, zero new lowerings."""
 
     rebind_safe = True
+    op_label: Optional[str] = None
+
+    def __init__(self, model, example: Table, **kwargs: Any):
+        super().__init__(model, example, **kwargs)
+        self._build_kernel()
+
+    def _build_kernel(self) -> None:
+        # transform_kernel's "unported config" signal is returning None
+        # (all three families); a RAISE here is a genuine defect (e.g.
+        # an unfitted model) and must surface at construction, not
+        # silently degrade every request to the generic transform path
+        kernel = self.model.transform_kernel(self.example.schema())
+        self._kernel = kernel
+        self._kernel_params = (jax.device_put(kernel.params)
+                               if kernel is not None else None)
+
+    def rebind(self, model) -> "ServableModel":
+        clone = super().rebind(model)
+        clone._build_kernel()
+        return clone
 
     def _run(self, table: Table) -> Table:
-        from ..models.common.linear import resolve_features
+        from ..api.chain import UnsafeColumnValues, run_kernel
 
-        model = self.model
-        kind, feats = resolve_features(table, model.get_features_col())
-        if kind != "dense":
-            return model.transform(table)[0]
-        model._require_model()
-        w = jnp.asarray(model._state.coefficients, jnp.float32)
-        b = jnp.asarray(model._state.intercept, jnp.float32)
-        (X,), n = pad_rows_to_bucket((feats.astype(np.float32),),
-                                     min_bucket=self.min_bucket)
-        fn = _serving_jit("linear_margins", _linear_margins, (0,))
-        margins = np.asarray(fn(X, w, b), np.float64)[:n]
-        out = table.with_column(model.get_prediction_col(),
-                                model._decision(margins))
-        raw_col = model.get_raw_prediction_col()
-        if raw_col:
-            out = out.with_column(raw_col, model._raw(margins))
+        kernel = self._kernel
+        if kernel is None:
+            return self.model.transform(table)[0]
+        # kernel admissibility was decided on the EXAMPLE schema; a
+        # request re-spelling a consumed column as object dtype (e.g. a
+        # SparseVector features column under the same name) must route
+        # to the model's own transform, exactly like the pre-registry
+        # per-request resolve_features fallback did
+        if any(np.asarray(table[n]).dtype.kind not in "fiub"
+               for n in kernel.consumes):
+            return self.model.transform(table)[0]
+        try:
+            cols = run_kernel(kernel, table, params=self._kernel_params,
+                              min_bucket=self.min_bucket, op=self.op_label)
+        except (UnsafeColumnValues, KeyError):
+            # f32-unsafe int batch, or a request schema the kernel's
+            # columns don't cover — the model's own transform owns those
+            return self.model.transform(table)[0]
+        out = table
+        for name in (n for n in cols if n not in kernel.produces):
+            out = out.with_column(name, cols[name])
         return out
 
 
-def _kmeans_assign(measure, points, centroids):
-    return jnp.argmin(measure.pairwise(points, centroids), axis=1)
+class _LinearServable(_KernelServable):
+    """Linear family (LogisticRegression / LinearRegression / LinearSVC):
+    dense features score through the registry-dispatched margin kernel;
+    sparse and mixed layouts fall back to the model's own (bucket-routed)
+    transform (their ``transform_kernel`` is None)."""
+
+    op_label = "linear_margins"
 
 
-class _KMeansServable(ServableModel):
-    """KMeansModel: donated-input jitted nearest-centroid assign."""
+class _KMeansServable(_KernelServable):
+    """KMeansModel: registry-dispatched nearest-centroid assign."""
 
-    rebind_safe = True
-
-    def _run(self, table: Table) -> Table:
-        from ..distance import DistanceMeasure
-        from ..linalg import stack_vectors
-
-        model = self.model
-        model._require_model()
-        measure = DistanceMeasure.get_instance(model.get_distance_measure())
-        points = stack_vectors(
-            table[model.get_features_col()]).astype(np.float32)
-        (points,), n = pad_rows_to_bucket((points,),
-                                          min_bucket=self.min_bucket)
-        fn = _serving_jit("kmeans_assign", _kmeans_assign,
-                         (1,), static_argnums=(0,))
-        assign = np.asarray(
-            fn(measure, points, jnp.asarray(model._centroids)))[:n]
-        return table.with_column(model.get_prediction_col(),
-                                 assign.astype(np.int64))
+    op_label = "kmeans_assign"
 
 
-def _widedeep_scores(params, dense, cat_ids):
-    from ..models.recommendation.widedeep import forward
+class _WideDeepServable(_KernelServable):
+    """WideDeepModel: registry-dispatched sigmoid(forward) (the id range
+    check runs as the kernel's host ``pre``, the in-kernel offset is an
+    exact int add)."""
 
-    return jax.nn.sigmoid(forward(params, dense, cat_ids))
-
-
-class _WideDeepServable(ServableModel):
-    """WideDeepModel: donated-input jitted sigmoid(forward)."""
-
-    rebind_safe = True
-
-    def _run(self, table: Table) -> Table:
-        from ..models.recommendation.widedeep import _validate_cat_ids
-
-        model = self.model
-        model._require_model()
-        dense = np.asarray(table[model.DENSE_FEATURES_COL], np.float32)
-        cat = np.asarray(table[model.CAT_FEATURES_COL], np.int32)
-        cat = _validate_cat_ids(cat, model._vocab_sizes)
-        (dense, cat), n = pad_rows_to_bucket((dense, cat),
-                                             min_bucket=self.min_bucket)
-        fn = _serving_jit("widedeep_scores", _widedeep_scores, (1, 2))
-        scores = np.asarray(fn(model._params, dense, cat), np.float64)[:n]
-        out = table.with_column(model.get_raw_prediction_col(), scores)
-        return out.with_column(model.get_prediction_col(),
-                               (scores > 0.5).astype(np.int64))
+    op_label = "widedeep_scores"
 
 
 class _PipelineServable(ServableModel):

@@ -105,6 +105,13 @@ class ServingMetrics:
         self._rate_t: Optional[float] = None
         self._rate_value = 0.0
         self._published_count = 0    # nothing recorded -> nothing to publish
+        #: kernel-registry observability (ISSUE 10): the endpoint
+        #: re-exports the process-wide dispatch surface's compile-count /
+        #: cache-hit / dispatch-latency gauges into its own subtree, so
+        #: cross-consumer compile reuse (warm-up vs steady state, CV
+        #: folds, hot-swap generations) is visible per endpoint snapshot
+        self._kernel_group = self.group.add_group("kernels")
+        self._kernel_published = -1
 
     def on_shed(self, queue_depth: int) -> None:
         self.shed.inc()
@@ -202,7 +209,13 @@ class ServingMetrics:
         np.quantile pass for both, and skipped entirely when no new
         samples arrived since the last publish (an idle endpoint's metric
         tick must not pay an O(window) sort under the ring lock every
-        time)."""
+        time).  Kernel-registry gauges refresh on the same cadence
+        (skip-if-unchanged on the dispatch counter)."""
+        from ..kernels.registry import kernel_stats
+
+        if kernel_stats.dispatches != self._kernel_published:
+            kernel_stats.publish(self._kernel_group)
+            self._kernel_published = kernel_stats.dispatches
         count = self.latency.count
         if count == self._published_count:
             return

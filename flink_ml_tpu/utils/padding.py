@@ -10,7 +10,8 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = ["FixedRowBatcher", "pad_rows_with_mask", "bucket_rows",
-           "bucket_sizes", "pad_rows_to_bucket", "DEFAULT_MIN_BUCKET"]
+           "bucket_sizes", "pad_rows_to_bucket", "pad_rows_to_block",
+           "require_block_rows", "DEFAULT_MIN_BUCKET"]
 
 #: Smallest row bucket the shared predict paths pad to.  Every batch size in
 #: [1, 8] compiles the same program, and each further power of two adds one
@@ -132,15 +133,58 @@ def pad_rows_to_bucket(arrays: Sequence[np.ndarray], *,
         for a in arrays), n
 
 
+def require_block_rows(n: int, block: int, *, op: str = "kernel") -> None:
+    """THE registered-kernel block invariant (the kernel registry's shared
+    padding contract, see ``kernels/registry.py``): a blocked device
+    kernel's row count must be an exact multiple of its grid block.
+    Kernels call this instead of respelling the check, so every violation
+    names the same rule and the same fix."""
+    if block <= 0:
+        raise ValueError(f"{op}: block must be positive, got {block}")
+    if n % block:
+        raise ValueError(
+            f"{op}: n={n} must be a multiple of block={block} — pad rows "
+            "with utils.padding.pad_rows_to_block (maskless zero-fill "
+            "contract) or pad_rows_with_mask(multiple=block) (masked "
+            "contract)")
+
+
+def pad_rows_to_block(arrays: Sequence[np.ndarray], block: int,
+                      ) -> Tuple[Tuple[np.ndarray, ...], int]:
+    """The MASKLESS kernel padding contract: zero-pad every array's leading
+    dim up to a multiple of ``block``; returns ``(padded, n_real_rows)``.
+
+    Pad rows are exact zeros BY CONTRACT — a registered maskless kernel
+    (e.g. ``ops/kmeans_pallas.py``'s stats kernels) relies on zero filler
+    having an analytically removable effect (its ``pad_correction``)
+    instead of carrying a mask operand.  Kernels that do take a mask use
+    :func:`pad_rows_with_mask` with ``multiple=block`` instead; either
+    way the divisibility rule is :func:`require_block_rows` — one
+    documented invariant for every registered kernel."""
+    if block <= 0:
+        raise ValueError("block must be positive")
+    n = int(arrays[0].shape[0])
+    pad = (-n) % block
+    if pad == 0:
+        return tuple(np.asarray(a) for a in arrays), n
+    return tuple(
+        np.concatenate(
+            [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+        for a in arrays), n
+
+
 def pad_rows_with_mask(arr, multiple: int,
                        fill: str = "first_row") -> Tuple[np.ndarray, np.ndarray]:
     """Pad rows so ``rows % multiple == 0``; returns ``(padded, mask)`` with
-    a float32 mask of 1 for real rows.
+    a float32 mask of 1 for real rows — the MASKED kernel padding contract
+    (:func:`require_block_rows` documents the divisibility rule both
+    contracts share).
 
     ``fill="first_row"`` repeats row 0 — safe when every consumer weights
     rows by the mask.  ``fill="zero"`` pads exact-zero rows — required by the
     maskless Pallas KMeans path (``ops/kmeans_pallas.py``), whose padding
-    correction assumes zero filler."""
+    correction assumes zero filler (the :func:`pad_rows_to_block`
+    contract)."""
     if multiple <= 0:
         raise ValueError("multiple must be positive")
     if fill not in ("first_row", "zero"):

@@ -6,6 +6,7 @@ from .bench_schema import BenchSchemaPass
 from .collectives import CollectiveConsistencyPass
 from .donation import DonationSafetyPass
 from .host_sync import HostSyncPass
+from .kernel_registry import KernelRegistryPass
 from .locks import LockDisciplinePass
 
 ALL_PASSES = (
@@ -14,9 +15,10 @@ ALL_PASSES = (
     DonationSafetyPass,
     LockDisciplinePass,
     CollectiveConsistencyPass,
+    KernelRegistryPass,
     BenchSchemaPass,
 )
 
 __all__ = ["ALL_PASSES", "AtomicWritesPass", "BenchSchemaPass",
            "CollectiveConsistencyPass", "DonationSafetyPass",
-           "HostSyncPass", "LockDisciplinePass"]
+           "HostSyncPass", "KernelRegistryPass", "LockDisciplinePass"]

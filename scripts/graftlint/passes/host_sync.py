@@ -43,11 +43,15 @@ SCAN_CALLEES = {"scan", "masked_chunk_scan", "while_loop", "fori_loop"}
 #: training dispatch stream the publishes ride on; ``iteration/`` joined
 #: with ISSUE 9: the workset while_loop driver's whole value is zero host
 #: round-trips per round — a ``block_until_ready``/``.item()`` hiding in
-#: its scan/while bodies would re-serialize every epoch)
+#: its scan/while bodies would re-serialize every epoch; ``ops/`` joined
+#: with ISSUE 10: the kernel registry routes every training hot path
+#: through these modules, so a host fetch in a kernel wrapper would
+#: fence EVERY consumer's dispatch stream at once)
 SCAN_ROOTS = (
     "flink_ml_tpu/iteration",
     "flink_ml_tpu/models",
     "flink_ml_tpu/online",
+    "flink_ml_tpu/ops",
     "flink_ml_tpu/parallel",
 )
 

@@ -1,0 +1,84 @@
+"""kernel-registry — models/ must dispatch device kernels through
+``flink_ml_tpu.kernels``, not hand-rolled backend branches.
+
+ISSUE 10 collapsed three kernel notions (chain StageKernels, serving
+executors, ops/ Pallas kernels) into one per-backend registry: a Pallas
+implementation registered once accelerates pipelines, serving, AND
+training, with the XLA lowering as the automatic fallback.  That only
+holds while the model layer actually goes THROUGH the registry — the
+two bypass idioms this pass flags are exactly what PRs 1-9 accumulated
+and PR 10 removed by hand:
+
+- a direct ``pl.pallas_call`` (or ``pallas_call``) in ``models/``: a
+  kernel invoked where only one consumer can see it.  Kernels live in
+  ``ops/`` and register; models look them up.
+- ``use_pallas``-style backend branching: a function parameter, keyword
+  argument, or variable named ``use_pallas`` (the pre-PR 10 sgd.py
+  idiom ``use_pallas=jax.default_backend() == "tpu"``), which silently
+  forks dispatch policy per call site instead of resolving it once in
+  the registry's availability/supports predicates.
+
+Scope-fixed to ``flink_ml_tpu/models`` — ``ops/`` is where pallas_call
+belongs, and the registry itself obviously names backends.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from typing import List
+
+from ..core import ModuleInfo, Project
+from .base import LintPass
+
+#: the flagged branching identifier (the historical idiom, verbatim)
+_BRANCH_NAME = "use_pallas"
+
+
+class KernelRegistryPass(LintPass):
+    id = "kernel-registry"
+    describes = ("models/ must dispatch kernels through the kernel "
+                 "registry (no direct pallas_call, no use_pallas-style "
+                 "backend branching)")
+    roots = ("flink_ml_tpu/models",)
+    scope_fixed = True
+    hint = ("register the implementation in kernels/registry.py (op, "
+            "backend, supports, available) and resolve it with "
+            "lookup(op, sig) — see ARCHITECTURE.md 'Kernel registry'")
+
+    def check_module(self, mod: ModuleInfo,
+                     project: Project) -> List:
+        findings: List = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                qn = mod.call_qualname(node) or ""
+                if qn.endswith("pallas_call"):
+                    findings.append(mod.finding(
+                        self.id, node,
+                        "direct pallas_call bypasses the kernel registry "
+                        "— move the kernel to flink_ml_tpu/ops/ and "
+                        "register it",
+                        hint=self.hint))
+                for kw in node.keywords:
+                    if kw.arg == _BRANCH_NAME:
+                        findings.append(mod.finding(
+                            self.id, kw.value,
+                            f"'{_BRANCH_NAME}=' backend branching at the "
+                            "call site bypasses the kernel registry",
+                            hint=self.hint))
+            elif isinstance(node, ast.arg) and node.arg == _BRANCH_NAME:
+                findings.append(mod.finding(
+                    self.id, node,
+                    f"'{_BRANCH_NAME}' parameter forks backend dispatch "
+                    "per function instead of a registry lookup",
+                    hint=self.hint))
+            elif isinstance(node, ast.Name) and node.id == _BRANCH_NAME \
+                    and isinstance(node.ctx, ast.Store):
+                # the inline form: `use_pallas = default_backend() == ...`
+                # binds the fork without any parameter or keyword
+                findings.append(mod.finding(
+                    self.id, node,
+                    f"'{_BRANCH_NAME}' binding forks backend dispatch "
+                    "inline instead of a registry lookup",
+                    hint=self.hint))
+        return findings

@@ -106,7 +106,7 @@ w_ora = np.asarray(oracle_run(*args_base)[0]["w"])
 legs = []
 for name, prec in [("fused/default", "default"), ("fused/highest", "highest")]:
     cfg_p = SGDConfig(learning_rate=LR, tol=0, ell_precision=prec)
-    upd = _mixed_update_ell(logistic_loss, cfg_p, use_pallas=True)
+    upd = _mixed_update_ell(logistic_loss, cfg_p, backend="pallas")
     w_got = np.asarray(make_loop(upd, with_cat=False)(1)(*args_ell)[0]["w"])
     ok = np.allclose(w_got, w_ora, rtol=1e-3, atol=1e-4)
     err = float(np.max(np.abs(w_got - w_ora)))

@@ -188,7 +188,7 @@ class TestMixedUpdateEll:
             want, want_loss = oracle(params, jnp.asarray(dense),
                                      jnp.asarray(cat[0]), jnp.asarray(y),
                                      jnp.asarray(wb))
-            ell = _mixed_update_ell(logistic_loss, cfg, use_pallas=False)
+            ell = _mixed_update_ell(logistic_loss, cfg, backend="xla")
             got, got_loss = ell(params, jnp.asarray(dense),
                                 layout.src[0],
                                 layout.pos[0], layout.mask[0],
@@ -267,7 +267,7 @@ class TestSparseUpdateEll:
                 params, jnp.asarray(idx[0]), jnp.asarray(vals[0]),
                 jnp.asarray(y), jnp.asarray(wb))
             got, got_loss = _sparse_update_ell(
-                logistic_loss, cfg, use_pallas=False)(
+                logistic_loss, cfg, backend="xla")(
                 params,
                 layout.src[0], layout.pos[0], layout.mask[0],
                 layout.val[0], layout.ovf_idx[0], layout.ovf_src[0],
@@ -298,7 +298,7 @@ class TestSparseUpdateEll:
         want, _ = _sparse_update(logistic_loss, cfg)(
             params, jnp.asarray(idx[0]), jnp.asarray(vals[0]),
             jnp.asarray(y), jnp.asarray(wb))
-        got, _ = _sparse_update_ell(logistic_loss, cfg, use_pallas=False)(
+        got, _ = _sparse_update_ell(logistic_loss, cfg, backend="xla")(
             params,
             layout.src[0], layout.pos[0], layout.mask[0], layout.val[0],
             layout.ovf_idx[0], layout.ovf_src[0], layout.ovf_val[0],
@@ -336,7 +336,7 @@ class TestSparseUpdateEll:
         y = rng.integers(0, 2, size=batch).astype(np.float32)
         wb = np.ones(batch, np.float32)
         cfg = SGDConfig(learning_rate=0.4, tol=0)
-        upd = _sparse_update_ell(logistic_loss, cfg, use_pallas=False)
+        upd = _sparse_update_ell(logistic_loss, cfg, backend="xla")
         outs = []
         for L in (host, dev):
             params = {"w": jnp.zeros(d, jnp.float32),
@@ -622,7 +622,7 @@ def test_trim_overflow_preserves_update_exactly():
     dense = rng.normal(size=(batch, 3)).astype(np.float32)
     upd = _mixed_update_ell(logistic_loss,
                             SGDConfig(learning_rate=0.4, tol=0),
-                            use_pallas=False)
+                            backend="xla")
     for builder in ("host", "device"):
         lay = (ell_layout(cat, d, pad_ovf_cap=2048)
                if builder == "host"

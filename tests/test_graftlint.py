@@ -61,7 +61,7 @@ def _check(pass_obj, tmp_path, source, name="mod.py", repo=None):
 # ---------------------------------------------------------------------------
 
 def test_repo_is_clean_under_all_passes():
-    """THE gate: all six passes, suppressions + baseline enforced, no
+    """THE gate: all seven passes, suppressions + baseline enforced, no
     findings — and the accepted exceptions are really being exercised
     (they'd otherwise be unused-suppression / stale-baseline findings)."""
     report = runner.run()
@@ -197,7 +197,7 @@ def test_pass_catalog_covers_the_contract():
     ids = {cls.id for cls in ALL_PASSES}
     assert ids == {"host-sync", "atomic-writes", "donation-safety",
                    "lock-discipline", "collective-consistency",
-                   "bench-schema"}
+                   "kernel-registry", "bench-schema"}
 
 
 # ---------------------------------------------------------------------------
@@ -789,3 +789,72 @@ def test_shims_delegate_and_warn(tmp_path, capsys):
     assert shim.SCAN_ROOTS and callable(shim.check_file) \
         and callable(shim._module_paths)
     assert caw.DURABLE_MODULES and callable(caw.check_file)
+
+
+# ---------------------------------------------------------------------------
+# 2f. kernel-registry (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+def test_kernel_registry_flags_direct_pallas_call(tmp_path):
+    from scripts.graftlint.passes.kernel_registry import KernelRegistryPass
+
+    problems = _check(KernelRegistryPass(), tmp_path, """\
+        from jax.experimental import pallas as pl
+
+        def fast_scores(x):
+            return pl.pallas_call(lambda i, o: None, out_shape=x)(x)
+        """)
+    assert len(problems) == 1
+    assert "pallas_call bypasses the kernel registry" in problems[0].message
+    assert problems[0].symbol == "fast_scores"
+
+
+def test_kernel_registry_flags_use_pallas_branching(tmp_path):
+    """The pre-PR 10 sgd.py idiom, reconstructed from a seeded fixture:
+    a use_pallas parameter AND the call-site keyword both flag."""
+    from scripts.graftlint.passes.kernel_registry import KernelRegistryPass
+
+    problems = _check(KernelRegistryPass(), tmp_path, """\
+        import jax
+
+        def _update(w, use_pallas=True):
+            if use_pallas:
+                return w + 1
+            return w - 1
+
+        def fit(w):
+            return _update(w, use_pallas=jax.default_backend() == "tpu")
+
+        def fit_inline(w):
+            use_pallas = jax.default_backend() == "tpu"
+            return w + 1 if use_pallas else w - 1
+        """)
+    msgs = [p.message for p in problems]
+    assert any("parameter forks backend dispatch" in m for m in msgs)
+    assert any("backend branching at the call site" in m for m in msgs)
+    assert any("binding forks backend dispatch inline" in m for m in msgs)
+    assert len(problems) == 3
+
+
+def test_kernel_registry_accepts_registry_lookup(tmp_path):
+    from scripts.graftlint.passes.kernel_registry import KernelRegistryPass
+
+    problems = _check(KernelRegistryPass(), tmp_path, """\
+        from flink_ml_tpu.kernels.registry import lookup
+
+        def _update(w, backend=None):
+            entry = lookup("ell_margin", sig=(w.shape[0],), backend=backend)
+            return entry.fn(w)
+        """)
+    assert problems == []
+
+
+def test_kernel_registry_scope_is_models_tree():
+    """scope_fixed: pointing graftlint at flink_ml_tpu must not run the
+    models-layer rule over ops/ (where pallas_call lives by design)."""
+    from scripts.graftlint.passes.kernel_registry import KernelRegistryPass
+
+    p = KernelRegistryPass()
+    assert p.scope_fixed and p.roots == ("flink_ml_tpu/models",)
+    project = Project(repo=REPO)
+    assert p.run(project, ["flink_ml_tpu"]) == []

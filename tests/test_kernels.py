@@ -1,0 +1,534 @@
+"""Unified kernel registry (``flink_ml_tpu/kernels/``, ISSUE 10).
+
+What these tests pin down:
+
+- registry mechanics: priority/availability/supports selection, forced
+  backends (bypass availability, never supports), loud failures;
+- dispatch accounting: the compile/cache-hit/latency gauges track the
+  shared jit's cache keying, and serving endpoints re-export them;
+- THE cross-consumer guarantee: one registry entry per (op, schema,
+  backend) backs pipelines, serving, AND training — a serving warm-up
+  leaves ZERO new XLA lowerings for the fused pipeline plan, the
+  model's own transform, and a CV-style re-score on the same (op,
+  schema, bucket), lowering-counter-asserted; the training step
+  builders resolve the very same entries (fn-identity-asserted);
+- the cross-backend parity matrix: every multi-backend op's alternate
+  implementations agree with the XLA lowering (bit-exact where the
+  kernel contract promises it), with a COVERAGE gate so registering a
+  new backend without a parity harness fails this file.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flink_ml_tpu.data.table import Table
+from flink_ml_tpu.kernels import registry as kreg
+from flink_ml_tpu.kernels.registry import (
+    KernelEntry,
+    dispatch,
+    kernel_stats,
+    lookup,
+    register_kernel,
+)
+
+
+# ---------------------------------------------------------------------------
+# registry mechanics
+# ---------------------------------------------------------------------------
+
+def _with_temp_op(entries):
+    """Context: register throwaway entries under a test-only op name and
+    drop them afterwards."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        op = "_test_op_"
+        for e in entries:
+            register_kernel(op, **e)
+        try:
+            yield op
+        finally:
+            kreg._REGISTRY.pop(op, None)
+    return cm()
+
+
+def test_lookup_picks_priority_available_supported():
+    with _with_temp_op([
+        dict(backend="slow", fn=lambda: "slow", priority=0),
+        dict(backend="fast", fn=lambda: "fast", priority=10),
+        dict(backend="faster-elsewhere", fn=lambda: "x", priority=20,
+             available=lambda: False),
+        dict(backend="faster-elsewhen", fn=lambda: "y", priority=30,
+             supports=lambda sig: False),
+    ]) as op:
+        assert lookup(op).backend == "fast"
+        # forced backend bypasses availability...
+        assert lookup(op, backend="faster-elsewhere").backend == \
+            "faster-elsewhere"
+        # ...but a provided sig still gates the shape contract
+        with pytest.raises(ValueError, match="does not support"):
+            lookup(op, sig=("some-shape",), backend="faster-elsewhen")
+        # ...and with no sig the caller owns the choice entirely
+        assert lookup(op, backend="faster-elsewhen").backend == \
+            "faster-elsewhen"
+
+
+def test_lookup_failures_are_loud():
+    with pytest.raises(KeyError, match="unknown kernel op"):
+        lookup("_no_such_op_")
+    with _with_temp_op([
+        dict(backend="narrow", fn=lambda: 0,
+             supports=lambda sig: sig == ("ok",)),
+    ]) as op:
+        with pytest.raises(KeyError, match="no backend"):
+            lookup(op, backend="missing")
+        with pytest.raises(ValueError, match="no available backend"):
+            lookup(op, sig=("nope",))
+        assert lookup(op, sig=("ok",)).backend == "narrow"
+
+
+def test_register_replaces_same_backend():
+    with _with_temp_op([dict(backend="xla", fn=lambda: 1)]) as op:
+        register_kernel(op, "xla", lambda: 2)
+        assert len(kreg._REGISTRY[op]) == 1
+        assert lookup(op, backend="xla").fn() == 2
+
+
+def test_catalog_registers_every_documented_op():
+    ops = kreg.ops()
+    for op in ("ell_margin", "ell_scatter_apply", "gbt_level_histograms",
+               "kmeans_assign", "kmeans_update_stats",
+               "kmeans_workset_update", "linear_margins",
+               "routed_table_grad", "widedeep_scores"):
+        assert op in ops, f"catalog lost op {op}"
+    # every op has the automatic non-TPU fallback registered
+    for op in ops:
+        if op.startswith("_test_"):
+            continue
+        assert any(e.is_available() for e in kreg._REGISTRY[op].values()), \
+            f"op {op} has no available backend on this host"
+
+
+# ---------------------------------------------------------------------------
+# dispatch accounting
+# ---------------------------------------------------------------------------
+
+def _margin_plan(n=16, d=4, seed=0, fcol="f"):
+    from flink_ml_tpu.models.common.linear import _linear_chain_kernel
+
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    plan = ((_linear_chain_kernel, (fcol, "m")),)
+    params = ({"w": rng.normal(size=(d,)).astype(np.float32),
+               "b": np.float32(0.5)},)
+    return plan, params, {fcol: X}
+
+
+def test_dispatch_counts_compiles_and_cache_hits():
+    plan, params, cols = _margin_plan(fcol="_acct_col_a")
+    before = kernel_stats.snapshot()
+    out1 = dispatch(plan, params, cols, op="_acct_op")
+    mid = kernel_stats.snapshot()
+    assert mid["compiles"] == before["compiles"] + 1
+    out2 = dispatch(plan, params, cols, op="_acct_op")
+    after = kernel_stats.snapshot()
+    assert after["compiles"] == mid["compiles"]          # cache hit
+    assert after["cache_hits"] == mid["cache_hits"] + 1
+    assert after["per_op"]["_acct_op"]["dispatches"] >= 2
+    assert after["dispatch_latency_ms"] > 0.0
+    np.testing.assert_array_equal(np.asarray(out1["m"]),
+                                  np.asarray(out2["m"]))
+    # a different shape on the same plan is a NEW compile
+    plan2, params2, cols2 = _margin_plan(n=32, fcol="_acct_col_a")
+    dispatch(plan2, params2, cols2, op="_acct_op")
+    assert kernel_stats.snapshot()["compiles"] == after["compiles"] + 1
+
+
+def test_dispatch_accounting_tracks_lowering_counter():
+    """The gauge's compile/hit split mirrors the REAL jit cache: a fresh
+    (plan, shapes) key lowers once, repeats lower zero times."""
+    from jax._src import test_util as jtu
+
+    plan, params, cols = _margin_plan(fcol="_lower_col_b")
+    with jtu.count_jit_and_pmap_lowerings() as count:
+        dispatch(plan, params, cols)
+    assert count[0] == 1
+    with jtu.count_jit_and_pmap_lowerings() as count:
+        dispatch(plan, params, cols)
+    assert count[0] == 0
+
+
+def test_serving_metrics_republish_kernel_gauges():
+    from flink_ml_tpu.serving.metrics import ServingMetrics
+
+    m = ServingMetrics()
+    plan, params, cols = _margin_plan(fcol="_gauge_col_c")
+    dispatch(plan, params, cols)
+    m.publish()
+    snap = m.snapshot()
+    assert snap["kernels.dispatches"] >= 1
+    assert snap["kernels.compiles"] >= 1
+    assert "kernels.dispatch_latency_ms" in snap
+
+
+# ---------------------------------------------------------------------------
+# THE cross-consumer compile-sharing guarantee
+# ---------------------------------------------------------------------------
+
+def test_one_executable_backs_serving_pipeline_and_transform():
+    """Zero-new-lowerings: after a serving warm-up of the LR margins op,
+    (a) the model's own transform (the training stack's predict entry —
+    what fit-time evaluation and CV fold scoring call), (b) a fused
+    PipelineModel plan, and (c) a hot-swapped same-shape generation all
+    run on the SAME compiled executable per (op, schema, bucket)."""
+    from jax._src import test_util as jtu
+
+    from flink_ml_tpu.api.pipeline import PipelineModel
+    from flink_ml_tpu.models.classification.logisticregression import (
+        LogisticRegression,
+    )
+    from flink_ml_tpu.serving.executor import make_servable
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(48, 6)).astype(np.float64)
+    y = (X[:, 0] > 0).astype(np.float64)
+    train = Table({"features": X, "label": y})
+    model = LogisticRegression().set_max_iter(2).fit(train)
+    feats = Table({"features": X})
+
+    servable = make_servable(model, Table({"features": X[:4]}),
+                             max_batch_rows=64)
+    servable.warm_up()        # buckets 8..64 compile HERE
+
+    with jtu.count_jit_and_pmap_lowerings() as count:
+        # (a) serving steady state
+        served = servable.predict(Table({"features": X[:5]}))
+        # (b) the training stack's own predict entry
+        offline = model.transform(feats)[0]
+        # (c) the fused pipeline plan (singleton terminal segment)
+        pipe = PipelineModel([model])
+        fused = pipe.transform(feats)[0]
+        # (d) a same-shape new generation (CV fold / delta publish)
+        import copy
+
+        gen2 = copy.deepcopy(model)
+        gen2._state.coefficients = gen2._state.coefficients * 1.5
+        servable.rebind(gen2).predict(Table({"features": X[:5]}))
+    assert count[0] == 0, (
+        f"{count[0]} new XLA lowerings after warm-up — pipelines, "
+        "serving, and the predict entry no longer share one executable")
+    np.testing.assert_array_equal(offline["prediction"],
+                                  fused["prediction"])
+    np.testing.assert_array_equal(served["prediction"],
+                                  offline["prediction"][:5])
+
+
+def test_training_builders_resolve_the_same_registry_entries():
+    """The training-side consumers go through the SAME registry entries
+    the parity matrix exercises — fn identity, not a parallel table."""
+    from flink_ml_tpu.models.common import gbt
+    from flink_ml_tpu.ops import ell_scatter, emb_grad
+
+    assert lookup("ell_margin", sig=(16,), backend="xla").fn \
+        is ell_scatter.ell_margin_xla_entry
+    assert lookup("ell_scatter_apply", sig=(16,), backend="xla").fn \
+        is ell_scatter.ell_scatter_apply_xla_entry
+    assert lookup("gbt_level_histograms", backend="xla").fn \
+        is gbt._level_histograms_segsum
+    assert lookup("gbt_level_histograms", backend="mxu").fn \
+        is gbt._level_histograms_mxu
+    assert lookup("routed_table_grad", backend="xla").fn \
+        is emb_grad.routed_apply_xla
+    # off TPU the automatic picks are the XLA lowerings (the fallback
+    # rule), and GBT's "auto" resolves through the same lookup
+    if jax.default_backend() != "tpu":
+        assert lookup("ell_margin", sig=(16,)).backend == "xla"
+        assert gbt.resolve_hist_impl("auto") == "segsum"
+
+
+# ---------------------------------------------------------------------------
+# cross-backend parity matrix
+# ---------------------------------------------------------------------------
+
+def _parity_ell_margin(backends):
+    from flink_ml_tpu.ops.ell_scatter import ell_layout
+
+    rng = np.random.default_rng(3)
+    d, batch, nnz = 128 * 8, 64, 4
+    cat = rng.integers(0, d, size=(1, batch, nnz)).astype(np.int32)
+    lay = ell_layout(cat, d)
+    w = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
+    m_len = 256
+    outs = {}
+    for b in backends:
+        entry = lookup("ell_margin", sig=(int(lay.src.shape[1]),),
+                       backend=b)
+        kw = {} if b == "xla" else {"interpret": True,
+                                    "precision": "highest"}
+        outs[b] = np.asarray(entry.fn(
+            w, lay.src[0], lay.pos[0], lay.mask[0], m_len=m_len, **kw))
+    ref = outs.pop("xla")
+    for b, got in outs.items():
+        np.testing.assert_allclose(got[:batch], ref[:batch], atol=1e-5,
+                                   err_msg=f"ell_margin[{b}] vs xla")
+
+
+def _parity_ell_scatter_apply(backends):
+    from flink_ml_tpu.ops.ell_scatter import ell_layout
+
+    rng = np.random.default_rng(4)
+    d, batch, nnz = 128 * 8, 64, 4
+    cat = rng.integers(0, d, size=(1, batch, nnz)).astype(np.int32)
+    lay = ell_layout(cat, d)
+    w = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
+    r_ext = jnp.asarray(
+        np.concatenate([rng.normal(size=batch),
+                        np.zeros(256 - batch)]).astype(np.float32))
+    outs = {}
+    for b in backends:
+        entry = lookup("ell_scatter_apply", sig=(int(lay.src.shape[1]),),
+                       backend=b)
+        kw = {} if b == "xla" else {"interpret": True,
+                                    "precision": "highest"}
+        outs[b] = np.asarray(entry.fn(
+            w, r_ext, lay.src[0], lay.pos[0], lay.mask[0], lr=0.3, **kw))
+    ref = outs.pop("xla")
+    for b, got in outs.items():
+        np.testing.assert_allclose(got, ref, atol=1e-5,
+                                   err_msg=f"ell_scatter_apply[{b}] vs xla")
+
+
+def _parity_gbt_hist(backends):
+    rng = np.random.default_rng(5)
+    n, d, bins, nodes = 512, 6, 16, 4
+    binned = jnp.asarray(rng.integers(0, bins, size=(n, d)), jnp.int32)
+    ids = jnp.asarray(rng.integers(-1, nodes, size=n), jnp.int32)
+    g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.asarray((rng.random(n) + 0.1).astype(np.float32))
+    outs = {b: lookup("gbt_level_histograms", backend=b).fn(
+        binned, ids, g, h, nodes, d, bins) for b in backends}
+    gr, hr = outs.pop("xla")
+    for b, (gg, hh) in outs.items():
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(gr),
+                                   rtol=1e-4, atol=1e-5, err_msg=b)
+        np.testing.assert_allclose(np.asarray(hh), np.asarray(hr),
+                                   rtol=1e-4, atol=1e-5, err_msg=b)
+
+
+def _parity_kmeans_update_stats(backends):
+    from flink_ml_tpu.distance import DistanceMeasure
+    from flink_ml_tpu.ops.kmeans_pallas import pad_correction
+
+    rng = np.random.default_rng(6)
+    n, d, k = 256, 8, 4
+    pts = rng.normal(size=(n, d)).astype(np.float32)
+    pts[-13:] = 0.0                       # maskless zero-pad contract
+    mask = np.ones(n, np.float32)
+    mask[-13:] = 0.0
+    cents = pts[:k].copy()
+    measure = DistanceMeasure.get_instance("euclidean")
+    outs = {}
+    for b in backends:
+        entry = lookup("kmeans_update_stats", backend=b)
+        if b == "xla":
+            sums, counts = entry.fn(measure, k, jnp.asarray(pts),
+                                    jnp.asarray(mask), jnp.asarray(cents))
+        else:
+            sums, counts = entry.fn(jnp.asarray(pts), jnp.asarray(cents),
+                                    block_n=128, tie_policy="first",
+                                    interpret=True)
+            counts = pad_correction(counts, jnp.asarray(cents), 13,
+                                    tie_policy="first")
+        outs[b] = (np.asarray(sums), np.asarray(counts))
+    sr, cr = outs.pop("xla")
+    for b, (ss, cc) in outs.items():
+        np.testing.assert_allclose(ss, sr, atol=1e-4, err_msg=b)
+        np.testing.assert_allclose(cc, cr, atol=1e-5, err_msg=b)
+
+
+def _parity_kmeans_workset_update(backends):
+    from flink_ml_tpu.distance import DistanceMeasure
+
+    rng = np.random.default_rng(7)
+    n, d, k = 256, 8, 4
+    pts = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    pm = np.ones(n, np.float32)
+    pm[-9:] = 0.0
+    cents = pts[:k]
+    prev = jnp.asarray(rng.integers(0, k, n).astype(np.int32))
+    act = jnp.asarray((rng.random(n) < 0.5).astype(np.float32))
+    measure = DistanceMeasure.get_instance("euclidean")
+    outs = {}
+    for b in backends:
+        entry = lookup("kmeans_workset_update", backend=b)
+        if b == "xla":
+            outs[b] = entry.fn(measure, k, pts, cents, prev, act,
+                               jnp.asarray(pm))
+        else:
+            outs[b] = entry.fn(pts, cents, prev, act, jnp.asarray(pm),
+                               block_n=128, interpret=True)
+    a_r, db_r, ds_r, s_r, c_r = [np.asarray(x) for x in outs.pop("xla")]
+    for b, got in outs.items():
+        a, db, ds, s, c = [np.asarray(x) for x in got]
+        # per-row outputs are expression-identical -> bitwise
+        np.testing.assert_array_equal(a, a_r, err_msg=b)
+        np.testing.assert_array_equal(db, db_r, err_msg=b)
+        np.testing.assert_array_equal(ds, ds_r, err_msg=b)
+        # stats accumulate tile-sequentially -> f32-order equivalent
+        np.testing.assert_allclose(s, s_r, rtol=1e-5, atol=1e-5,
+                                   err_msg=b)
+        np.testing.assert_allclose(c, c_r, atol=1e-5, err_msg=b)
+
+
+def _parity_routed_table_grad(backends):
+    from flink_ml_tpu.ops.emb_grad import emb_grad_route
+
+    rng = np.random.default_rng(8)
+    batch, fields, vocab, E = 64, 4, 40, 3
+    cat = rng.integers(0, vocab, size=(1, batch, fields))
+    cat[0, :40, 0] = 5                    # heavy run -> fold_passes > 0
+    route = emb_grad_route(cat, vocab)
+    g = jnp.asarray(rng.normal(size=(batch * fields, E)).astype(np.float32))
+    outs = {}
+    for b in backends:
+        entry = lookup("routed_table_grad", sig=route.kernel_sig(),
+                       backend=b)
+        kw = {} if b == "xla" else {"interpret": True}
+        outs[b] = np.asarray(entry.fn(route, g, *route.step_slice(0), **kw))
+    ref = outs.pop("xla")
+    for b, got in outs.items():
+        # the fused fold's shift-add tree is element-identical: bitwise
+        np.testing.assert_array_equal(got, ref, err_msg=b)
+
+
+_PARITY = {
+    "ell_margin": _parity_ell_margin,
+    "ell_scatter_apply": _parity_ell_scatter_apply,
+    "gbt_level_histograms": _parity_gbt_hist,
+    "kmeans_update_stats": _parity_kmeans_update_stats,
+    "kmeans_workset_update": _parity_kmeans_workset_update,
+    "routed_table_grad": _parity_routed_table_grad,
+}
+
+
+def test_every_multi_backend_op_has_a_parity_harness():
+    """Coverage gate: registering a second backend for an op WITHOUT
+    adding its parity harness here fails loudly — an unverified kernel
+    must not ship behind the registry's automatic selection."""
+    for op in kreg.ops():
+        if op.startswith("_"):
+            continue
+        if len(kreg.backends(op)) > 1:
+            assert op in _PARITY, (
+                f"op {op} grew a second backend with no parity harness")
+
+
+@pytest.mark.parametrize("op", sorted(_PARITY))
+def test_parity_matrix(op):
+    backends = kreg.backends(op)
+    if len(backends) < 2:
+        pytest.skip(f"{op} has one backend")
+    assert "xla" in backends, f"{op} lost its XLA fallback"
+    _PARITY[op](list(backends))
+
+
+# ---------------------------------------------------------------------------
+# padding contract
+# ---------------------------------------------------------------------------
+
+def test_shared_block_padding_contract():
+    from flink_ml_tpu.utils.padding import (
+        pad_rows_to_block,
+        require_block_rows,
+    )
+
+    arrs, n = pad_rows_to_block((np.ones((10, 3)), np.arange(10)), 8)
+    assert n == 10 and arrs[0].shape[0] == 16 and arrs[1].shape[0] == 16
+    assert np.all(arrs[0][10:] == 0.0) and np.all(arrs[1][10:] == 0)
+    require_block_rows(16, 8, op="t")                  # divisible: fine
+    with pytest.raises(ValueError, match="pad_rows_to_block"):
+        require_block_rows(10, 8, op="t")
+
+
+def test_kmeans_pallas_raises_shared_contract_error():
+    from flink_ml_tpu.ops.kmeans_pallas import kmeans_update_stats
+
+    pts = jnp.ones((100, 4), jnp.float32)
+    cents = jnp.ones((2, 4), jnp.float32)
+    with pytest.raises(ValueError, match="pad_rows_to_block"):
+        kmeans_update_stats(pts, cents, block_n=64, interpret=True)
+
+
+# ---------------------------------------------------------------------------
+# registry-resolved training paths stay value-correct end to end
+# ---------------------------------------------------------------------------
+
+def test_forced_xla_ell_builder_matches_default_on_cpu():
+    """On a non-TPU host the registry's automatic pick IS the XLA
+    lowering, so the default-resolved builder and the forced-"xla"
+    builder must be the same computation."""
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, _mixed_update_ell
+    from flink_ml_tpu.ops.ell_scatter import ell_layout
+
+    if jax.default_backend() == "tpu":
+        pytest.skip("CPU-resolution test")
+    rng = np.random.default_rng(11)
+    d, batch, nnz = 128 * 4, 32, 3
+    cat = rng.integers(0, d, size=(1, batch, nnz)).astype(np.int32)
+    lay = ell_layout(cat, d)
+    dense = rng.normal(size=(batch, 2)).astype(np.float32)
+    y = rng.integers(0, 2, size=batch).astype(np.float32)
+    wb = np.ones(batch, np.float32)
+    cfg = SGDConfig(learning_rate=0.3, tol=0)
+    params = {"w": jnp.zeros(d, jnp.float32), "b": jnp.zeros((), jnp.float32)}
+    args = (jnp.asarray(dense), lay.src[0], lay.pos[0], lay.mask[0],
+            lay.ovf_idx[0], lay.ovf_src[0], lay.heavy_idx[0],
+            lay.heavy_cnt[0], jnp.asarray(y), jnp.asarray(wb))
+    auto, _ = _mixed_update_ell(logistic_loss, cfg)(params, *args)
+    forced, _ = _mixed_update_ell(logistic_loss, cfg, backend="xla")(
+        params, *args)
+    np.testing.assert_array_equal(np.asarray(auto["w"]),
+                                  np.asarray(forced["w"]))
+
+
+def test_workset_fused_body_matches_xla_body_in_interpret():
+    """The fused workset body (what a TPU fit plans) drives the SAME
+    convergence as the XLA body: same rounds, same final centroids to
+    f32 summation order, same exit — interpret mode standing in for the
+    chip."""
+    from flink_ml_tpu.distance import DistanceMeasure
+    from flink_ml_tpu.iteration import IterationConfig, iterate
+    from flink_ml_tpu.models.clustering.kmeans import (
+        FitPlan,
+        kmeans_workset_epoch_step,
+    )
+
+    rng = np.random.default_rng(12)
+    n, d, k = 256, 6, 3
+    pts = rng.normal(size=(n, d)).astype(np.float32)
+    pts[:n // 3] += 4.0
+    pts[n // 3: 2 * n // 3] -= 4.0
+    mask = jnp.ones((n,), jnp.float32)
+    init = jnp.asarray(pts[:k].copy())
+    measure = DistanceMeasure.get_instance("euclidean")
+    plan = FitPlan("xla", None, 1, "first_row", k, d)
+
+    results = {}
+    for name, body in (
+            ("xla", kmeans_workset_epoch_step(measure, k)),
+            ("fused", kmeans_workset_epoch_step(measure, k, block_n=128,
+                                                interpret=True))):
+        results[name] = iterate(
+            body, init, (jnp.asarray(pts), mask), max_epochs=40,
+            workset=plan.init_workset(mask),
+            workset_tol=0.0,
+            config=IterationConfig(mode="fused"))
+    assert results["fused"].num_epochs == results["xla"].num_epochs
+    np.testing.assert_allclose(np.asarray(results["fused"].state),
+                               np.asarray(results["xla"].state),
+                               rtol=1e-5, atol=1e-5)

@@ -112,3 +112,20 @@ def test_checker_covers_iteration_package():
     assert {"core.py", "body.py", "checkpoint.py"} <= names
     for path in visited:
         assert chs.check_file(path) == []
+
+
+def test_checker_covers_ops_package():
+    """ISSUE 10 satellite: the ops/ kernel modules joined the scanned
+    roots — the kernel registry routes every training hot path through
+    them, so a host fetch in a kernel wrapper would fence EVERY
+    consumer's dispatch stream at once.  Assert the root is registered
+    AND that the walk actually visits its modules."""
+    assert "flink_ml_tpu/ops" in chs.SCAN_ROOTS
+    visited = [p for p in chs._module_paths()
+               if os.sep + os.path.join("flink_ml_tpu", "ops") + os.sep
+               in p]
+    names = {os.path.basename(p) for p in visited}
+    assert {"ell_scatter.py", "kmeans_pallas.py", "emb_grad.py",
+            "emb_grad_pallas.py"} <= names
+    for path in visited:
+        assert chs.check_file(path) == []

@@ -298,7 +298,7 @@ def test_prefetch_workers_propagates_transform_error():
 def test_streaming_ell_path_matches_xla(tmp_path, monkeypatch):
     """The out-of-core mixed trainer's ELL streaming path (per-batch
     layouts built in the decode workers) must reproduce the plain XLA
-    path exactly.  CPU forces use_pallas=False, so this exercises the
+    path exactly.  CPU resolves the registry's XLA backend, so this exercises the
     batch assembly + fixed-cap layouts end to end."""
     from flink_ml_tpu.data.datacache import DataCacheReader, DataCacheWriter
     from flink_ml_tpu.models.common import sgd
