@@ -2536,6 +2536,171 @@ def bench_kernels(results: dict) -> None:
     kern["registry"] = snap
 
 
+_COLDSTART_CHILD = '''
+import json, os, time
+import numpy as np
+from jax._src import test_util as jtu
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.classification.logisticregression import (
+    LogisticRegressionModel)
+from flink_ml_tpu.models.clustering.kmeans import KMeansModel
+from flink_ml_tpu.models.common.gbt import GBTConfig, train_forest
+from flink_ml_tpu.serving import ModelRegistry
+from flink_ml_tpu.kernels.registry import kernel_stats
+
+rng = np.random.default_rng(3)
+d = 32
+lr = LogisticRegressionModel()
+lr.set_model_data(Table({"coefficients": rng.normal(size=(1, d)),
+                         "intercept": np.array([0.2])}))
+km = KMeansModel()
+km.set_model_data(Table({
+    "centroids": rng.normal(size=(8, d)).astype(np.float32)[None]}))
+feats = Table({"features": rng.normal(size=(256, d)).astype(np.float32)})
+
+registry = ModelRegistry()
+t0 = time.perf_counter()
+with jtu.count_jit_and_pmap_lowerings() as count:
+    dep_lr = registry.deploy("lr", lr, feats.take(2), max_batch_rows=256)
+    dep_km = registry.deploy("km", km, feats.take(2), max_batch_rows=256)
+warmup_s = time.perf_counter() - t0
+
+X = rng.normal(size=(4096, 8)).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float64)
+def grad_hess(y, pred):
+    p = 1.0 / (1.0 + np.exp(-pred))
+    return (p - y), np.maximum(p * (1.0 - p), 1e-16)
+t0 = time.perf_counter()
+train_forest(X, y, grad_hess, 0.0,
+             GBTConfig(num_trees=2, max_depth=4, max_bins=32))
+gbt_s = time.perf_counter() - t0
+
+snap = kernel_stats.snapshot()
+print(json.dumps({
+    "warmup_s": round(warmup_s, 4),
+    "warmup_lowerings": count[0],
+    "gbt_s": round(gbt_s, 4),
+    "aot": snap["aot"],
+    "reports": {"lr": dep_lr.servable.warmup_report,
+                "km": dep_km.servable.warmup_report},
+}))
+'''
+
+
+def bench_coldstart(results: dict) -> None:
+    """Cold-start leg (coldstart_metric_version 1, ISSUE 12): the AOT
+    executable cache's reason to exist, measured as a cold-vs-warm
+    PROCESS A/B.  Two identical subprocesses deploy the serving op set
+    (LR + KMeans bucketed servables) and pay GBT's training compile leg
+    against one shared cache dir: the first compiles and persists, the
+    second must warm up from deserialized executables — wall ratio is
+    the headline, and the second process's lowering counter is the
+    zero-compile evidence.  Children run on CPU always (the parent owns
+    any TPU, and the acceptance series is the CPU-smoke op set — noted);
+    the autotune sub-leg measures the histogram-backend search cost vs
+    its steady-state win on this host.  Measured fields are null, never
+    faked, when a sub-leg fails."""
+    import subprocess
+    import sys
+    import tempfile
+
+    cold = {
+        "coldstart_metric_version": 1,
+        # pre-nulled headline fields: a failed sub-leg keeps what was
+        # measured, nulls never become fake numbers
+        "cold_warmup_s": None, "warm_warmup_s": None,
+        "coldstart_speedup": None, "warm_zero_lowerings": None,
+        "gbt_compile_cold_s": None, "gbt_compile_warm_s": None,
+        "gbt_compile_speedup": None,
+        "aot_cold": None, "aot_warm": None, "warm_buckets": None,
+        "autotune": {"winner": None, "search_ms": None,
+                     "timings_ms": None, "steady_win_us_per_call": None},
+        "note": ("children pinned to JAX_PLATFORMS=cpu: the parent owns "
+                 "the accelerator, and the acceptance series is the "
+                 "CPU-smoke serving op set (compile cost is host-side "
+                 "either way)"),
+    }
+    results["coldstart_warm_speedup"] = None
+    results["notes"]["coldstart"] = cold
+
+    with tempfile.TemporaryDirectory(prefix="bench_aot_") as tmp:
+        script = os.path.join(tmp, "coldstart_child.py")
+        with open(script, "w") as f:
+            f.write(_COLDSTART_CHILD)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["FLINK_ML_TPU_AOT_CACHE_PATH"] = os.path.join(tmp, "cache")
+        env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__))
+                             + os.pathsep + env.get("PYTHONPATH", ""))
+
+        def run_child():
+            proc = subprocess.run([sys.executable, script], env=env,
+                                  capture_output=True, text=True,
+                                  timeout=420)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"coldstart child failed: {proc.stderr[-400:]}")
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+
+        first = run_child()
+        second = run_child()
+        cold["cold_warmup_s"] = first["warmup_s"]
+        cold["warm_warmup_s"] = second["warmup_s"]
+        cold["coldstart_speedup"] = round(
+            first["warmup_s"] / max(second["warmup_s"], 1e-9), 2)
+        cold["warm_zero_lowerings"] = second["warmup_lowerings"] == 0
+        cold["gbt_compile_cold_s"] = first["gbt_s"]
+        cold["gbt_compile_warm_s"] = second["gbt_s"]
+        cold["gbt_compile_speedup"] = round(
+            first["gbt_s"] / max(second["gbt_s"], 1e-9), 2)
+        cold["aot_cold"] = first["aot"]
+        cold["aot_warm"] = second["aot"]
+        cold["warm_buckets"] = {
+            name: {str(b): rec["source"]
+                   for b, rec in rep["buckets"].items()}
+            for name, rep in second["reports"].items()}
+        results["coldstart_warm_speedup"] = cold["coldstart_speedup"]
+
+    # -- autotune sub-leg: search cost vs steady-state win -------------------
+    # both histogram impls are plain XLA programs, so the search runs
+    # honestly on any backend; what the winner IS depends on the chip
+    # (MXU wins on TPU) — the decision files record the device
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.kernels import autotune
+    from flink_ml_tpu.models.common import gbt as gbt_mod
+
+    rng = np.random.default_rng(47)
+    hn, hd, hbins, hnodes = 1 << 13, 16, 64, 8
+    binned = jnp.asarray(rng.integers(0, hbins, size=(hn, hd)), jnp.int32)
+    ids = jnp.asarray(rng.integers(-1, hnodes, size=hn), jnp.int32)
+    g = jnp.asarray(rng.normal(size=hn).astype(np.float32))
+    h = jnp.asarray((rng.random(hn) + 0.1).astype(np.float32))
+    cands = {
+        "segsum": lambda: gbt_mod._level_histograms_segsum(
+            binned, ids, g, h, hnodes, hd, hbins),
+        "mxu": lambda: gbt_mod._level_histograms_mxu(
+            binned, ids, g, h, hnodes, hd, hbins),
+    }
+    t0 = time.perf_counter()
+    timings = autotune.measure(cands)
+    search_ms = (time.perf_counter() - t0) * 1e3
+    winner = min(timings, key=timings.get)
+    loser = max(timings, key=timings.get)
+    cold["autotune"] = {
+        "winner": winner,
+        "search_ms": round(search_ms, 1),
+        "timings_ms": {k: round(v, 3) for k, v in timings.items()},
+        # what each later call banks by riding the measured choice
+        # instead of the losing candidate — the search amortizes after
+        # search_ms / win_per_call calls, and the persisted decision
+        # makes that a ONE-TIME cost per fleet, not per process
+        "steady_win_us_per_call": round(
+            (timings[loser] - timings[winner]) * 1e3, 2),
+        "probe": f"{hn}x{hd}, {hnodes} nodes, {hbins} bins",
+    }
+
+
 def main() -> None:
     tpu_ok = _probe_tpu_backend()
     if not tpu_ok:
@@ -2574,7 +2739,7 @@ def main() -> None:
                 bench_workset, bench_widedeep, bench_als, bench_gbt,
                 bench_online_ftrl, bench_serving, bench_pipeline,
                 bench_comm, bench_wal, bench_recovery, bench_online,
-                bench_kernels):
+                bench_kernels, bench_coldstart):
         try:
             leg(results)
         except Exception as exc:   # noqa: BLE001

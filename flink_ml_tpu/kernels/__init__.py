@@ -1,3 +1,4 @@
+from . import aot, autotune  # noqa: F401
 from .registry import (  # noqa: F401
     KernelEntry,
     KernelStats,

@@ -205,6 +205,18 @@ def lookup(op: str, sig: tuple = (), *,
         raise ValueError(
             f"no available backend of op {op!r} supports signature "
             f"{sig!r} (registered: {tuple(sorted(table))})")
+    if len(cands) > 1:
+        # a persisted autotune decision beats static priority: the
+        # measured-best backend for this (op, sig) on THIS device kind,
+        # recorded once by whichever process searched first (no-op —
+        # None — when no cache root is configured or nothing is recorded)
+        from . import autotune
+
+        tuned = autotune.decided_backend(op, sig)
+        if tuned is not None:
+            for e in cands:
+                if e.backend == tuned:
+                    return e
     # deterministic: priority desc, backend name as the tiebreak
     cands.sort(key=lambda e: (-e.priority, e.backend))
     return cands[0]
@@ -234,6 +246,97 @@ class KernelStats:
         self._lat_ema_ms = 0.0
         self._last_ms = 0.0
         self.per_op: Dict[str, Dict[str, int]] = {}
+        #: cache-source accounting (ISSUE 12): where executables came
+        #: from — persistent-cache loads vs live compiles — plus the
+        #: failure ledger (quarantines never crash, so they MUST count)
+        self.aot_hits = 0
+        self.aot_misses = 0
+        self.aot_stores = 0
+        self.aot_store_failed = 0
+        self.aot_quarantined = 0
+        self.aot_unserializable = 0
+        self._aot_load_ms = 0.0
+        self._compile_ms = 0.0
+        #: autotune decisions observed this process: "op|sig" -> the
+        #: chosen backend/block, decision source, and search cost
+        self.tuned_ops: Dict[str, Dict[str, Any]] = {}
+        #: per-THREAD mirrors of (compiles, aot_hits, cache_hits) — the
+        #: warm-up source attribution diffs these, so a hot-swap warming
+        #: on the deploy thread is never mislabeled by the old
+        #: generation's concurrent serving dispatches
+        self._tls = threading.local()
+
+    def _tls_bump(self, field: str) -> None:
+        counts = getattr(self._tls, "counts", None)
+        if counts is None:
+            counts = self._tls.counts = {"compiles": 0, "aot_hits": 0,
+                                         "cache_hits": 0}
+        counts[field] += 1
+
+    def thread_counts(self) -> Tuple[int, int, int]:
+        """(compiles, aot_hits, cache_hits) recorded by THIS thread —
+        the race-free warm-up probe (see :meth:`counts` for the
+        process-wide view)."""
+        counts = getattr(self._tls, "counts", None)
+        if counts is None:
+            return (0, 0, 0)
+        return (counts["compiles"], counts["aot_hits"],
+                counts["cache_hits"])
+
+    def record_aot(self, op: str, *, event: str,
+                   seconds: float = 0.0) -> None:
+        """One persistent-cache event: ``hit`` (deserialized from disk,
+        ``seconds`` = load wall), ``miss`` (live compile, ``seconds`` =
+        compile wall), ``store``, ``quarantine`` (corrupt/skewed entry
+        moved aside), ``unserializable`` (backend refused serialize)."""
+        ms = seconds * 1e3
+        with self._lock:
+            if event == "hit":
+                self.aot_hits += 1
+                self._aot_load_ms += ms
+            elif event == "miss":
+                self.aot_misses += 1
+                self._compile_ms += ms
+            elif event == "store":
+                self.aot_stores += 1
+            elif event == "store_failed":
+                self.aot_store_failed += 1
+            elif event == "quarantine":
+                self.aot_quarantined += 1
+            elif event == "unserializable":
+                self.aot_unserializable += 1
+            else:
+                raise ValueError(f"unknown AOT event {event!r}")
+            if event == "hit":
+                self._tls_bump("aot_hits")
+            if event in ("hit", "miss"):
+                rec = self.per_op.setdefault(
+                    op, {"dispatches": 0, "compiles": 0, "cache_hits": 0})
+                rec["aot_hits"] = rec.get("aot_hits", 0) \
+                    + (1 if event == "hit" else 0)
+                rec["aot_misses"] = rec.get("aot_misses", 0) \
+                    + (1 if event == "miss" else 0)
+                which = "aot_load_ms" if event == "hit" else "compile_ms"
+                rec[which] = round(rec.get(which, 0.0) + ms, 3)
+
+    def record_autotune(self, op: str, sig: tuple, choice: str, *,
+                        kind: str, source: str, search_ms: float,
+                        timings: Dict[str, float]) -> None:
+        """One autotune resolution: ``source`` "measured" = a fresh
+        search ran (and persisted, cache permitting); "cache" = a
+        recorded winner was honored with zero search cost."""
+        with self._lock:
+            self.tuned_ops[f"{op}|{sig!r}"] = {
+                "choice": choice, "kind": kind, "source": source,
+                "search_ms": round(search_ms, 2), "timings_ms": timings,
+            }
+
+    def counts(self) -> Tuple[int, int, int]:
+        """(compiles, aot_hits, cache_hits), process-wide.  The serving
+        executors' warm-up attribution diffs :meth:`thread_counts`
+        instead — this view races with concurrent serving threads."""
+        with self._lock:
+            return (self.compiles, self.aot_hits, self.cache_hits)
 
     def record(self, op: str, *, compiled: bool, seconds: float) -> None:
         ms = seconds * 1e3
@@ -241,8 +344,10 @@ class KernelStats:
             self.dispatches += 1
             if compiled:
                 self.compiles += 1
+                self._tls_bump("compiles")
             else:
                 self.cache_hits += 1
+                self._tls_bump("cache_hits")
             self._last_ms = ms
             self._lat_ema_ms = (0.8 * self._lat_ema_ms + 0.2 * ms
                                 if self._lat_ema_ms else ms)
@@ -263,17 +368,37 @@ class KernelStats:
                 "dispatches": self.dispatches,
                 "dispatch_latency_ms": round(self._lat_ema_ms, 4),
                 "last_dispatch_ms": round(self._last_ms, 4),
+                "aot": {
+                    "hits": self.aot_hits,
+                    "misses": self.aot_misses,
+                    "stores": self.aot_stores,
+                    "store_failed": self.aot_store_failed,
+                    "quarantined": self.aot_quarantined,
+                    "unserializable": self.aot_unserializable,
+                    "load_ms": round(self._aot_load_ms, 3),
+                    "compile_ms": round(self._compile_ms, 3),
+                },
+                "tuned_ops": {k: dict(v)
+                              for k, v in self.tuned_ops.items()},
                 "per_op": {k: dict(v) for k, v in self.per_op.items()},
             }
 
     def publish(self, group) -> None:
         """Refresh gauges on ``group`` (the ``PrefetchStats.publish``
         idiom): serving endpoints re-export the registry's counters into
-        their own metric subtree, ``bench.py`` into its report."""
+        their own metric subtree, ``bench.py`` into its report.  The
+        cache-source gauges make cold-start composition a measured
+        number: ``aot_load_ms`` vs ``compile_ms`` is literally 'what the
+        persistent cache saved this process'."""
         snap = self.snapshot()
         for name in ("compiles", "cache_hits", "dispatches",
                      "dispatch_latency_ms", "last_dispatch_ms"):
             group.gauge(name).set(snap[name])
+        for name in ("hits", "misses", "stores", "store_failed",
+                     "quarantined", "unserializable", "load_ms",
+                     "compile_ms"):
+            group.gauge(f"aot_{name}").set(snap["aot"][name])
+        group.gauge("tuned_ops").set(len(snap["tuned_ops"]))
         group.gauge("ops_seen").set(len(snap["per_op"]))
 
 
@@ -346,20 +471,71 @@ def _shape_key(params_seq, cols) -> tuple:
                   for leaf in leaves))
 
 
+_PLAN_KEY_MEMO: Dict[Any, str] = {}
+
+
+def _persistent_plan_key(cache, plan: tuple, shape_key: tuple) -> str:
+    """The durable form of the in-memory dispatch key: plan identity by
+    qualified names + bytecode fingerprints (``aot.plan_token``) instead
+    of object identity, shapes by their existing repr, the environment
+    fingerprint folded in by the cache."""
+    memo = (plan, shape_key)
+    with _JIT_LOCK:
+        key = _PLAN_KEY_MEMO.get(memo)
+    if key is None:
+        from .aot import plan_token
+
+        treedef, shapes = shape_key
+        key = cache.key_for("plan", plan_token(plan),
+                            repr((str(treedef), shapes)))
+        with _JIT_LOCK:
+            _PLAN_KEY_MEMO[memo] = key
+    return key
+
+
 def dispatch(plan: tuple, params_seq: tuple, cols: Dict[str, Any], *,
              op: Optional[str] = None) -> Dict[str, Any]:
     """Run ``plan`` over ``cols`` through THE shared jit, with compile /
     cache-hit / latency accounting.  ``op`` labels the per-op counters
-    (defaults to the stage fns' names)."""
+    (defaults to the stage fns' names).
+
+    With a persistent AOT cache configured (``kernels/aot.py``), the
+    compiled program for each (plan, shapes) key is held as an explicit
+    ``jax.stages.Compiled`` — loaded from the cache dir when a previous
+    process already compiled it (cold-start becomes a deserialize),
+    compiled-and-stored otherwise.  Either way the executable is the
+    SAME lowered program the shared jit would run, so outputs are
+    bit-identical across the two paths (asserted in
+    ``tests/test_aot_cache.py``)."""
     label = op or "+".join(fn.__name__ for fn, _ in plan)
     key = (plan, _shape_key(params_seq, cols))
     with _JIT_LOCK:
-        compiled = key not in _SEEN_KEYS
+        seen = key in _SEEN_KEYS
         _SEEN_KEYS.add(key)
         _DISPATCHES[0] += 1
+    from .aot import active_cache
+
+    cache = active_cache()
+    if cache is None:
+        t0 = time.perf_counter()
+        out = _plan_jit()(plan, params_seq, _ONE, cols)
+        kernel_stats.record(label, compiled=not seen,
+                            seconds=time.perf_counter() - t0)
+        return out
+    pkey = _persistent_plan_key(cache, plan, key[1])
+    compiled, source = cache.load_or_build(
+        pkey,
+        lambda: _plan_jit().lower(plan, params_seq, _ONE, cols).compile(),
+        label=label)
     t0 = time.perf_counter()
-    out = _plan_jit()(plan, params_seq, _ONE, cols)
-    kernel_stats.record(label, compiled=compiled,
+    try:
+        out = compiled(params_seq, _ONE, cols)
+    except TypeError:
+        # an operand aspect the shape key cannot see (weak types)
+        # diverged from the lowering — correctness comes first: run the
+        # plain jit path for this call, keep the entry for callers it fits
+        out = _plan_jit()(plan, params_seq, _ONE, cols)
+    kernel_stats.record(label, compiled=(source == "compile"),
                         seconds=time.perf_counter() - t0)
     return out
 

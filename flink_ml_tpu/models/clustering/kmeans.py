@@ -494,7 +494,10 @@ def _plan_fit_impl(n: int, d: int, k: int, measure: DistanceMeasure,
 
     entry = lookup("kmeans_update_stats", sig=(n, d, k, measure.name))
     if entry.backend == "pallas":
-        return "pallas", kp.pick_block_n(None, d, k)
+        # measured-not-analytic when the autotune cache is configured
+        # (ISSUE 12): the winner is persisted per (d, k, device kind),
+        # so only the fleet's first process pays the search
+        return "pallas", kp.pick_block_n_measured(d, k)
     return "xla", None
 
 
@@ -551,7 +554,7 @@ def _fit_plan(n: int, d: int, k: int, measure: DistanceMeasure, mesh, *,
         entry = lookup("kmeans_workset_update",
                        sig=(n, d, k, measure.name, data_devs))
         if entry.backend == "pallas":
-            block_n = kp.pick_block_n_workset(None, d, k)
+            block_n = kp.pick_block_n_workset_measured(d, k)
             return FitPlan("pallas_ws", block_n, block_n, "first_row", k, d)
         return FitPlan("xla", None, 1, "first_row", k, d)
     impl, block_n = _plan_fit_impl(n, d, k, measure, mesh)

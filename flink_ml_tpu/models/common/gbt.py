@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...kernels.aot import aot_jit
+
 __all__ = ["GBTConfig", "bin_features", "train_forest", "predict_forest",
            "Forest", "SoftmaxForest", "train_forest_softmax",
            "predict_forest_softmax"]
@@ -264,7 +266,7 @@ def _apply_split(binned, node_ids, best_feature, best_bin, best_gain):
                      2 * safe_node + goes_right.astype(jnp.int32), -1)
 
 
-@partial(jax.jit, static_argnames=("n_nodes", "d", "bins", "reg_lambda",
+@partial(aot_jit, static_argnames=("n_nodes", "d", "bins", "reg_lambda",
                                    "min_child_weight", "hist_impl"))
 def _build_level(binned, node_ids, grad, hess, n_nodes: int,
                  d: int, bins: int, reg_lambda: float,
@@ -287,7 +289,7 @@ def _build_level(binned, node_ids, grad, hess, n_nodes: int,
     return best_feature, best_bin, best_gain, new_ids
 
 
-@partial(jax.jit, static_argnames=("n_nodes", "reg_lambda"))
+@partial(aot_jit, static_argnames=("n_nodes", "reg_lambda"))
 def _leaf_values(node_ids, grad, hess, n_nodes: int, reg_lambda: float):
     """Newton leaf weights -G/(H+lambda) for every level-local node."""
     live = node_ids >= 0
@@ -317,10 +319,13 @@ def _train_one_tree(binned, g, h, d: int, config: GBTConfig):
     level_ids = [node_ids]
     for level in range(depth):
         n_nodes = 2 ** level
+        # hist impl resolved to a CONCRETE name before it becomes a
+        # static arg: "auto" would be ambiguous in the persistent AOT
+        # key (the registry/autotune pick can differ across processes)
         f, b, gain, node_ids = _build_level(
             binned, node_ids, g, h, n_nodes, d, bins,
             config.reg_lambda, config.min_child_weight,
-            hist_impl=HIST_IMPL)
+            hist_impl=resolve_hist_impl())
         level_feature.append(np.asarray(f))
         level_bin.append(np.asarray(b))
         level_gain.append(np.asarray(gain))
@@ -356,6 +361,39 @@ def _train_one_tree(binned, g, h, d: int, config: GBTConfig):
     return feature_row, threshold_row, value_row, pred
 
 
+def _maybe_autotune_hist(binned, g, h, d: int, bins: int) -> None:
+    """First-encounter autotune of the histogram backend (ISSUE 12):
+    when several registry backends are AVAILABLE on this device (TPU has
+    mxu + xla; CPU has one, so nothing to search) and a persistent cache
+    root is configured, time both on a probe slice of the REAL binned
+    data and record the winner — ``resolve_hist_impl("auto")`` then
+    resolves through ``registry.lookup``, which honors the decision in
+    this and every later process.  A recorded decision short-circuits
+    (zero search cost)."""
+    from ...kernels import autotune
+    from ...kernels.registry import backends, lookup
+
+    if HIST_IMPL != "auto" or not autotune.enabled():
+        return
+    avail = [b for b in backends("gbt_level_histograms")
+             if lookup("gbt_level_histograms", backend=b).is_available()]
+    if len(avail) < 2:
+        return
+    rows = min(int(binned.shape[0]), 8192)
+    bp, gp, hp = binned[:rows], g[:rows], h[:rows]
+    ids = jnp.zeros((rows,), jnp.int32)
+    impl_of = {"xla": "segsum"}
+
+    def runner(backend):
+        impl = _HIST_IMPLS[impl_of.get(backend, backend)]
+        return lambda: impl(bp, ids, gp, hp, 4, d, bins)
+
+    autotune.choose("gbt_level_histograms", (),
+                    {b: runner(b) for b in avail},
+                    probe=f"real-data slice rows={rows} d={d} bins={bins} "
+                          "n_nodes=4")
+
+
 def train_forest(X: np.ndarray, y: np.ndarray,
                  grad_hess: Callable[[np.ndarray, np.ndarray],
                                      Tuple[np.ndarray, np.ndarray]],
@@ -373,9 +411,12 @@ def train_forest(X: np.ndarray, y: np.ndarray,
     pred = np.full((n,), base_score, np.float64)
     for t in range(config.num_trees):
         g, h = grad_hess(y, pred)
+        gd = jnp.asarray(g, jnp.float32)
+        hd = jnp.asarray(h, jnp.float32)
+        if t == 0:
+            _maybe_autotune_hist(binned, gd, hd, d, config.max_bins)
         features[t], thresholds[t], values[t], tree_pred = _train_one_tree(
-            binned, jnp.asarray(g, jnp.float32), jnp.asarray(h, jnp.float32),
-            d, config)
+            binned, gd, hd, d, config)
         pred = pred + config.learning_rate * np.asarray(tree_pred, np.float64)
 
     return Forest(features, thresholds, values, edges, base_score,
@@ -809,7 +850,7 @@ def _predict_tree(binned: np.ndarray, feature: np.ndarray,
         jnp.asarray(value), depth))
 
 
-@partial(jax.jit, static_argnames=("depth",))
+@partial(aot_jit, static_argnames=("depth",))
 def _predict_tree_jit(binned, feature, threshold, value, depth: int):
     n = binned.shape[0]
     node = jnp.zeros((n,), jnp.int32)       # global complete-tree index

@@ -66,6 +66,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -78,7 +79,9 @@ __all__ = [
     "update_stats_sharded",
     "pad_correction",
     "pick_block_n",
+    "pick_block_n_measured",
     "pick_block_n_workset",
+    "pick_block_n_workset_measured",
     "supported",
     "workset_supported",
 ]
@@ -119,6 +122,106 @@ def pick_block_n(n: Optional[int], d: int, k: int) -> Optional[int]:
     """Largest viable stats-kernel block.  Pass ``n=None`` when the
     caller zero-pads to the block anyway (the estimator does)."""
     return _pick_block(n, lambda bn: supported(d, k, bn))
+
+
+def _viable_blocks(fits) -> list:
+    """Every power-of-two block (8192 down to 128) passing ``fits`` —
+    the candidate set the measured search ranks (the analytic descent
+    only ever took the largest)."""
+    return [bn for bn in (8192, 4096, 2048, 1024, 512, 256, 128)
+            if fits(bn)]
+
+
+def _measured_block(op: str, d: int, k: int, candidates: list,
+                    runner_factory, *, analytic: int) -> int:
+    """Resolve a block size by measurement through the registry
+    autotuner (``kernels/autotune.py``): ``choose`` honors a recorded
+    decision for ``(op, ("block_n", d, k))`` without running anything;
+    a first encounter times every candidate on a synthetic probe of the
+    kernel's real entry point and persists the winner.  With autotuning
+    disabled (no cache root) the analytic pick stands — exactly the
+    pre-autotune behavior."""
+    from ..kernels import autotune
+
+    if len(candidates) == 1 or not autotune.enabled():
+        return analytic
+    choice, _ = autotune.choose(
+        op, ("block_n", d, k),
+        {str(bn): runner_factory(bn) for bn in candidates},
+        kind="block", probe=f"synthetic n={max(candidates)} d={d} k={k}")
+    return int(choice)
+
+
+def _probe_operands(n: int, d: int, k: int):
+    # centroids drawn SEPARATELY from the points: the probe's k must be
+    # the real fit's k even when k exceeds the largest candidate block,
+    # or the persisted winner would be measured on the wrong problem
+    rng = np.random.default_rng(1234)
+    points = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    cents = jnp.asarray(rng.normal(size=(k, d)).astype(np.float32))
+    return points, cents
+
+
+def pick_block_n_measured(d: int, k: int, *, interpret: bool = False,
+                          candidates: Optional[list] = None
+                          ) -> Optional[int]:
+    """The measured form of :func:`pick_block_n` (ISSUE 12): instead of
+    trusting the VMEM model to rank blocks, time the stats kernel at
+    every viable block size once per (d, k, device kind) and persist the
+    winner in the autotune cache — every later process reuses the
+    decision without re-searching.  Falls back to the analytic pick when
+    autotuning is disabled; returns None exactly when the analytic
+    descent would (no viable block -> XLA fallback)."""
+    cands = (candidates if candidates is not None
+             else _viable_blocks(lambda bn: supported(d, k, bn)))
+    if not cands:
+        return None
+    # probe operands are lazy AND shared across candidates: a recorded
+    # decision allocates nothing, a fresh search allocates one set
+    probe: list = []
+
+    def runner(bn):
+        def thunk():
+            if not probe:
+                probe.append(_probe_operands(max(cands), d, k))
+            points, cents = probe[0]
+            return kmeans_update_stats(points, cents, block_n=bn,
+                                       interpret=interpret)
+        return thunk
+
+    return _measured_block("kmeans_update_stats", d, k, cands, runner,
+                           analytic=max(cands))
+
+
+def pick_block_n_workset_measured(d: int, k: int, *,
+                                  interpret: bool = False,
+                                  candidates: Optional[list] = None
+                                  ) -> Optional[int]:
+    """Measured twin of :func:`pick_block_n_workset` for the fused
+    workset kernel (same decision protocol, its own op key — the two
+    kernels have different VPU/VMEM profiles, so one winner must never
+    be assumed to transfer to the other)."""
+    cands = (candidates if candidates is not None
+             else _viable_blocks(lambda bn: workset_supported(d, k, bn)))
+    if not cands:
+        return None
+    probe: list = []
+
+    def runner(bn):
+        def thunk():
+            if not probe:
+                n = max(cands)
+                points, cents = _probe_operands(n, d, k)
+                probe.append((points, cents, jnp.zeros((n,), jnp.int32),
+                              jnp.ones((n,), jnp.float32)))
+            points, cents, prev, ones = probe[0]
+            return kmeans_workset_update(points, cents, prev, ones,
+                                         ones, block_n=bn,
+                                         interpret=interpret)
+        return thunk
+
+    return _measured_block("kmeans_workset_update", d, k, cands, runner,
+                           analytic=max(cands))
 
 
 def _stats_kernel(tie_policy: str, compute_dtype):

@@ -109,6 +109,18 @@ class ServingEndpoint:
         except KeyError:
             return False
 
+    @property
+    def warmup_report(self) -> Optional[dict]:
+        """The live servable's readiness accounting (ISSUE 12): wall
+        time to ready plus per-bucket compile-vs-aot-vs-cache source —
+        None before the first deploy (or for custom servables that skip
+        the standard warm-up)."""
+        try:
+            servable = self._registry.current(self._name).servable
+        except KeyError:
+            return None
+        return getattr(servable, "warmup_report", None)
+
     def close(self, timeout: float = 10.0) -> None:
         """Stop admitting, drain queued requests, join the serve loop."""
         self._batcher.close()

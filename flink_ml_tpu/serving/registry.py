@@ -124,6 +124,15 @@ class ModelRegistry:
                     }
             servable = self._factory(model, example, **servable_kwargs)
             servable.warm_up()   # off the serving path: old version live
+            rep = getattr(servable, "warmup_report", None)
+            if rep:
+                # the cold-start one-liner (ISSUE 12): how long readiness
+                # took and how much of it the persistent AOT cache saved
+                log.info(
+                    "warm-up of %r: %d buckets in %.3fs (%d compiled, "
+                    "%d aot-loaded, %d cache-hit)", name,
+                    len(rep["buckets"]), rep["wall_s"], rep["compiled"],
+                    rep["aot_loaded"], rep["cache_hits"])
         except Exception as exc:  # noqa: BLE001 — rollback decision below
             with self._lock:
                 incumbent = self._live.get(name)

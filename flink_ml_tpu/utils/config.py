@@ -31,6 +31,10 @@ class FrameworkConfig:
     compute_dtype: str = "float32"
     # INFO-log period for iteration metrics listeners (0 = silent)
     log_every_epochs: int = 0
+    # Root of the persistent AOT executable / autotune-decision cache
+    # (kernels/aot.py).  None (default) disables it: dispatch compiles
+    # in-process exactly as before.  Env: FLINK_ML_TPU_AOT_CACHE_PATH.
+    aot_cache_path: Optional[str] = None
 
     @staticmethod
     def from_env(base: Optional["FrameworkConfig"] = None) -> "FrameworkConfig":

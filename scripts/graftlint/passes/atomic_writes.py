@@ -21,13 +21,19 @@ from ..core import ModuleInfo, Project
 from .base import LintPass
 
 #: the durable layer: every open-for-write here must be atomic
-#: (``robustness/durability.py`` joined this PR — the manifest/marker
-#: commit protocol lives there and must obey its own rule)
+#: (``robustness/durability.py`` joined in PR 8 — the manifest/marker
+#: commit protocol lives there and must obey its own rule;
+#: ``kernels/aot.py`` + ``kernels/autotune.py`` joined in ISSUE 12 —
+#: the persistent executable/decision cache writes through the same
+#: commit protocol and must be tmp -> os.replace like everything else
+#: a loader trusts)
 DURABLE_MODULES = (
     "flink_ml_tpu/utils/persist.py",
     "flink_ml_tpu/iteration/checkpoint.py",
     "flink_ml_tpu/data/wal.py",
     "flink_ml_tpu/robustness/durability.py",
+    "flink_ml_tpu/kernels/aot.py",
+    "flink_ml_tpu/kernels/autotune.py",
 )
 
 _WRITE_MODES = {"w", "wb", "w+", "wb+", "a", "ab"}

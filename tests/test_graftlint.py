@@ -567,6 +567,37 @@ def test_atomic_writes_pass_flags_naked_write(tmp_path):
     assert len(problems) == 1 and "half-written" in problems[0].message
 
 
+def test_atomic_writes_pass_visits_aot_cache_modules():
+    """The persistent executable/decision cache (ISSUE 12) joined the
+    durable roots: the pass must actually VISIT both modules (parse
+    them, see their open-for-write sites) and find every write riding
+    the tmp-dir -> commit -> os.replace protocol — no suppressions, no
+    blind spots."""
+    import ast
+
+    for rel in ("flink_ml_tpu/kernels/aot.py",
+                "flink_ml_tpu/kernels/autotune.py"):
+        assert rel in AtomicWritesPass.roots
+    project = Project(repo=REPO)
+    writes_seen = 0
+    for rel in ("flink_ml_tpu/kernels/aot.py",
+                "flink_ml_tpu/kernels/autotune.py"):
+        mod = project.module(os.path.join(REPO, *rel.split("/")))
+        problems = AtomicWritesPass().check_module(mod, project)
+        assert problems == [], (
+            f"{rel}: cache writes must use the commit protocol "
+            f"(tmp -> os.replace): {[f.message for f in problems]}")
+        # visits-the-module proof: the pass's subject matter — actual
+        # open-for-write call sites — exists in the module it cleared
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and mod.call_qualname(node) == "open":
+                writes_seen += 1
+    assert writes_seen >= 5, (
+        "the AOT cache modules lost their write sites — the durable-root "
+        "listing is guarding nothing")
+
+
 def test_atomic_writes_pass_guards_durability_module():
     """robustness/durability.py joined the durable roots this PR; its
     two protocol-level exceptions are inline-suppressed, so the raw pass
