@@ -205,16 +205,19 @@ def bench_logreg(results: dict) -> None:
                 "b": jnp.zeros((), jnp.float32)}
 
     def measure(run_epochs, data_args):
+        from flink_ml_tpu.utils.profiler import fenced_call
+
         params, losses = run_epochs(fresh_params(), 0.0, *data_args)
         loss_host = np.asarray(losses)     # fence = device_get
         assert np.all(np.isfinite(loss_host))
         assert loss_host[-1] < loss_host[0], "LR bench did not learn"
         trials = []
         for t in range(1, 4):
-            start = time.perf_counter()
-            _, losses = run_epochs(fresh_params(), t * 1e-6, *data_args)
-            np.asarray(losses)
-            trials.append(time.perf_counter() - start)
+            # fenced_call = THE shared timing idiom (utils/profiler.py):
+            # probe-fetch of the loss log is the completion fence
+            _, secs = fenced_call(run_epochs, fresh_params(), t * 1e-6,
+                                  *data_args, probe_of=lambda r: r[1])
+            trials.append(secs)
         return min(trials)
 
     # headline: the mixed dense+categorical path via EXACTLY what
@@ -904,13 +907,14 @@ def bench_kmeans(results: dict) -> None:
                                 jnp.arange(iters, dtype=jnp.int32))
         return final
 
+    from flink_ml_tpu.utils.profiler import fenced_call
+
     np.asarray(run_iters(init, points, mask))  # compile + warmup
     trials = []
     for trial in range(1, 4):
         trial_init = points[K * trial:K * (trial + 1)] + 0.0
-        start = time.perf_counter()
-        np.asarray(run_iters(trial_init, points, mask))
-        trials.append(time.perf_counter() - start)
+        _, secs = fenced_call(run_iters, trial_init, points, mask)
+        trials.append(secs)
     tpu_rate = iters / min(trials)
 
     host_rng = np.random.default_rng(0)
@@ -1012,15 +1016,12 @@ def bench_workset(results: dict) -> None:
                        workset=plan.init_workset(mask),
                        config=IterationConfig(mode="fused"))
 
+    from flink_ml_tpu.utils.profiler import fenced_call
+
     run_bsp(); run_ws()  # compile + warmup
-    start = time.perf_counter()
-    res_bsp = run_bsp()
-    np.asarray(jax.device_get(res_bsp.state))
-    bsp_wall = time.perf_counter() - start
-    start = time.perf_counter()
-    res_ws = run_ws()
+    res_bsp, bsp_wall = fenced_call(run_bsp, probe_of=lambda r: r.state)
+    res_ws, ws_wall = fenced_call(run_ws, probe_of=lambda r: r.state)
     c_ws = np.asarray(jax.device_get(res_ws.state))
-    ws_wall = time.perf_counter() - start
 
     c_bsp = np.asarray(jax.device_get(res_bsp.state))
     results["workset_bitexact"] = bool(np.array_equal(c_bsp, c_ws))
@@ -1133,14 +1134,16 @@ def bench_widedeep(results: dict) -> None:
                 jnp.arange(steps, dtype=jnp.int32))
             return params, opt_state, losses
 
+        from flink_ml_tpu.utils.profiler import fenced_call
+
         p, o, losses = run(params, opt_state)     # compile + warm
         assert np.all(np.isfinite(np.asarray(losses)))
         trials = []
         for _ in range(3):
-            start = time.perf_counter()
-            p, o, losses = run(p, o)
-            np.asarray(losses)                    # completion fence
-            trials.append(time.perf_counter() - start)
+            # probe = the loss log: the shared fenced timing idiom
+            (p, o, losses), secs = fenced_call(run, p, o,
+                                               probe_of=lambda r: r[2])
+            trials.append(secs)
         return min(trials) / steps
 
     step_s = measure(lazy=False, route=route_g)  # product default since
@@ -1257,6 +1260,8 @@ def bench_als(results: dict) -> None:
                                      jnp.arange(epochs, dtype=jnp.int32))
             return U, V
 
+        from flink_ml_tpu.utils.profiler import fenced_call
+
         U, V = jnp.asarray(f0[:n_users]), jnp.asarray(f0[n_users:])
         U1, _ = run(U, V, *data)                   # compile + warm
         assert np.all(np.isfinite(np.asarray(U1[:2])))
@@ -1266,10 +1271,9 @@ def bench_als(results: dict) -> None:
             dt = list(data)
             for s in w_slots:
                 dt[s] = data[s] * (1.0 + t * 1e-6)
-            start = time.perf_counter()
-            U2, _ = run(U, V, *dt)
-            np.asarray(U2[:1])                     # completion fence
-            trials.append(time.perf_counter() - start)
+            _, secs = fenced_call(run, U, V, *dt,
+                                  probe_of=lambda r: r[0][:1])
+            trials.append(secs)
         return min(trials) / epochs
 
     epoch_s = measure("sorted")        # the fit() default since r5
@@ -1484,15 +1488,16 @@ def bench_online_ftrl(results: dict) -> None:
                 "z": jnp.zeros((d,), jnp.float32),
                 "n": jnp.zeros((d,), jnp.float32)}
 
+    from flink_ml_tpu.utils.profiler import fenced_call
+
     state, losses = run(fresh(), idx, vals, y, sw)
     assert np.all(np.isfinite(np.asarray(losses)))
     trials = []
     for t in range(1, 4):
         swt = sw * (1.0 + t * 1e-6)        # relay-cache defeat
-        start = time.perf_counter()
-        _, losses = run(fresh(), idx, vals, y, swt)
-        np.asarray(losses)                 # completion fence
-        trials.append(time.perf_counter() - start)
+        _, secs = fenced_call(run, fresh(), idx, vals, y, swt,
+                              probe_of=lambda r: r[1])
+        trials.append(secs)
     win_s = min(trials) / windows
 
     # host anchor: the same update in numpy on one window, rate scaled
@@ -2701,6 +2706,171 @@ def bench_coldstart(results: dict) -> None:
     }
 
 
+def bench_obs(results: dict) -> None:
+    """Observability-overhead leg (obs_metric_version 1, ISSUE 13): is
+    the unified tracing/probe layer off-the-hot-path cheap?  Two A/Bs,
+    both within-run (the phase-independent ratio discipline):
+
+    - **Serving**: the PR 2 64-client sweep against one warmed LR
+      endpoint, tracing DISABLED then ENABLED — p99 and req/s both
+      ways, the overhead fractions as the headline, and the XLA
+      lowering counter across the enabled pass (MUST be 0: tracing is
+      host bookkeeping, it never touches a compiled program).
+    - **Chunked fit**: a dense streaming ``sgd_fit_outofcore`` at W=8,
+      StepProbe detached then attached — per-step time from the
+      post-compile epochs (``stream_info["epoch_seconds"][1:]``), so
+      the ratio isolates the probe's carry + one-fetch-per-chunk cost.
+
+    Plus the export surfaces exercised for real: span counts, a
+    Chrome-trace file written and re-parsed, and the Prometheus
+    exposition line count off the endpoint's metrics tree.  Measured
+    fields are null, never faked, when a sub-leg fails."""
+    import tempfile
+    import threading
+
+    from jax._src import test_util as jtu
+
+    from flink_ml_tpu import Table
+    from flink_ml_tpu.models.classification.logisticregression import (
+        LogisticRegressionModel)
+    from flink_ml_tpu.obs import default_tree, prometheus_text
+    from flink_ml_tpu.obs.trace import tracer
+    from flink_ml_tpu.serving import ModelRegistry, ServingEndpoint
+
+    obs: dict = {
+        "obs_metric_version": 1,
+        "serving_p99_ms_off": None, "serving_p99_ms_on": None,
+        "serving_rps_off": None, "serving_rps_on": None,
+        "tracing_p99_overhead_frac": None,
+        "tracing_rps_overhead_frac": None,
+        "tracing_new_lowerings": None,
+        "spans_captured": None, "trace_export_events": None,
+        "prometheus_lines": None,
+        "probe_step_ms_off": None, "probe_step_ms_on": None,
+        "probe_overhead_frac": None,
+    }
+    results["notes"]["obs"] = obs
+    results.setdefault("obs_tracing_overhead_frac", None)
+
+    # -- serving A/B ---------------------------------------------------------
+    d = 64
+    rng = np.random.default_rng(23)
+    model = LogisticRegressionModel()
+    model.set_model_data(Table({
+        "coefficients": rng.normal(size=(1, d)),
+        "intercept": np.array([0.1])}))
+    feats = Table({"features": rng.normal(size=(1024, d))
+                   .astype(np.float32)})
+    registry = ModelRegistry()
+    registry.deploy("lr", model, feats.take(2), max_batch_rows=256)
+    endpoint = ServingEndpoint(registry, "lr", max_batch_rows=256,
+                               max_wait_ms=1.0,
+                               queue_capacity=1 << 14).start()
+
+    def sweep(clients=64, per_client=16):
+        latencies: list = []
+        errors: list = []
+        lock = threading.Lock()
+
+        def client(worker):
+            crng = np.random.default_rng(worker)
+            mine = []
+            try:
+                for _ in range(per_client):
+                    start = int(crng.integers(0, 1000))
+                    rows = int(crng.integers(1, 9))
+                    req = feats.slice(start, start + rows)
+                    t0 = time.perf_counter()
+                    endpoint.predict(req, timeout=120)
+                    mine.append(time.perf_counter() - t0)
+            except Exception as exc:   # noqa: BLE001 — surfaced below
+                with lock:
+                    errors.append(repr(exc)[:200])
+            with lock:
+                latencies.extend(mine)
+
+        wall_t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(w,))
+                   for w in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        wall = time.perf_counter() - wall_t0
+        if errors:
+            # a failed client shrinks the sample: the A/B would compare
+            # different populations — null the leg instead of skewing it
+            raise RuntimeError(
+                f"serving sweep lost {len(errors)} client(s): {errors[:3]}")
+        lat = np.asarray(latencies)
+        return (round(1e3 * float(np.quantile(lat, 0.99)), 3),
+                round(len(lat) / wall, 1))
+
+    try:
+        sweep(clients=8, per_client=8)            # warm both paths
+        p99_off, rps_off = sweep()
+        tracer.enable()
+        with jtu.count_jit_and_pmap_lowerings() as count:
+            p99_on, rps_on = sweep()
+        obs["tracing_new_lowerings"] = int(count[0])
+        obs["spans_captured"] = tracer.count
+        # export surfaces, exercised for real
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "trace.json")
+            tracer.export_chrome(path)
+            obs["trace_export_events"] = len(
+                json.load(open(path))["traceEvents"])
+        tree = default_tree(endpoint=endpoint, tracer=tracer)
+        obs["prometheus_lines"] = len(
+            prometheus_text(tree.snapshot()).strip().split("\n"))
+        tracer.disable()
+        tracer.clear()
+        obs["serving_p99_ms_off"], obs["serving_rps_off"] = p99_off, rps_off
+        obs["serving_p99_ms_on"], obs["serving_rps_on"] = p99_on, rps_on
+        obs["tracing_p99_overhead_frac"] = round(p99_on / p99_off - 1, 4)
+        obs["tracing_rps_overhead_frac"] = round(1 - rps_on / rps_off, 4)
+        results["obs_tracing_overhead_frac"] = \
+            obs["tracing_p99_overhead_frac"]
+    finally:
+        tracer.disable()
+        endpoint.close()
+
+    # -- chunked-fit A/B -----------------------------------------------------
+    from flink_ml_tpu.models.common.losses import squared_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    steps, batch, fd = 32, 256, 32
+    coefs = np.arange(1, fd + 1, dtype=np.float32)
+
+    def mk():
+        frng = np.random.default_rng(11)
+
+        def make_reader():
+            for _ in range(steps):
+                X = frng.normal(size=(batch, fd)).astype(np.float32)
+                yield {"features": X, "label": X @ coefs}
+
+        return make_reader
+
+    cfg = SGDConfig(max_epochs=3, tol=0.0)
+
+    def fit_step_ms(probe: bool):
+        info: dict = {}
+        sgd_fit_outofcore(squared_loss, mk(), num_features=fd, config=cfg,
+                          steps_per_dispatch=8, stream_info=info,
+                          cache_decoded=False, step_probe=probe)
+        # epoch 0 pays the compile; post-compile epochs are the signal
+        return min(info["epoch_seconds"][1:]) * 1e3 / steps
+
+    try:
+        obs["probe_step_ms_off"] = round(fit_step_ms(False), 4)
+        obs["probe_step_ms_on"] = round(fit_step_ms(True), 4)
+        obs["probe_overhead_frac"] = round(
+            obs["probe_step_ms_on"] / obs["probe_step_ms_off"] - 1, 4)
+    except Exception as exc:   # noqa: BLE001 — nulled, never faked
+        obs["probe_error"] = repr(exc)[:200]
+
+
 def main() -> None:
     tpu_ok = _probe_tpu_backend()
     if not tpu_ok:
@@ -2739,7 +2909,7 @@ def main() -> None:
                 bench_workset, bench_widedeep, bench_als, bench_gbt,
                 bench_online_ftrl, bench_serving, bench_pipeline,
                 bench_comm, bench_wal, bench_recovery, bench_online,
-                bench_kernels, bench_coldstart):
+                bench_kernels, bench_coldstart, bench_obs):
         try:
             leg(results)
         except Exception as exc:   # noqa: BLE001

@@ -70,6 +70,7 @@ import numpy as np
 from ..data.table import Table
 from ..kernels.registry import dispatch as _kernel_dispatch
 from ..kernels.registry import dispatch_count  # noqa: F401  (re-export)
+from ..obs.trace import tracer
 from ..utils.padding import DEFAULT_MIN_BUCKET, pad_rows_to_bucket
 
 __all__ = ["StageKernel", "ChainConfig", "CompiledSegment",
@@ -240,13 +241,22 @@ def run_kernel(kernel: StageKernel, table: Table, *,
     host = {n: _normalize_col(table[n], dtype) for n in kernel.consumes}
     if kernel.pre is not None:
         kernel.pre(host)
-    padded, n = pad_rows_to_bucket(tuple(host.values()),
-                                   min_bucket=min_bucket)
-    cols = dict(zip(host, padded))
+    with tracer.span("bucket_pad", cat="kernel", op=op):
+        padded, n = pad_rows_to_bucket(tuple(host.values()),
+                                       min_bucket=min_bucket)
+        cols = dict(zip(host, padded))
     out = _kernel_dispatch(((kernel.fn, kernel.static),),
                            (kernel.params if params is None else params,),
                            cols, op=op)
-    fetched = {name: np.asarray(out[name])[:n] for name in kernel.produces}
+    # device_execute: the np.asarray fetch IS the completion fence (the
+    # StepTimer probe pattern — device_get on the host side of the
+    # dispatch boundary, never a block inside a step fn), so this span
+    # covers queue + device compute + transfer of the produced columns
+    with tracer.span("device_execute", cat="kernel", op=op,
+                     bucket=int(next(iter(cols.values())).shape[0])
+                     if cols else 0):
+        fetched = {name: np.asarray(out[name])[:n]
+                   for name in kernel.produces}
     if kernel.post is not None:
         fetched.update(kernel.post(fetched))
     return fetched

@@ -146,7 +146,8 @@ def _assemble_chunk(items: list, size: int):
     return chunk, mask, n_valid
 
 
-def masked_chunk_scan(step: Callable, state: Any, loss_sum, chunk, mask):
+def masked_chunk_scan(step: Callable, state: Any, loss_sum, chunk, mask,
+                      probe=None):
     """THE consumer half of ``chunks=W``: run ``step(state, *batch) ->
     (new_state, loss)`` over every stacked batch of ``chunk`` as one
     ``lax.scan``, freezing ``state`` and skipping the loss accumulation
@@ -155,22 +156,49 @@ def masked_chunk_scan(step: Callable, state: Any, loss_sum, chunk, mask):
     copy of the freeze/accumulate logic shared by the sgd and WideDeep
     streaming fits (callers jit + donate the ``(state, loss_sum)``
     carry); the hosted ``iterate`` chunk loop carries extra epoch/vote
-    structure and stays separate."""
+    structure and stays separate.
+
+    ``probe`` (a :class:`~flink_ml_tpu.obs.StepProbe`, ISSUE 13)
+    optionally rides the carry recording the per-step ``loss`` — it is
+    frozen on dead steps exactly like the state, so the recorded series
+    is W-independent; callers fetch it in one batched transfer at the
+    chunk boundary and pass a ``reset()`` probe into the next dispatch.
+    ``probe=None`` keeps the 2-tuple carry byte-identical to the
+    pre-probe program (the W-bit-exactness contract rides on program
+    identity, not just the math)."""
     import jax.numpy as jnp
 
-    def scan_step(carry, xs):
-        state, loss_sum = carry
+    if probe is None:
+        def scan_step(carry, xs):
+            state, loss_sum = carry
+            *batch, m = xs
+            new_state, loss = step(state, *batch)
+            valid = m > 0
+            state = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(valid, n, o), new_state, state)
+            loss_sum = loss_sum + jnp.where(valid, loss, 0.0)
+            return (state, loss_sum), None
+
+        (state, loss_sum), _ = jax.lax.scan(scan_step, (state, loss_sum),
+                                            tuple(chunk) + (mask,))
+        return state, loss_sum
+
+    def probed_step(carry, xs):
+        state, loss_sum, probe = carry
         *batch, m = xs
         new_state, loss = step(state, *batch)
         valid = m > 0
         state = jax.tree_util.tree_map(
             lambda n, o: jnp.where(valid, n, o), new_state, state)
         loss_sum = loss_sum + jnp.where(valid, loss, 0.0)
-        return (state, loss_sum), None
+        probe = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(valid, n, o),
+            probe.record(loss=loss), probe)
+        return (state, loss_sum, probe), None
 
-    (state, loss_sum), _ = jax.lax.scan(scan_step, (state, loss_sum),
-                                        tuple(chunk) + (mask,))
-    return state, loss_sum
+    (state, loss_sum, probe), _ = jax.lax.scan(
+        probed_step, (state, loss_sum, probe), tuple(chunk) + (mask,))
+    return state, loss_sum, probe
 
 
 def chunk_consumer_plan(mesh, specs, W: int, prefetch_depth: int):

@@ -50,6 +50,7 @@ from typing import Any, Dict, Iterator, List, Optional
 import numpy as np
 
 from .table import Table
+from ..obs.trace import tracer
 from ..robustness.durability import CorruptStateError
 from ..robustness.faults import fault_point
 
@@ -133,10 +134,12 @@ class WindowLog:
             yield window
         # live phase: write-ahead, then hand over
         for window in self._source:
-            if self._retry is not None:
-                self._retry.call(self._persist, self._next_log, window)
-            else:
-                self._persist(self._next_log, window)
+            with tracer.span("wal_append", cat="train",
+                             window=self._next_log):
+                if self._retry is not None:
+                    self._retry.call(self._persist, self._next_log, window)
+                else:
+                    self._persist(self._next_log, window)
             self._next_log += 1
             self._consumed = self._next_log
             yield window

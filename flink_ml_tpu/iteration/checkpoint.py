@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from ..obs.trace import tracer
 from ..robustness.durability import (
     CorruptStateError,
     commit_dir,
@@ -289,7 +290,10 @@ class CheckpointManager:
         meta = {"epoch": epoch}
         if extra:
             meta.update(extra)
-        save_pytree(path, state, meta)
+        # the cut's slot key IS the trainer's global step for streaming
+        # fits — the `step` correlation id a later delta publish carries
+        with tracer.span("checkpoint_write", cat="train", step=int(epoch)):
+            save_pytree(path, state, meta)
         self._gc()
         return path
 

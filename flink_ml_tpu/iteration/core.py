@@ -469,15 +469,17 @@ def _iterate_fused(body: BodyFn, initial_state, provider: _DataProvider,
     zero_out = jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype), probe.outputs)
 
-    # Per-epoch convergence curves survive the fused loop in a fixed-size
-    # NaN-prefilled trace buffer riding the carry (the sgd.py loss-log
-    # pattern): a while_loop keeps only its final carry, so anything
-    # per-epoch must be indexed into a (max_epochs,) buffer on device.
-    # NaN tail = epochs never run.
-    trace0 = {
-        "active_fraction": jnp.full((max_epochs,), jnp.nan, jnp.float32),
-        "termination": jnp.full((max_epochs,), jnp.nan, jnp.float32),
-    }
+    # Per-epoch convergence curves survive the fused loop in a
+    # fixed-size NaN-prefilled StepProbe riding the carry (obs/probe.py
+    # — the generalization of the sgd.py loss-log pattern this loop used
+    # to hand-roll): a while_loop keeps only its final carry, so
+    # anything per-epoch must be indexed into a (max_epochs,) buffer on
+    # device.  NaN tail = epochs never run; the probe cursor tracks
+    # rounds actually recorded.
+    from ..obs.probe import StepProbe
+
+    trace0 = StepProbe.create(("active_fraction", "termination"),
+                              max_epochs)
 
     @partial(jax.jit, donate_argnums=(0,) if config.donate_state else ())
     def run(state, data):
@@ -492,13 +494,9 @@ def _iterate_fused(body: BodyFn, initial_state, provider: _DataProvider,
             keep_going = vote.astype(bool).reshape(())
             frac = (frac_fn(res.feedback) if frac_fn is not None
                     else jnp.asarray(jnp.nan, jnp.float32))
-            trace = {
-                "active_fraction":
-                    trace["active_fraction"].at[epoch].set(frac),
-                "termination":
-                    trace["termination"].at[epoch].set(
-                        vote.astype(jnp.float32).reshape(())),
-            }
+            trace = trace.record_at(
+                epoch, active_fraction=frac,
+                termination=vote.astype(jnp.float32).reshape(()))
             return res.feedback, res.outputs, epoch + 1, keep_going, trace
 
         return jax.lax.while_loop(
@@ -511,9 +509,8 @@ def _iterate_fused(body: BodyFn, initial_state, provider: _DataProvider,
     from ..parallel.mesh import fetch_replicated
 
     n_run = int(np.asarray(fetch_replicated(num_epochs)))
-    side = {"epoch_trace": {
-        k: np.asarray(fetch_replicated(v))[:n_run]
-        for k, v in trace.items()}}
+    side = {"epoch_trace": trace.fetch(
+        get=lambda v: np.asarray(fetch_replicated(v)))}
     return IterationResult(final_state, outputs, n_run, side)
 
 

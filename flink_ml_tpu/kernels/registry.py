@@ -50,6 +50,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from ..obs.trace import tracer
+
 __all__ = [
     "KernelEntry",
     "KernelStats",
@@ -516,9 +518,16 @@ def dispatch(plan: tuple, params_seq: tuple, cols: Dict[str, Any], *,
     from .aot import active_cache
 
     cache = active_cache()
+    # the dispatch span measures time-to-return of the ASYNC dispatch
+    # (the same wall kernel_stats records): steady-state it is the
+    # dispatch overhead, on a cold key it includes the compile.  The
+    # device-execute completion is a separate, FENCED span recorded by
+    # the consumer that fetches the output (api/chain.py::run_kernel) —
+    # never a block inside this hot path.
     if cache is None:
         t0 = time.perf_counter()
-        out = _plan_jit()(plan, params_seq, _ONE, cols)
+        with tracer.span("registry_dispatch", cat="kernel", op=label):
+            out = _plan_jit()(plan, params_seq, _ONE, cols)
         kernel_stats.record(label, compiled=not seen,
                             seconds=time.perf_counter() - t0)
         return out
@@ -528,13 +537,14 @@ def dispatch(plan: tuple, params_seq: tuple, cols: Dict[str, Any], *,
         lambda: _plan_jit().lower(plan, params_seq, _ONE, cols).compile(),
         label=label)
     t0 = time.perf_counter()
-    try:
-        out = compiled(params_seq, _ONE, cols)
-    except TypeError:
-        # an operand aspect the shape key cannot see (weak types)
-        # diverged from the lowering — correctness comes first: run the
-        # plain jit path for this call, keep the entry for callers it fits
-        out = _plan_jit()(plan, params_seq, _ONE, cols)
+    with tracer.span("registry_dispatch", cat="kernel", op=label):
+        try:
+            out = compiled(params_seq, _ONE, cols)
+        except TypeError:
+            # an operand aspect the shape key cannot see (weak types)
+            # diverged from the lowering — correctness comes first: run the
+            # plain jit path for this call, keep the entry for callers it fits
+            out = _plan_jit()(plan, params_seq, _ONE, cols)
     kernel_stats.record(label, compiled=(source == "compile"),
                         seconds=time.perf_counter() - t0)
     return out

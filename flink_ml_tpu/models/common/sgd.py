@@ -20,7 +20,7 @@ import itertools
 import time
 
 from dataclasses import dataclass
-from typing import Callable, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +35,7 @@ from ...data.replay_cache import (
 )
 from ...iteration import IterationBodyResult, IterationConfig, iterate
 from ...iteration.checkpoint import CheckpointConfig, CheckpointManager
+from ...obs.trace import tracer
 from ...parallel.mesh import (
     default_mesh,
     assemble_process_local as _assemble_process_local,
@@ -283,8 +284,10 @@ def _run_minibatch_epochs(update, data: tuple, init_params, steps: int,
     the fused while_loop (replicated scalars), so early stopping works
     without any cross-host round-trip per epoch."""
 
+    from ...obs.probe import StepProbe
+
     def epoch_body(state, epoch, data):
-        params, prev_loss, loss_log = state
+        params, prev_loss, probe = state
 
         def batch_step(params, i):
             return update(params, *(a[i] for a in data))
@@ -292,28 +295,31 @@ def _run_minibatch_epochs(update, data: tuple, init_params, steps: int,
         params, losses = jax.lax.scan(
             batch_step, params, jnp.arange(steps, dtype=jnp.int32))
         epoch_loss = jnp.mean(losses)
-        # The full loss history rides in the carried state (a fixed-size
-        # buffer indexed by epoch) so the fused while_loop path — which only
-        # keeps the LAST epoch's outputs — still yields the complete log.
-        loss_log = loss_log.at[epoch].set(epoch_loss)
+        # The full loss history rides in the carried state (a StepProbe
+        # — obs/probe.py, the generalization of the fixed-size
+        # NaN-prefilled buffer this driver used to hand-roll) so the
+        # fused while_loop path — which only keeps the LAST epoch's
+        # outputs — still yields the complete log in one fetch.
+        probe = probe.record_at(epoch, loss=epoch_loss)
         termination = (jnp.abs(prev_loss - epoch_loss) > config.tol
                        if config.tol > 0 else None)
         return IterationBodyResult(
-            feedback=(params, epoch_loss, loss_log), termination=termination)
+            feedback=(params, epoch_loss, probe), termination=termination)
 
     init_state = (replicate(init_params, mesh) if place_params
                   else init_params,
                   jnp.asarray(jnp.inf, jnp.float32),
-                  jnp.full((config.max_epochs,), jnp.nan, jnp.float32))
+                  StepProbe.create(("loss",), config.max_epochs))
 
     result = iterate(
         epoch_body, init_state, data,
         max_epochs=config.max_epochs,
         config=IterationConfig(mode="fused"),
     )
-    params, _final_loss, loss_buf = result.state
+    params, _final_loss, probe = result.state
     params = _fetch_replicated(params)
-    loss_log = list(_fetch_replicated(loss_buf)[:result.num_epochs])
+    loss_log = list(probe.fetch(
+        get=lambda v: _fetch_replicated(v))["loss"][:result.num_epochs])
     return params, loss_log
 
 
@@ -1324,7 +1330,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                       checkpoint_every_steps: int = 0,
                       resume: bool = False,
                       retry_policy=None,
-                      publish_cb: Optional[Callable] = None
+                      publish_cb: Optional[Callable] = None,
+                      step_probe: bool = False
                       ) -> Tuple[LinearState, list]:
     """Out-of-core variant of :func:`sgd_fit`: the dataset never has to fit
     in host RAM or HBM (the Criteo-1TB shape, BASELINE.md north star).
@@ -1492,6 +1499,16 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     checkpoint-based recovery is the healing layer, not retry).  The
     reader must not consume a batch on a failed pull, or be idempotent
     at the failed position (seekable readers are).
+
+    **Step probe** (``step_probe=True``, ISSUE 13): a
+    :class:`~flink_ml_tpu.obs.StepProbe` rides the donated chunk carry
+    recording the per-step ``loss`` — zero host sync inside the scan
+    (the probe is frozen on dead padded steps like the state, so the
+    series is W-independent) and ONE batched device->host transfer per
+    chunk boundary.  The concatenated per-step series lands in
+    ``stream_info["step_trace"]`` (``{"loss": np.ndarray}``).  Chunked
+    single-process fits only — the per-batch multi-host loop already
+    fetches per step, so a probe would add nothing there (raises).
     """
     from ...parallel.mesh import local_axis_multiple
 
@@ -1598,15 +1615,31 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     # local, so process-spanning meshes keep the classic per-batch loop.
     W = max(1, int(steps_per_dispatch))
     chunked = procs == 1
+    if step_probe and not chunked:
+        raise ValueError(
+            "step_probe=True needs the chunked single-process path: the "
+            "per-batch multi-host loop dispatches per step already, so "
+            "a probe would only duplicate what the host loop sees")
     if chunked:
         from ...data.prefetch import chunk_consumer_plan, masked_chunk_scan
 
         sharding, chunk_depth = chunk_consumer_plan(mesh, specs, W,
                                                     prefetch_depth)
-        chunk_step = jax.jit(
-            lambda params, loss_sum, chunk, mask: masked_chunk_scan(
-                update, params, loss_sum, chunk, mask),
-            donate_argnums=(0, 1))
+        if step_probe:
+            # the probe joins the donated carry (argnums 0-2): each
+            # chunk's returned probe is fetched ONCE at the boundary and
+            # a reset() probe (fresh buffers) feeds the next dispatch,
+            # so donation never aliases a buffer the host still reads
+            chunk_step = jax.jit(
+                lambda params, loss_sum, probe, chunk, mask:
+                masked_chunk_scan(update, params, loss_sum, chunk, mask,
+                                  probe=probe),
+                donate_argnums=(0, 1, 2))
+        else:
+            chunk_step = jax.jit(
+                lambda params, loss_sum, chunk, mask: masked_chunk_scan(
+                    update, params, loss_sum, chunk, mask),
+                donate_argnums=(0, 1))
     else:
         W = 1
         sharding = tuple(NamedSharding(mesh, p) for p in specs)
@@ -1804,6 +1837,12 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
 
     epoch_secs: list = []
     dispatch_log: list = []   # jitted-step dispatches per epoch
+    probe = None
+    step_trace: Dict[str, list] = {}
+    if step_probe:
+        from ...obs.probe import StepProbe
+
+        probe = StepProbe.create(("loss",), W)
     for epoch in range(start_epoch, config.max_epochs):
         t_epoch = time.perf_counter()
         rec_cache = None
@@ -1996,7 +2035,24 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                     # would read StopIteration and silently truncate)
                     if loss_sum is None:
                         loss_sum = jnp.zeros((), jnp.float32)
-                    params, loss_sum = chunk_step(params, loss_sum, chunk, mask)
+                    with tracer.span("train_chunk", cat="train",
+                                     step=global_step + n_valid,
+                                     epoch=epoch):
+                        # span = dispatch wall (async): completion is
+                        # fenced by the probe fetch below / the epoch-end
+                        # loss fetch, never inside the loop
+                        if probe is not None:
+                            params, loss_sum, probe_out = chunk_step(
+                                params, loss_sum, probe, chunk, mask)
+                        else:
+                            params, loss_sum = chunk_step(
+                                params, loss_sum, chunk, mask)
+                    if probe is not None:
+                        # ONE batched transfer at the chunk boundary —
+                        # the only fence the probe ever costs
+                        for k, v in probe_out.fetch().items():
+                            step_trace.setdefault(k, []).append(v)
+                        probe = probe_out.reset()
                     n_batches += n_valid
                     step_in_epoch += n_valid
                     global_step += n_valid
@@ -2040,7 +2096,11 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
             replay_cache = rec_cache
             recorded_epochs += 1
             _rec_cache[0] = None
-        epoch_secs.append(time.perf_counter() - t_epoch)
+        t_now = time.perf_counter()
+        epoch_secs.append(t_now - t_epoch)
+        if tracer.enabled:
+            tracer.add("train_epoch", t_epoch, t_now, cat="train",
+                       epoch=epoch, step=global_step)
         epoch_loss = float(
             np.asarray(_fetch_replicated(loss_sum))) / n_batches
         loss_log.append(epoch_loss)
@@ -2064,6 +2124,10 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         stream_info["impl"] = stream_impl
         stream_info["steps_per_dispatch"] = W
         stream_info["dispatches_per_epoch"] = dispatch_log
+        if step_probe:
+            stream_info["step_trace"] = {
+                k: (np.concatenate(v) if v else np.zeros((0,), np.float32))
+                for k, v in step_trace.items()}
         if block_cache is not None:
             stream_info["decoded_cache_mode"] = "block"
             stream_info["decoded_cache_batches"] = len(block_cache)

@@ -1,0 +1,27 @@
+"""Unified observability (ISSUE 13): span tracing with correlation ids
+(:mod:`.trace`), the one-snapshot metrics tree with Prometheus/JSONL
+writers (:mod:`.tree`), and the device-side :class:`~.probe.StepProbe`
+riding scan carries.  See ARCHITECTURE.md "Observability"."""
+
+from .probe import StepProbe
+from .trace import CORRELATION_KEYS, Span, SpanTracer, tracer
+from .tree import (
+    MetricsTree,
+    ObsSampler,
+    default_tree,
+    prometheus_text,
+    read_samples,
+)
+
+__all__ = [
+    "CORRELATION_KEYS",
+    "MetricsTree",
+    "ObsSampler",
+    "Span",
+    "SpanTracer",
+    "StepProbe",
+    "default_tree",
+    "prometheus_text",
+    "read_samples",
+    "tracer",
+]

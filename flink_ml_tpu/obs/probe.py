@@ -1,0 +1,146 @@
+"""StepProbe — named per-step scalars riding a scan carry (ISSUE 13).
+
+The repo grew this idiom twice by hand: the sgd fused-fit loss log (a
+``(max_epochs,)`` NaN-prefilled buffer indexed ``.at[epoch]``) and the
+PR 9 workset ``epoch_trace`` (the same buffer, twice, for
+active-fraction and termination).  :class:`StepProbe` is the
+generalization both now ride: a registered pytree packing K named
+channels into ONE ``(capacity, K)`` f32 buffer plus a cursor, so
+
+- **recording is pure device math** (``.at[cursor].set`` of one packed
+  row — no host sync inside any step fn; the graftlint host-sync pass
+  covers this module), and
+- **fetching is one batched transfer**: :meth:`fetch` issues a single
+  ``device_get`` of ``(buf, cursor)`` at a chunk/loop boundary and
+  splits into per-channel host arrays — never K transfers, never one
+  per step.
+
+NaN prefill is the validity encoding: rows past the cursor (steps never
+run, the padded tail of a short chunk) read NaN and :meth:`fetch` trims
+them.  The probe composes with donation (the chunked fit donates its
+carry; :meth:`reset` hands the next dispatch fresh buffers after a
+fetch) and with ``masked_chunk_scan``'s dead-step freeze (the probe
+rides the same ``jnp.where`` the state does, so padded steps record
+nothing and any two ``W`` values stay bit-exact).
+
+Adopters: the fused ``iterate`` epoch trace (``iteration/core.py``)
+records ``active_fraction`` + ``termination`` per round;
+``sgd_fit_outofcore(step_probe=True)`` records per-step ``loss`` across
+the chunked scan and surfaces the concatenated series as
+``stream_info["step_trace"]``.  Channel vocabulary is caller-defined —
+grad norms, realized compression rungs/bytes, workset active fractions
+are all just names.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+__all__ = ["StepProbe"]
+
+
+class StepProbe:
+    """K named per-step f32 scalars in one ``(capacity, K)`` device
+    buffer + an int32 cursor.  Immutable-functional like every carry
+    pytree: ``record``/``record_at``/``reset`` return new probes."""
+
+    __slots__ = ("names", "capacity", "buf", "cursor")
+
+    def __init__(self, names: Tuple[str, ...], capacity: int,
+                 buf: Any = None, cursor: Any = None):
+        import jax.numpy as jnp
+
+        self.names = tuple(names)
+        self.capacity = int(capacity)
+        if not self.names:
+            raise ValueError("StepProbe needs at least one channel name")
+        if len(set(self.names)) != len(self.names):
+            raise ValueError(f"duplicate channel names: {self.names}")
+        if self.capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.buf = (buf if buf is not None else
+                    jnp.full((self.capacity, len(self.names)), jnp.nan,
+                             jnp.float32))
+        self.cursor = (cursor if cursor is not None
+                       else jnp.asarray(0, jnp.int32))
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def create(cls, names: Sequence[str], capacity: int) -> "StepProbe":
+        return cls(tuple(names), capacity)
+
+    # -- device-side recording (pure math, safe inside step fns) -------------
+    def _row(self, scalars: Dict[str, Any]):
+        import jax.numpy as jnp
+
+        unknown = set(scalars) - set(self.names)
+        if unknown:
+            raise ValueError(
+                f"unknown probe channel(s) {sorted(unknown)}; this probe "
+                f"records {self.names}")
+        return jnp.stack([
+            jnp.asarray(scalars[n], jnp.float32).reshape(())
+            if n in scalars else jnp.asarray(jnp.nan, jnp.float32)
+            for n in self.names])
+
+    def record(self, **scalars) -> "StepProbe":
+        """Write one packed row at the cursor and advance it.  Channels
+        not provided stay NaN for this step.  Past-capacity records are
+        dropped (the ring-less fixed-buffer contract: callers size
+        ``capacity`` to the loop bound — ``W`` steps, ``max_epochs``
+        rounds)."""
+        import jax.numpy as jnp
+
+        idx = jnp.minimum(self.cursor, self.capacity - 1)
+        row = jnp.where(self.cursor < self.capacity,
+                        self._row(scalars), self.buf[idx])
+        return StepProbe(self.names, self.capacity,
+                         self.buf.at[idx].set(row),
+                         jnp.minimum(self.cursor + 1, self.capacity))
+
+    def record_at(self, index, **scalars) -> "StepProbe":
+        """Write at an explicit step index (the fused while_loop records
+        at ``epoch``); the cursor becomes ``max(cursor, index + 1)`` so
+        :meth:`fetch` still trims to rounds actually run."""
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(index, jnp.int32)
+        return StepProbe(self.names, self.capacity,
+                         self.buf.at[idx].set(self._row(scalars)),
+                         jnp.maximum(self.cursor, idx + 1))
+
+    def reset(self) -> "StepProbe":
+        """Fresh NaN buffers, cursor 0 — what a donating chunk loop
+        passes into the next dispatch after fetching this one."""
+        return StepProbe(self.names, self.capacity)
+
+    # -- host-side fetch (ONE batched transfer) ------------------------------
+    def fetch(self, get: Optional[Callable[[Any], Any]] = None
+              ) -> Dict[str, np.ndarray]:
+        """Fetch every channel in one ``device_get`` of ``(buf, cursor)``
+        and trim to recorded steps.  ``get`` overrides the fetcher for
+        replicated/multi-host arrays (the iteration driver passes
+        ``fetch_replicated``)."""
+        if get is None:
+            buf, cursor = jax.device_get((self.buf, self.cursor))
+        else:
+            buf, cursor = get(self.buf), get(self.cursor)
+        n = int(np.asarray(cursor))
+        buf = np.asarray(buf)[:n]
+        return {name: buf[:, i] for i, name in enumerate(self.names)}
+
+
+def _probe_flatten(p: StepProbe):
+    return (p.buf, p.cursor), (p.names, p.capacity)
+
+
+def _probe_unflatten(aux, children):
+    names, capacity = aux
+    return StepProbe(names, capacity, *children)
+
+
+jax.tree_util.register_pytree_node(StepProbe, _probe_flatten,
+                                   _probe_unflatten)

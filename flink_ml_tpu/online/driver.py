@@ -46,6 +46,7 @@ import numpy as np
 
 from ..data.wal import WindowBatchReader, WindowLog
 from ..iteration.body import IterationListener
+from ..obs.trace import tracer
 from .delta import DeltaBaseMismatch
 from .publish import DeltaEncoder, DeltaPublisher, PublishResult
 from .staleness import StalenessPolicy
@@ -165,6 +166,9 @@ class ContinuousLearner:
         # cut never pays the device->host sync it exists to avoid.
         if not self.policy.due(step // self._every, self.publisher.stats):
             self.publisher.stats.skips += 1
+            # the cadence skip is a real event on the cut timeline: a
+            # trace showing cut T with no publish must say WHY
+            tracer.instant("publish_skip", cat="publish", step=step)
         else:
             result = encode_and_publish(self.encoder, self.publisher,
                                         step, params_fn())

@@ -45,6 +45,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ..obs.trace import tracer
 from .delta import (
     DeltaShapeChanged,
     FullUpdate,
@@ -257,15 +258,22 @@ class DeltaPublisher:
         from ..serving.registry import GenerationConflict
 
         t0 = time.perf_counter()
-        with self._lock:
+        with self._lock, \
+                tracer.span("delta_publish", cat="publish",
+                            step=int(update.step)) as span:
             try:
-                return self._apply_locked(update, t0)
+                result = self._apply_locked(update, t0)
             except GenerationConflict:
                 # drop every cached view of the entry (the drift check
                 # alone misses a first-publish race) and re-validate
                 self._base = None
                 self._template = None
-                return self._apply_locked(update, t0)
+                result = self._apply_locked(update, t0)
+            # the publish span carries BOTH halves of the correlation
+            # chain: the trainer's cut step and the serving generation
+            # it became — the join point of "cut T -> generation G"
+            span.note(generation=result.generation, x_mode=result.mode)
+            return result
 
     def _apply_locked(self, update, t0: float) -> PublishResult:
         live = self._registry.current(self._name)

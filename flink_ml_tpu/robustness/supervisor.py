@@ -33,6 +33,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional
 
+from ..obs.trace import tracer
 from .faults import InjectedCrash
 from .retry import RetryPolicy
 
@@ -142,6 +143,8 @@ def resilient_fit(fit: Callable, *args: Any,
             rep.events.append(RecoveryEvent(
                 error=repr(exc)[:200], detected_at=clock(),
                 backoff_s=pause))
+            tracer.instant("recovery_restart", cat="train",
+                           x_error=repr(exc)[:80])
             backoff.sleep(pause)
             resume = True
             continue

@@ -21,6 +21,7 @@ loop).  One condition variable covers both sides.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 
@@ -32,6 +33,13 @@ from typing import List, Optional
 from ..data.table import Table
 
 __all__ = ["MicroBatcher", "ServingRequest", "ServingOverloadedError"]
+
+#: process-wide request-id source — THE ``request_id`` correlation id of
+#: the span-tracing contract (``obs/trace.py``): assigned at submit,
+#: carried by the request through queue-wait/serve spans, unique across
+#: every endpoint in the process so one exported trace never aliases
+#: two requests
+_REQUEST_IDS = itertools.count(1)
 
 
 class ServingOverloadedError(RuntimeError):
@@ -49,6 +57,7 @@ class ServingRequest:
     rows: int
     future: Future = field(default_factory=Future)
     submitted_at: float = field(default_factory=time.perf_counter)
+    request_id: int = field(default_factory=lambda: next(_REQUEST_IDS))
 
 
 class MicroBatcher:

@@ -30,6 +30,7 @@ from typing import Any, List, Optional
 import numpy as np
 
 from ..data.table import Table
+from ..obs.trace import tracer
 from .batcher import MicroBatcher, ServingOverloadedError, ServingRequest
 from .metrics import ServingMetrics
 from .registry import ModelRegistry
@@ -169,10 +170,28 @@ class ServingEndpoint:
         deployed = self._registry.current(self._name)
         servable = deployed.servable
         rows = sum(r.rows for r in batch)
-        try:
+        if tracer.enabled:
+            # queue-wait is recorded RETROACTIVELY from the request's
+            # submit stamp — the submit path itself never touches the
+            # tracer (no lock, no clock read, under load)
+            formed = time.perf_counter()
             for request in batch:
-                servable.check_schema(request.table)
-            out = servable.predict(self._concat([r.table for r in batch]))
+                tracer.add("queue_wait", request.submitted_at, formed,
+                           cat="serving", request_id=request.request_id,
+                           generation=deployed.generation)
+        try:
+            with tracer.span("batch_assembly", cat="serving",
+                             generation=deployed.generation):
+                for request in batch:
+                    servable.check_schema(request.table)
+                table = self._concat([r.table for r in batch])
+            with tracer.span("serve_batch", cat="serving",
+                             generation=deployed.generation,
+                             bucket=servable.bucket_for(rows)):
+                # nested inside: bucket_pad -> registry dispatch ->
+                # device_execute (the kernel-servable path instruments
+                # those in api/chain.py + kernels/registry.py)
+                out = servable.predict(table)
         except BaseException as exc:  # noqa: BLE001 — delivered per-request
             for request in batch:
                 request.future.set_exception(exc)
@@ -181,6 +200,12 @@ class ServingEndpoint:
         now = time.perf_counter()
         latencies = []
         for request in batch:
+            if tracer.enabled:
+                # committed BEFORE the future resolves, so a caller woken
+                # by predict() can already see its own request span
+                tracer.add("request", request.submitted_at, now,
+                           cat="serving", request_id=request.request_id,
+                           generation=deployed.generation)
             request.future.set_result(
                 out.slice(offset, offset + request.rows))
             offset += request.rows

@@ -88,6 +88,8 @@ class ServingMetrics:
         self.publishes_delta = self.group.counter("publishes_delta")
         self.publishes_full = self.group.counter("publishes_full")
         self._staleness = self.group.gauge("model_staleness_seconds")
+        #: never-published = NaN (absent in exports), never a fake age
+        self._staleness.set(float("nan"))
         self._publish_rate = self.group.gauge("publishes_per_sec")
         self._publish_bytes = self.group.gauge("last_publish_bytes")
         self._last_publish_at: Optional[float] = None
@@ -162,9 +164,12 @@ class ServingMetrics:
         """Refresh the model-staleness gauge (seconds since the last
         publish).  Called from the serve loop per batch — one
         ``time.time()`` — so the gauge stays live between publishes; a
-        never-published endpoint reads -1 (unknown, not fresh)."""
+        never-published endpoint reads NaN (unknown, not fresh — and
+        NaN, not the old ``-1`` sentinel, so snapshot consumers and the
+        Prometheus writer emit ABSENT instead of a fake negative age;
+        ISSUE 13 satellite, regression-tested in tests/test_obs.py)."""
         if self._last_publish_at is None:
-            self._staleness.set(-1.0)
+            self._staleness.set(float("nan"))
             return
         now = time.time() if now is None else now
         self._staleness.set(round(now - self._last_publish_at, 3))

@@ -37,6 +37,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 from ..data.table import Table
+from ..obs.trace import tracer
 from ..robustness.faults import fault_point
 from ..utils import persist
 from .executor import ServableModel, make_servable
@@ -154,6 +155,7 @@ class ModelRegistry:
                                      generation=generation, source=source,
                                      deployed_at=time.time())
             self._live[name] = deployed   # THE swap: one dict assignment
+        tracer.instant("deploy", cat="publish", generation=generation)
         if metrics is not None:
             metrics.on_deploy(generation)
         return deployed
@@ -210,6 +212,8 @@ class ModelRegistry:
                                      generation=generation, source=source,
                                      deployed_at=time.time())
             self._live[name] = deployed   # THE swap: one dict assignment
+        tracer.instant("publish_swap", cat="publish",
+                       generation=generation)
         if metrics is not None:
             if hasattr(metrics, "on_publish"):
                 metrics.on_publish(generation, mode=mode,

@@ -8,6 +8,7 @@ from .donation import DonationSafetyPass
 from .host_sync import HostSyncPass
 from .kernel_registry import KernelRegistryPass
 from .locks import LockDisciplinePass
+from .unfenced_timing import UnfencedTimingPass
 
 ALL_PASSES = (
     HostSyncPass,
@@ -16,9 +17,11 @@ ALL_PASSES = (
     LockDisciplinePass,
     CollectiveConsistencyPass,
     KernelRegistryPass,
+    UnfencedTimingPass,
     BenchSchemaPass,
 )
 
 __all__ = ["ALL_PASSES", "AtomicWritesPass", "BenchSchemaPass",
            "CollectiveConsistencyPass", "DonationSafetyPass",
-           "HostSyncPass", "KernelRegistryPass", "LockDisciplinePass"]
+           "HostSyncPass", "KernelRegistryPass", "LockDisciplinePass",
+           "UnfencedTimingPass"]

@@ -26,7 +26,13 @@ from .base import LintPass
 #: ``kernels/aot.py`` + ``kernels/autotune.py`` joined in ISSUE 12 —
 #: the persistent executable/decision cache writes through the same
 #: commit protocol and must be tmp -> os.replace like everything else
-#: a loader trusts)
+#: a loader trusts; ``flink_ml_tpu/obs/`` joined in ISSUE 13 — trace
+#: exports and metrics time-series are exactly the files an operator
+#: loads after a crash, so a half-written trace JSON must never sit at
+#: a trusted path.  The one sanctioned exception — the sampler's
+#: line-framed JSONL append, whose torn tail the reader truncates (the
+#: WAL-tail stance) — carries an inline suppression with its
+#: justification, which this root existing keeps EXERCISED.)
 DURABLE_MODULES = (
     "flink_ml_tpu/utils/persist.py",
     "flink_ml_tpu/iteration/checkpoint.py",
@@ -34,6 +40,7 @@ DURABLE_MODULES = (
     "flink_ml_tpu/robustness/durability.py",
     "flink_ml_tpu/kernels/aot.py",
     "flink_ml_tpu/kernels/autotune.py",
+    "flink_ml_tpu/obs",
 )
 
 _WRITE_MODES = {"w", "wb", "w+", "wb+", "a", "ab"}

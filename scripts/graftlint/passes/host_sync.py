@@ -47,9 +47,15 @@ SCAN_CALLEES = {"scan", "masked_chunk_scan", "while_loop", "fori_loop"}
 #: with ISSUE 10: the kernel registry routes every training hot path
 #: through these modules, so a host fetch in a kernel wrapper would
 #: fence EVERY consumer's dispatch stream at once)
+#: (``obs/`` joined with ISSUE 13: the StepProbe's whole contract is
+#: zero host sync inside step fns — its ``record``/``record_at`` ride
+#: scan/while carries on every training hot path, so a device_get
+#: sneaking into a step-shaped helper there would fence every adopter's
+#: dispatch stream at once)
 SCAN_ROOTS = (
     "flink_ml_tpu/iteration",
     "flink_ml_tpu/models",
+    "flink_ml_tpu/obs",
     "flink_ml_tpu/online",
     "flink_ml_tpu/ops",
     "flink_ml_tpu/parallel",

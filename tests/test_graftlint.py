@@ -197,7 +197,7 @@ def test_pass_catalog_covers_the_contract():
     ids = {cls.id for cls in ALL_PASSES}
     assert ids == {"host-sync", "atomic-writes", "donation-safety",
                    "lock-discipline", "collective-consistency",
-                   "kernel-registry", "bench-schema"}
+                   "kernel-registry", "unfenced-timing", "bench-schema"}
 
 
 # ---------------------------------------------------------------------------
@@ -598,6 +598,35 @@ def test_atomic_writes_pass_visits_aot_cache_modules():
         "listing is guarding nothing")
 
 
+def test_atomic_writes_pass_visits_obs_package():
+    """flink_ml_tpu/obs joined the durable roots (ISSUE 13): trace
+    exports must be tmp -> os.replace (they are the files an operator
+    loads after a crash), and the one sanctioned exception — the
+    sampler's line-framed JSONL append (torn tail dropped by
+    read_samples, the WAL-tail stance) — must be SEEN by the raw pass
+    and disarmed only by its inline suppression (suppression !=
+    blindness)."""
+    assert "flink_ml_tpu/obs" in AtomicWritesPass.roots
+    project = Project(repo=REPO)
+    visited = {os.path.relpath(m.path, REPO): m
+               for m in project.iter_modules(
+                   [os.path.join(REPO, "flink_ml_tpu", "obs")])}
+    names = {os.path.basename(p) for p in visited}
+    assert {"trace.py", "tree.py", "probe.py"} <= names
+    by_file = {rel: AtomicWritesPass().check_module(mod, project)
+               for rel, mod in visited.items()}
+    # the atomic export writes clear the pass outright
+    trace_rel = os.path.join("flink_ml_tpu", "obs", "trace.py")
+    assert by_file[trace_rel] == []
+    # the sampler append IS flagged raw, and the flag is suppressed
+    tree_rel = os.path.join("flink_ml_tpu", "obs", "tree.py")
+    raw = by_file[tree_rel]
+    assert {f.symbol for f in raw} == {"ObsSampler.sample"}
+    mod = visited[tree_rel]
+    for f in raw:
+        assert "atomic-writes" in mod.suppressions.get(f.line, set())
+
+
 def test_atomic_writes_pass_guards_durability_module():
     """robustness/durability.py joined the durable roots this PR; its
     two protocol-level exceptions are inline-suppressed, so the raw pass
@@ -889,3 +918,159 @@ def test_kernel_registry_scope_is_models_tree():
     assert p.scope_fixed and p.roots == ("flink_ml_tpu/models",)
     project = Project(repo=REPO)
     assert p.run(project, ["flink_ml_tpu"]) == []
+
+
+# ---------------------------------------------------------------------------
+# 2g. unfenced-timing (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def test_unfenced_timing_flags_bare_bracketing(tmp_path):
+    """The can't-fail seeded fixture: perf_counter brackets a jitted
+    call with no fence — the dispatch-enqueue-not-the-work bug bench.py
+    hand-dodged per leg before fenced_call."""
+    from scripts.graftlint.passes.unfenced_timing import UnfencedTimingPass
+
+    problems = _check(UnfencedTimingPass(), tmp_path, """\
+        import time
+        import jax
+
+        run = jax.jit(lambda x: x * 2)
+
+        def measure(x):
+            t0 = time.perf_counter()
+            y = run(x)
+            return time.perf_counter() - t0
+        """)
+    assert len(problems) == 1
+    assert "no device fence" in problems[0].message
+    assert problems[0].symbol == "measure"
+
+
+def test_unfenced_timing_accepts_fenced_forms(tmp_path):
+    """np.asarray probe fetch, jax.device_get, and fenced_call all
+    satisfy the fence; host-only timing (no jitted call inside the
+    bracket) is never flagged."""
+    from scripts.graftlint.passes.unfenced_timing import UnfencedTimingPass
+
+    problems = _check(UnfencedTimingPass(), tmp_path, """\
+        import time
+        import jax
+        import numpy as np
+
+        from flink_ml_tpu.utils.profiler import fenced_call
+
+        run = jax.jit(lambda x: x * 2)
+
+        def measure_probe(x):
+            t0 = time.perf_counter()
+            y = run(x)
+            np.asarray(y)
+            return time.perf_counter() - t0
+
+        def measure_get(x):
+            t0 = time.perf_counter()
+            y = run(x)
+            jax.device_get(y)
+            return time.perf_counter() - t0
+
+        def measure_fenced(x):
+            t0 = time.perf_counter()
+            y, s = fenced_call(run, x)
+            return time.perf_counter() - t0
+
+        def measure_host_only(rows):
+            t0 = time.perf_counter()
+            total = sum(range(rows))
+            return time.perf_counter() - t0
+        """)
+    assert problems == []
+
+
+def test_unfenced_timing_covers_decorator_and_direct_jit(tmp_path):
+    """@jax.jit / @partial(jax.jit, ...) defs and a direct
+    jax.jit(fn)(args) invocation are all jitted calls."""
+    from scripts.graftlint.passes.unfenced_timing import UnfencedTimingPass
+
+    problems = _check(UnfencedTimingPass(), tmp_path, """\
+        import time
+        from functools import partial
+
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step2(x):
+            return x + 2
+
+        def measure_decorated(x):
+            t0 = time.perf_counter()
+            y = step(x)
+            return time.perf_counter() - t0
+
+        def measure_partial(x):
+            t0 = time.perf_counter()
+            y = step2(x)
+            return time.perf_counter() - t0
+
+        def measure_direct(x):
+            t0 = time.perf_counter()
+            y = jax.jit(lambda v: v * 3)(x)
+            return time.perf_counter() - t0
+        """)
+    assert len(problems) == 3
+    assert {p.symbol for p in problems} == {
+        "measure_decorated", "measure_partial", "measure_direct"}
+
+
+def test_unfenced_timing_nested_defs_are_their_own_scope(tmp_path):
+    """A nested helper's bracket reports ONCE (in its own scope), and a
+    jitted call inside a never-called nested def does not poison the
+    enclosing function's host-only bracket."""
+    from scripts.graftlint.passes.unfenced_timing import UnfencedTimingPass
+
+    problems = _check(UnfencedTimingPass(), tmp_path, """\
+        import time
+        import jax
+
+        run = jax.jit(lambda x: x * 2)
+
+        def outer_with_bad_helper(x):
+            def measure(x):
+                t0 = time.perf_counter()
+                y = run(x)
+                return time.perf_counter() - t0
+
+            return measure(x)
+
+        def outer_host_bracket(x, items):
+            t0 = time.perf_counter()
+
+            def helper(v):
+                return run(v)          # defined, never called in-bracket
+
+            total = sum(items)
+            return time.perf_counter() - t0
+        """)
+    assert len(problems) == 1
+    assert problems[0].symbol == "outer_with_bad_helper.measure"
+
+
+def test_unfenced_timing_scope_and_repo_clean():
+    """Scope-fixed to the trees that publish measurements (bench.py +
+    obs/), and both are clean — the consolidation satellite actually
+    routed the hand-rolled copies through fenced_call."""
+    from scripts.graftlint.passes.unfenced_timing import UnfencedTimingPass
+
+    p = UnfencedTimingPass()
+    assert p.scope_fixed
+    assert set(p.roots) == {"bench.py", "flink_ml_tpu/obs"}
+    project = Project(repo=REPO)
+    assert [f.render() for f in p.run(project)] == []
+    # the walk genuinely visited both roots
+    scanned = {os.path.relpath(s, REPO) for s in project.scanned}
+    assert "bench.py" in scanned
+    assert any(s.startswith(os.path.join("flink_ml_tpu", "obs"))
+               for s in scanned)

@@ -114,6 +114,25 @@ def test_checker_covers_iteration_package():
         assert chs.check_file(path) == []
 
 
+def test_checker_covers_obs_package():
+    """ISSUE 13 satellite: the observability package joined the scanned
+    roots — the StepProbe's whole contract is zero host sync inside
+    step fns (its record/record_at ride scan/while carries on every
+    training hot path), so a device_get sneaking into a step-shaped
+    helper there would fence every adopter's dispatch stream at once.
+    Assert the root is registered AND that the walk actually visits its
+    modules (a registered-but-empty root would silently guard
+    nothing)."""
+    assert "flink_ml_tpu/obs" in chs.SCAN_ROOTS
+    visited = [p for p in chs._module_paths()
+               if os.sep + os.path.join("flink_ml_tpu", "obs") + os.sep
+               in p]
+    names = {os.path.basename(p) for p in visited}
+    assert {"probe.py", "trace.py", "tree.py"} <= names
+    for path in visited:
+        assert chs.check_file(path) == []
+
+
 def test_checker_covers_ops_package():
     """ISSUE 10 satellite: the ops/ kernel modules joined the scanned
     roots — the kernel registry routes every training hot path through

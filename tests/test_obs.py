@@ -1,0 +1,573 @@
+"""Tier-1 wiring for the unified observability layer (ISSUE 13).
+
+Four blocks:
+
+1. **Tracer mechanics** — ring wraparound, disabled-path no-op,
+   retroactive spans, Chrome-trace/JSONL export round trips.
+2. **One metrics tree** — every surface merges into one snapshot, the
+   Prometheus exposition parses line by line, the never-published
+   staleness gauge exports ABSENT (the ``-1`` sentinel regression),
+   the background sampler's JSONL survives a torn tail.
+3. **StepProbe** — device-side recording under jit/scan, one-transfer
+   fetch, masked-freeze parity (probe on/off bit-exact through the real
+   chunked fit), ServingMetrics edge cases.
+4. **THE acceptance** — one enabled tracer follows a correlation chain
+   from WAL ingest through checkpoint cut and delta publish to a served
+   request, in the exported trace; serving with tracing on adds ZERO
+   new XLA lowerings after warm-up.
+"""
+
+import json
+import math
+import os
+import re
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.obs import (
+    MetricsTree,
+    ObsSampler,
+    SpanTracer,
+    StepProbe,
+    default_tree,
+    prometheus_text,
+    read_samples,
+)
+from flink_ml_tpu.obs import trace as trace_mod
+from flink_ml_tpu.serving.metrics import ServingMetrics
+
+
+@pytest.fixture(autouse=True)
+def _quiet_global_tracer():
+    """Every test leaves the process-wide tracer disabled and empty."""
+    yield
+    trace_mod.tracer.disable()
+    trace_mod.tracer.clear()
+
+
+# ---------------------------------------------------------------------------
+# 1. tracer mechanics
+# ---------------------------------------------------------------------------
+
+def test_tracer_disabled_records_nothing():
+    t = SpanTracer(capacity=8)
+    assert t.span("a") is t.span("b")          # one shared no-op object
+    with t.span("a", op="x"):
+        pass
+    t.instant("b")
+    t.add("c", 0.0, 1.0)
+    assert t.spans() == [] and t.count == 0
+
+
+def test_tracer_ring_wraparound_keeps_newest():
+    t = SpanTracer(capacity=4).enable()
+    for i in range(6):
+        t.add(f"s{i}", 0.0, 0.1, step=i)
+    assert t.count == 6 and t.dropped == 2
+    assert [s.name for s in t.spans()] == ["s2", "s3", "s4", "s5"]
+
+
+def test_tracer_span_note_and_find():
+    t = SpanTracer(capacity=8).enable()
+    with t.span("serve", generation=1) as span:
+        span.note(request_id=7)
+    found = list(t.find("serve", request_id=7))
+    assert len(found) == 1 and found[0].ids["generation"] == 1
+    assert list(t.find("serve", request_id=99)) == []
+
+
+def test_chrome_export_round_trips(tmp_path):
+    t = SpanTracer(capacity=16).enable()
+    with t.span("outer", cat="serving", request_id=3):
+        t.instant("mark", window=5)
+    path = str(tmp_path / "trace.json")
+    n = t.export_chrome(path)
+    assert n == 2
+    loaded = json.load(open(path))
+    events = loaded["traceEvents"]
+    by_name = {e["name"]: e for e in events}
+    outer, mark = by_name["outer"], by_name["mark"]
+    # the Chrome-trace contract Perfetto loads: X events carry ts+dur,
+    # instants carry a scope, args hold the correlation ids
+    assert outer["ph"] == "X" and outer["dur"] >= 0
+    assert mark["ph"] == "i" and mark["s"] == "t"
+    assert outer["args"]["request_id"] == 3
+    assert mark["args"]["window"] == 5
+    assert all(e["ts"] >= 0 and e["pid"] == os.getpid() for e in events)
+    # the instant falls INSIDE the enclosing span's interval
+    assert outer["ts"] <= mark["ts"] <= outer["ts"] + outer["dur"]
+
+
+def test_jsonl_export_round_trips(tmp_path):
+    t = SpanTracer(capacity=8).enable()
+    t.add("a", 1.0, 2.0, step=4)
+    path = str(tmp_path / "trace.jsonl")
+    assert t.export_jsonl(path) == 1
+    lines = [json.loads(line) for line in open(path)]
+    assert lines[0]["name"] == "a" and lines[0]["step"] == 4
+    assert lines[0]["dur_s"] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# 2. the metrics tree
+# ---------------------------------------------------------------------------
+
+def _publish_once(m: ServingMetrics, generation=1, t0=1000.0):
+    m.on_publish(generation, mode="delta", payload_bytes=64, now=t0)
+
+
+def test_metrics_tree_merges_every_surface():
+    from flink_ml_tpu.kernels.registry import kernel_stats
+    from flink_ml_tpu.robustness.supervisor import RecoveryReport
+
+    m = ServingMetrics()
+    m.on_batch(n_requests=2, rows=3, bucket=8, latencies_s=[0.01, 0.02],
+               queue_depth=0, generation=1)
+    report = RecoveryReport(restarts=1, recovered=True)
+    stream_info = {"impl": "dense-stream",
+                   "step_trace": {"loss": np.asarray([1.0, 0.5])}}
+    tree = default_tree(serving=m, recovery=report,
+                        stream_info=stream_info, tracer=trace_mod.tracer)
+    snap = tree.snapshot()
+    assert snap["serving"]["requests"] == 2
+    assert snap["recovery"]["restarts"] == 1
+    assert snap["training"]["step_trace"]["loss"] == [1.0, 0.5]
+    assert snap["trace"]["enabled"] is False
+    assert snap["kernels"]["dispatches"] == kernel_stats.dispatches
+    assert "aot" in snap["kernels"] and "tuned_ops" in snap["kernels"]
+    json.dumps(snap)        # JSON-clean end to end (numpy normalized)
+
+
+def test_metrics_tree_provider_kinds_and_none():
+    tree = MetricsTree()
+    tree.register("fn", lambda: {"a": 1})
+    tree.register("ref", {"b": np.int64(2)})
+    tree.register("absent", lambda: None)
+    snap = tree.snapshot()
+    assert snap == {"fn": {"a": 1}, "ref": {"b": 2}}
+    with pytest.raises(TypeError, match="unsnapshotable"):
+        tree.register("bad", 42)
+
+
+_PROM_LINE = re.compile(
+    r"^(?:# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* gauge"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]* -?[0-9.eE+-]+(?:\.[0-9]+)?)$")
+
+
+def test_prometheus_exposition_parses():
+    """Every emitted line is either a TYPE comment or `name value` with
+    a legal metric name — the strict-parse half of the acceptance."""
+    m = ServingMetrics()
+    m.on_batch(n_requests=1, rows=1, bucket=8, latencies_s=[0.005],
+               queue_depth=0, generation=2)
+    text = prometheus_text(default_tree(serving=m).snapshot())
+    lines = text.strip().split("\n")
+    assert len(lines) >= 10
+    for line in lines:
+        assert _PROM_LINE.match(line), f"unparseable line: {line!r}"
+    # dotted MetricGroup keys flatten into legal names with values
+    assert re.search(
+        r"^flink_ml_tpu_serving_requests 1(\.0)?$", text, re.M)
+    # strings (health) are skipped, not mangled into bad samples
+    assert "SERVING" not in text
+
+
+def test_staleness_sentinel_never_exports_negative():
+    """ISSUE 13 satellite regression: never-published staleness is NaN
+    on the gauge and ABSENT from the exposition — not a fake ``-1``
+    age.  After a publish it exports as a real non-negative number."""
+    m = ServingMetrics()
+    m.touch_staleness()
+    assert math.isnan(m.staleness_seconds)
+    snap = m.snapshot()
+    assert math.isnan(snap["model_staleness_seconds"])
+    text = prometheus_text({"serving": m.group.snapshot()})
+    assert "model_staleness_seconds" not in text
+    assert "-1" not in text.split()
+    _publish_once(m, t0=1000.0)
+    m.touch_staleness(now=1002.5)
+    assert m.staleness_seconds == pytest.approx(2.5)
+    text = prometheus_text({"serving": m.group.snapshot()})
+    assert re.search(
+        r"^flink_ml_tpu_serving_model_staleness_seconds 2\.5$", text, re.M)
+
+
+def test_sampler_appends_and_survives_torn_tail(tmp_path):
+    path = str(tmp_path / "series.jsonl")
+    tree = MetricsTree().register("x", lambda: {"v": 1})
+    clock = iter([10.0, 11.0]).__next__
+    sampler = ObsSampler(tree, path, interval_s=60.0, clock=clock)
+    sampler.sample()
+    sampler.sample()
+    # crash mid-append: a torn final line is dropped by the reader
+    with open(path, "a") as f:
+        f.write('{"t": 12.0, "x": {"v"')
+    samples = read_samples(path)
+    assert [s["t"] for s in samples] == [10.0, 11.0]
+    assert samples[0]["x"] == {"v": 1}
+    assert sampler.samples_written == 2
+
+
+def test_sampler_mid_series_corruption_raises(tmp_path):
+    path = str(tmp_path / "series.jsonl")
+    with open(path, "w") as f:
+        f.write('{"t": 1}\nGARBAGE\n{"t": 2}\n')
+    with pytest.raises(ValueError, match="not the tail"):
+        read_samples(path)
+
+
+def test_sampler_background_thread_ticks(tmp_path):
+    import time as _time
+
+    path = str(tmp_path / "bg.jsonl")
+    tree = MetricsTree().register("x", lambda: {"v": 2})
+    sampler = ObsSampler(tree, path, interval_s=0.01).start()
+    deadline = _time.time() + 5.0
+    while sampler.samples_written < 2 and _time.time() < deadline:
+        _time.sleep(0.01)
+    sampler.stop()
+    assert len(read_samples(path)) >= 2
+
+
+# ---------------------------------------------------------------------------
+# 3a. ServingMetrics edge cases (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+def test_publishes_per_sec_ewma_first_publish():
+    """The FIRST publish has no predecessor interval: the rate gauge
+    must stay unset (no fake spike from a zero interval); the second
+    publish seeds the EWMA with the true instantaneous rate."""
+    m = ServingMetrics()
+    _publish_once(m, generation=1, t0=1000.0)
+    assert m.snapshot()["publishes_per_sec"] is None
+    m.on_publish(2, mode="delta", now=1002.0)
+    assert m.snapshot()["publishes_per_sec"] == pytest.approx(0.5)
+    m.on_publish(3, mode="delta", now=1004.0)        # EWMA stays put
+    assert m.snapshot()["publishes_per_sec"] == pytest.approx(0.5)
+
+
+def test_latency_ring_quantiles_at_wraparound():
+    """Past the window the ring holds exactly the newest ``window``
+    samples (write order irrelevant to quantiles): quantiles must match
+    numpy over that set, not over a stale prefix."""
+    from flink_ml_tpu.serving.metrics import LatencyTracker
+
+    tracker = LatencyTracker(window=8)
+    for v in range(1, 13):                 # 12 records, window 8
+        tracker.record(float(v))
+    assert tracker.count == 12
+    newest = np.asarray([5.0, 6, 7, 8, 9, 10, 11, 12])
+    p50, p99 = tracker.quantiles((0.5, 0.99))
+    assert p50 == pytest.approx(float(np.quantile(newest, 0.5)))
+    assert p99 == pytest.approx(float(np.quantile(newest, 0.99)))
+
+
+def test_kernel_gauges_republish_skips_if_unchanged():
+    """The kernels.* re-export refreshes only when the dispatch counter
+    moved — an idle endpoint's metric tick must not re-walk the
+    registry snapshot."""
+    from flink_ml_tpu.api.chain import StageKernel, run_kernel
+    from flink_ml_tpu.kernels.registry import kernel_stats
+
+    m = ServingMetrics()
+    m.publish()
+    sentinel = object()
+    gauge = m.group.add_group("kernels").gauge("dispatches")
+    gauge.set(sentinel)
+    m.publish()                            # counter unchanged -> skipped
+    assert gauge.value is sentinel
+    kernel = StageKernel(
+        fn=_double_fn, static=(), params=None,
+        consumes=("obs_col",), produces=("obs_out",))
+    run_kernel(kernel, Table({"obs_col": np.ones((4,), np.float32)}),
+               op="_obs_gauge_op")
+    m.publish()                            # counter moved -> refreshed
+    assert gauge.value == kernel_stats.dispatches
+
+
+def _double_fn(static, params, cols):
+    return {"obs_out": cols["obs_col"] * 2.0}
+
+
+# ---------------------------------------------------------------------------
+# 3b. StepProbe
+# ---------------------------------------------------------------------------
+
+def test_probe_records_under_scan_and_fetches_once():
+    import jax
+    import jax.numpy as jnp
+
+    probe = StepProbe.create(("loss", "grad_norm"), 4)
+
+    @jax.jit
+    def run(probe, xs):
+        def step(p, x):
+            return p.record(loss=x, grad_norm=x * 2), None
+
+        p, _ = jax.lax.scan(step, probe, xs)
+        return p
+
+    out = run(probe, jnp.arange(3, dtype=jnp.float32))
+    got = out.fetch()
+    np.testing.assert_array_equal(got["loss"], [0.0, 1.0, 2.0])
+    np.testing.assert_array_equal(got["grad_norm"], [0.0, 2.0, 4.0])
+    fresh = out.reset().fetch()
+    assert fresh["loss"].shape == (0,)
+
+
+def test_probe_partial_channels_and_validation():
+    probe = StepProbe.create(("a", "b"), 2)
+    got = probe.record(a=1.0).fetch()
+    assert got["a"][0] == 1.0 and math.isnan(got["b"][0])
+    with pytest.raises(ValueError, match="unknown probe channel"):
+        probe.record(c=1.0)
+    with pytest.raises(ValueError, match="duplicate"):
+        StepProbe.create(("a", "a"), 2)
+    # past-capacity records drop instead of corrupting the buffer
+    full = probe.record(a=1.0).record(a=2.0).record(a=3.0)
+    np.testing.assert_array_equal(full.fetch()["a"], [1.0, 2.0])
+
+
+def test_probe_rides_pytree_boundaries():
+    import jax
+
+    probe = StepProbe.create(("loss",), 3).record(loss=7.0)
+    leaves, treedef = jax.tree_util.tree_flatten(probe)
+    rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert rebuilt.names == ("loss",) and rebuilt.capacity == 3
+    np.testing.assert_array_equal(rebuilt.fetch()["loss"], [7.0])
+
+
+def test_chunked_fit_step_probe_bitexact_and_traced():
+    """sgd_fit_outofcore(step_probe=True): the probe changes NOTHING
+    about the result (bit-exact params + loss log vs probe-off on the
+    same stream) and stream_info carries the full per-step loss series
+    across chunk boundaries, padded tail excluded."""
+    from flink_ml_tpu.models.common.losses import squared_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    def mk():
+        rng = np.random.default_rng(7)
+
+        def make_reader():
+            for _ in range(10):           # 10 batches, W=4 -> padded tail
+                X = rng.normal(size=(16, 4)).astype(np.float32)
+                yield {"features": X,
+                       "label": (X @ np.arange(1, 5)).astype(np.float32)}
+
+        return make_reader
+
+    cfg = SGDConfig(max_epochs=2, tol=0.0)
+    info: dict = {}
+    s1, log1 = sgd_fit_outofcore(squared_loss, mk(), num_features=4,
+                                 config=cfg, steps_per_dispatch=4,
+                                 stream_info=info, step_probe=True)
+    s2, log2 = sgd_fit_outofcore(squared_loss, mk(), num_features=4,
+                                 config=cfg, steps_per_dispatch=4)
+    assert s1.coefficients.tobytes() == s2.coefficients.tobytes()
+    assert log1 == log2
+    trace = info["step_trace"]["loss"]
+    assert trace.shape == (20,)           # 10 steps x 2 epochs, no pad
+    assert np.all(np.isfinite(trace))
+    # the per-step series is consistent with the epoch aggregate
+    assert np.mean(trace[:10]) == pytest.approx(log1[0], rel=1e-5)
+
+
+def test_chunked_fit_probe_lowerings_do_not_scale_with_chunks():
+    """The probe rides the ONE chunk-scan program and its per-chunk
+    fetch/reset are transfers + cached tiny ops, not new programs: a
+    warmed probed fit lowers the same count at 1 epoch and at 4 (12
+    chunk dispatches) — zero per-chunk/per-epoch retraces with the
+    probe attached."""
+    from jax._src import test_util as jtu
+
+    from flink_ml_tpu.models.common.losses import squared_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    def mk():
+        rng = np.random.default_rng(5)
+
+        def make_reader():
+            for _ in range(8):
+                X = rng.normal(size=(16, 4)).astype(np.float32)
+                yield {"features": X,
+                       "label": (X @ np.arange(1, 5)).astype(np.float32)}
+
+        return make_reader
+
+    def lowerings(epochs: int) -> int:
+        cfg = SGDConfig(max_epochs=epochs, tol=0.0)
+        with jtu.count_jit_and_pmap_lowerings() as count:
+            sgd_fit_outofcore(squared_loss, mk(), num_features=4,
+                              config=cfg, steps_per_dispatch=4,
+                              cache_decoded=False, step_probe=True)
+        return count[0]
+
+    lowerings(1)                          # one-time compiles warm here
+    assert lowerings(1) == lowerings(4)
+
+
+def test_step_probe_refused_off_the_chunked_path():
+    from flink_ml_tpu.models.common.losses import squared_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+    from flink_ml_tpu.parallel.mesh import default_mesh
+
+    mesh = default_mesh()
+    if int(np.prod(list(mesh.shape.values()))) == mesh.devices.size \
+            and len(set(d.process_index for d in mesh.devices.flat)) == 1:
+        pytest.skip("single-process mesh: the chunked path engages")
+    with pytest.raises(ValueError, match="chunked single-process"):
+        sgd_fit_outofcore(squared_loss, lambda: iter(()), num_features=4,
+                          config=SGDConfig(max_epochs=1), mesh=mesh,
+                          step_probe=True)
+
+
+def test_fused_iterate_epoch_trace_still_reports():
+    """The PR 9 epoch-trace surface survived the StepProbe port: fused
+    workset iterations still surface trimmed active-fraction /
+    termination curves."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.iteration import (
+        IterationBodyResult,
+        IterationConfig,
+        Workset,
+        iterate,
+    )
+
+    def body(state, ws, epoch, data):
+        new = state + ws.mask
+        return IterationBodyResult(
+            (new, Workset((new < data).astype(jnp.float32), ws.bounds)))
+
+    res = iterate(body, jnp.zeros(4), jnp.asarray([1.0, 2.0, 3.0, 2.0]),
+                  max_epochs=8, workset=Workset(jnp.ones(4, jnp.float32)),
+                  config=IterationConfig(mode="fused"))
+    trace = res.side["epoch_trace"]
+    assert trace["active_fraction"].shape == (res.num_epochs,)
+    assert np.all(np.isfinite(trace["active_fraction"]))
+    assert res.num_epochs < 8             # drained before max_epochs
+
+
+# ---------------------------------------------------------------------------
+# 4. THE acceptance: end-to-end correlation + zero new lowerings
+# ---------------------------------------------------------------------------
+
+def _windows(start, stop, rows=16, d=4):
+    for i in range(start, stop):
+        rng = np.random.default_rng(1000 + i)
+        X = rng.normal(size=(rows, d)).astype(np.float32)
+        yield Table({"features": X,
+                     "label": (X[:, 0] > 0).astype(np.float32)})
+
+
+def test_trace_correlates_wal_cut_publish_and_request(tmp_path):
+    """One enabled tracer, one correlation chain: WAL window N ->
+    checkpoint cut T -> delta publish (step T, generation G) ->
+    generation G served request R — all present and joinable in the
+    exported Chrome trace."""
+    from flink_ml_tpu.iteration.checkpoint import CheckpointConfig
+    from flink_ml_tpu.models.classification.logisticregression import (
+        LogisticRegression,
+    )
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.online import ContinuousLearner
+    from flink_ml_tpu.serving import serve_model
+
+    windows = list(_windows(0, 8))
+    boot = LogisticRegression().set_max_iter(1).fit(windows[0])
+    endpoint = serve_model(boot, windows[0].drop("label").take(2),
+                           max_batch_rows=32, max_wait_ms=0.5)
+    tracer = trace_mod.tracer
+    try:
+        tracer.enable()
+        learner = ContinuousLearner(
+            loss_fn=logistic_loss, num_features=4,
+            source=iter(windows), wal_dir=str(tmp_path / "wal"),
+            endpoint=endpoint, batch_rows=16,
+            checkpoint=CheckpointConfig(str(tmp_path / "ck")),
+            publish_every_steps=4)
+        learner.run(max_windows=8)
+        out = endpoint.predict(windows[3].drop("label"))
+        assert out.num_rows == 16
+        tracer.disable()
+
+        # -- the chain, link by link -----------------------------------
+        wal = sorted(s.ids["window"] for s in tracer.find("wal_append"))
+        assert wal == list(range(8))
+        cuts = {s.ids["step"] for s in tracer.find("checkpoint_write")}
+        assert {4, 8} <= cuts
+        publishes = list(tracer.find("delta_publish"))
+        pub_by_step = {s.ids["step"]: s for s in publishes}
+        assert {4, 8} <= set(pub_by_step)
+        # every publish's cut step has a checkpoint span (never serve
+        # ahead of durable) and the WAL holds exactly the windows the
+        # cut covers (one window = one step on the fixed grid)
+        for step, span in pub_by_step.items():
+            assert step in cuts
+            assert {w for w in wal if w < step} == set(range(step))
+            assert "generation" in span.ids
+        live_gen = pub_by_step[8].ids["generation"]
+        served = [s for s in tracer.find("request")
+                  if s.ids.get("generation") == live_gen]
+        assert served, "no request span on the published generation"
+        assert all("request_id" in s.ids for s in served)
+        # supporting spans of the request path showed up too
+        assert any(tracer.find("queue_wait"))
+        assert any(tracer.find("serve_batch"))
+        assert any(tracer.find("train_chunk"))
+        assert any(tracer.find("train_epoch"))
+
+        # -- export round trip -----------------------------------------
+        path = str(tmp_path / "trace.json")
+        n = tracer.export_chrome(path)
+        events = json.load(open(path))["traceEvents"]
+        assert len(events) == n
+        names = {e["name"] for e in events}
+        assert {"wal_append", "checkpoint_write", "delta_publish",
+                "request"} <= names
+        pub_ev = [e for e in events if e["name"] == "delta_publish"
+                  and e["args"].get("step") == 8]
+        assert pub_ev and pub_ev[0]["args"]["generation"] == live_gen
+    finally:
+        tracer.disable()
+        tracer.clear()
+        endpoint.close()
+
+
+def test_serving_with_tracing_adds_zero_lowerings():
+    """Tracing is pure host bookkeeping: enabling it on a warmed
+    endpoint compiles NOTHING (lowering-counter asserted) while the
+    request-path spans all appear."""
+    from jax._src import test_util as jtu
+
+    from flink_ml_tpu.models.classification.logisticregression import (
+        LogisticRegression,
+    )
+    from flink_ml_tpu.serving import serve_model
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(48, 6)).astype(np.float32)
+    train = Table({"features": X, "label": (X[:, 0] > 0).astype(np.float64)})
+    model = LogisticRegression().set_max_iter(2).fit(train)
+    feats = Table({"features": X})
+    endpoint = serve_model(model, feats.take(2), max_batch_rows=64,
+                           max_wait_ms=0.5)
+    tracer = trace_mod.tracer
+    try:
+        endpoint.predict(feats.take(5))           # tracing off, warm
+        tracer.enable()
+        with jtu.count_jit_and_pmap_lowerings() as count:
+            endpoint.predict(feats.take(5))
+        assert count[0] == 0, (
+            f"{count[0]} new lowerings with tracing enabled — the "
+            "tracer leaked into a traced program")
+        for name in ("queue_wait", "serve_batch", "request",
+                     "registry_dispatch", "device_execute", "bucket_pad"):
+            assert any(tracer.find(name)), f"missing span {name!r}"
+    finally:
+        tracer.disable()
+        tracer.clear()
+        endpoint.close()
