@@ -2871,6 +2871,556 @@ def bench_obs(results: dict) -> None:
         obs["probe_error"] = repr(exc)[:200]
 
 
+def bench_multitenant(results: dict) -> None:
+    """Multi-tenant serving leg (multitenant_metric_version 1, ISSUE 14):
+    the shared scheduler under contention, closed-loop with a zipfian
+    tenant/key mix and a diurnal bulk ramp.  Within-run A/Bs (the
+    phase-independent ratio discipline), every variant compiled+warmed
+    before either is timed:
+
+    - **Contention**: interactive-class p99 alone vs with 8 contending
+      bulk tenants on the same scheduler (headline ratio; acceptance
+      <= 2x), vs the same interleaved traffic through one unbounded
+      FIFO endpoint (no classes, no WFQ — what the ratio is measured
+      against).
+    - **Admission**: tenants 2..9 share tenant 1's schema — the
+      admission must be compilation-free (warm-up source attribution
+      summed, plus the XLA lowering counter across the LAST admission).
+    - **Shed order**: a small-capacity scheduler under interleaved
+      overload — sheds must be 100% bulk-class before any interactive
+      shed.
+    - **Publish isolation**: tenant B's p99 while tenant A takes
+      continuous delta publishes vs while it doesn't (the PR 7 chaos
+      target: ratio within run-to-run noise), with zero dropped
+      requests.
+    - **Embedding cache**: WideDeep zipfian key mix through the
+      device-resident row-block cache — hit rate headline (acceptance
+      > 0.8 on the zipfian mix).
+    - **Shed fast path**: the lock-free overload check A/B (4 threads
+      hammering a saturated queue, fast path on vs off) — the
+      MicroBatcher satellite's evidence.
+
+    Measured fields are null, never faked, when a sub-leg fails."""
+    import threading
+
+    from jax._src import test_util as jtu
+
+    from flink_ml_tpu import Table
+    from flink_ml_tpu.models.classification.logisticregression import (
+        LogisticRegressionModel)
+    from flink_ml_tpu.serving import (MicroBatcher, ModelRegistry,
+                                      ServingEndpoint,
+                                      ServingOverloadedError,
+                                      SharedScheduler, make_servable)
+
+    mt: dict = {
+        "multitenant_metric_version": 1,
+        "config": "LR d=32 x 9 tenants (1 interactive + 8 bulk, zipfian "
+                  "mix), max_batch_rows=128, bulk cap 8, max_wait_ms=0.5; WideDeep "
+                  "vocab 4096+1024, block_rows=64",
+        "p99_interactive_alone_ms": None,
+        "p99_interactive_contended_ms": None,
+        "p99_interactive_fifo_ms": None,
+        "fifo_vs_scheduler_ratio": None,
+        "fifo_interactive_sheds": None,
+        "admit_compiles_tenant1": None,
+        "admit_compiles_tenants_2_to_9": None,
+        "admit_zero_lowerings": None,
+        "shed_counts": None,
+        "publish_p99_before_ms": None,
+        "publish_p99_during_ms": None,
+        "publishes_during": None,
+        "publish_dropped_requests": None,
+        "emb_cache": None,
+        "shed_fastpath": None,
+        "ramp": None,
+    }
+    results["notes"]["multitenant"] = mt
+    # headline fields: pre-nulled at leg entry, never faked
+    results.setdefault("multitenant_contended_p99_ratio", None)
+    results.setdefault("multitenant_shed_bulk_only", None)
+    results.setdefault("multitenant_publish_p99_ratio", None)
+    results.setdefault("emb_cache_hit_rate", None)
+
+    d = 32
+    rng = np.random.default_rng(41)
+
+    def lr_model(seed):
+        m = LogisticRegressionModel()
+        mrng = np.random.default_rng(seed)
+        m.set_model_data(Table({
+            "coefficients": mrng.normal(size=(1, d)),
+            "intercept": np.array([0.1])}))
+        return m
+
+    feats = Table({"features": rng.normal(size=(1024, d))
+                   .astype(np.float32)})
+
+    import gc
+    import sys
+
+    # latency-sensitive serving tuning, both restored in the leg's
+    # finally: (a) the default 5 ms GIL switch interval lets one flood
+    # thread hold the interpreter for longer than the whole p99 budget
+    # on a 1-core smoke host; (b) a gen-2 GC pause lands as a
+    # multi-ms p99 outlier in whichever variant it happens to hit —
+    # the same two knobs a real single-core serving deployment sets.
+    old_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.0005)
+    mt["gil_switch_interval_s"] = 0.0005
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+
+    # -- admission + contention on ONE scheduler -----------------------------
+    # bulk_batch_rows=8: a dispatched batch is not preemptible, so the
+    # bulk cap bounds the worst head-of-line block an interactive
+    # arrival eats — 8 rows keeps it at a single bucket-8 dispatch at
+    # this shape (swept 8-128; one bucket-8 request per bulk batch makes
+    # the non-preemptible bulk quantum ~ one interactive service time)
+    sched = SharedScheduler(max_batch_rows=128, max_wait_ms=0.5,
+                            queue_capacity=1 << 13, bulk_batch_rows=8)
+    try:
+        t1 = sched.add_tenant("inter", lr_model(0), feats.take(2),
+                              slo="interactive")
+        mt["admit_compiles_tenant1"] = t1.admission_report["compiled"]
+        later_compiles = 0
+        for i in range(7):
+            t = sched.add_tenant(f"bulk{i}", lr_model(i + 1),
+                                 feats.take(2), slo="bulk")
+            later_compiles += t.admission_report["compiled"]
+        with jtu.count_jit_and_pmap_lowerings() as count:
+            t9 = sched.add_tenant("bulk7", lr_model(8), feats.take(2),
+                                  slo="bulk")
+        later_compiles += t9.admission_report["compiled"]
+        mt["admit_compiles_tenants_2_to_9"] = later_compiles
+        mt["admit_zero_lowerings"] = int(count[0]) == 0
+        sched.start()
+
+        bulk_names = [f"bulk{i}" for i in range(8)]
+        # zipfian tenant mix: bulk tenant i takes share ~ 1/(i+1)
+        zipf_w = 1.0 / (np.arange(8) + 1.0)
+        zipf_w /= zipf_w.sum()
+
+        def interactive_load(n_clients=2, per_client=200,
+                             samples=None):
+            """Paced closed-loop interactive clients; returns p99 ms
+            (and extends ``samples`` with the raw latencies when
+            given — the pooled-pairs A/B below)."""
+            latencies: list = []
+            errors: list = []
+            lock = threading.Lock()
+
+            def client(worker):
+                crng = np.random.default_rng(100 + worker)
+                mine = []
+                try:
+                    for _ in range(per_client):
+                        start = int(crng.integers(0, 1000))
+                        rows = int(crng.integers(1, 5))
+                        req = feats.slice(start, start + rows)
+                        t0 = time.perf_counter()
+                        sched.predict("inter", req, timeout=120)
+                        mine.append(time.perf_counter() - t0)
+                        # paced closed loop: a user clicking, not a
+                        # saturating spin — keeps the p99 measuring
+                        # the serving fabric instead of the client's
+                        # own GIL self-queueing on the 1-core host
+                        time.sleep(0.001)
+                except Exception as exc:   # noqa: BLE001
+                    with lock:
+                        errors.append(repr(exc)[:200])
+                with lock:
+                    latencies.extend(mine)
+
+            threads = [threading.Thread(target=client, args=(w,))
+                       for w in range(n_clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(300)
+            if errors:
+                raise RuntimeError(f"interactive client lost: {errors[:3]}")
+            if samples is not None:
+                samples.extend(latencies)
+            return round(1e3 * float(np.quantile(
+                np.asarray(latencies), 0.99)), 3)
+
+        def bulk_flood(stop, n_clients):
+            """Open bulk load above service capacity: each client
+            bursts 8-row requests at zipfian-picked tenants, sleeping
+            only between bursts/sheds — the bulk queue saturates to its
+            class threshold and STAYS there (sheds expected; the fast
+            path makes them cheap).  All 8 bulk TENANTS stay backlogged
+            from few flood threads — tenant-level contention without
+            drowning the 1-core smoke host in GIL churn that would
+            measure the OS scheduler instead of this one."""
+            def client(worker):
+                crng = np.random.default_rng(500 + worker)
+                while not stop.is_set():
+                    shed = False
+                    for _ in range(4):
+                        name = bulk_names[int(crng.choice(8, p=zipf_w))]
+                        start = int(crng.integers(0, 900))
+                        try:
+                            sched.submit(name,
+                                         feats.slice(start, start + 8))
+                        except (ServingOverloadedError, RuntimeError):
+                            shed = True
+                    time.sleep(0.001 if shed else 0.0005)
+
+            threads = [threading.Thread(target=client, args=(w,),
+                                        daemon=True)
+                       for w in range(n_clients)]
+            for t in threads:
+                t.start()
+            return threads
+
+        # warm every path both variants touch before ANY timing
+        interactive_load(n_clients=2, per_client=8)
+        stop = threading.Event()
+        flood = bulk_flood(stop, 2)
+        try:
+            interactive_load(n_clients=2, per_client=8)
+        finally:
+            stop.set()
+            for t in flood:
+                t.join(10)
+
+        ramp = []
+        for phase, n_bulk in (("low", 1), ("high", 2)):   # diurnal ramp
+            stop = threading.Event()
+            flood = bulk_flood(stop, n_bulk)
+            try:
+                p99 = interactive_load(per_client=100)
+            finally:
+                stop.set()
+                for t in flood:
+                    t.join(10)
+            ramp.append({"phase": phase, "bulk_clients": n_bulk,
+                         "p99_interactive_ms": p99})
+        mt["ramp"] = ramp
+
+        # headline A/B: ALTERNATING alone/contended pairs — on a 1-core
+        # smoke host a single scheduling hiccup lands as a p99 outlier
+        # in whichever variant it hits; alternating and pooling is the
+        # within-run discipline that survives it (the comm-leg
+        # warm-both-then-time stance, extended)
+        pairs = []
+        alone_samples: list = []
+        contended_samples: list = []
+        for _ in range(4):
+            alone = interactive_load(samples=alone_samples)
+            stop = threading.Event()
+            flood = bulk_flood(stop, 2)
+            try:
+                # settle: the flood's queue-FILL transient (no sheds
+                # yet -> no shed-sleeps -> max submit churn) is not the
+                # steady contention under measurement
+                time.sleep(0.25)
+                contended = interactive_load(samples=contended_samples)
+            finally:
+                stop.set()
+                for t in flood:
+                    t.join(10)
+            pairs.append({"alone_ms": alone, "contended_ms": contended,
+                          "ratio": round(contended / alone, 3)})
+        mt["contention_pairs"] = pairs
+        # the headline ratio comes from the POOLED samples (4 x 400 per
+        # variant): a per-pair p99 is 4 samples from its tail, and a
+        # ratio of two of those is OS-jitter noise on a 1-core host
+        alone_p99 = round(1e3 * float(np.quantile(
+            np.asarray(alone_samples), 0.99)), 3)
+        contended_p99 = round(1e3 * float(np.quantile(
+            np.asarray(contended_samples), 0.99)), 3)
+        mt["p99_interactive_alone_ms"] = alone_p99
+        mt["p99_interactive_contended_ms"] = contended_p99
+        results["multitenant_contended_p99_ratio"] = round(
+            contended_p99 / alone_p99, 3)
+
+        # -- publish isolation: delta pushes to bulk0 while inter serves --
+        publishes = [0]
+        pub_errors: list = []
+
+        def publisher(stop):
+            # a realistic continuous-learning cadence (~50 publishes/s;
+            # bench_online measures raw publish cost separately) — the
+            # question here is whether tenant A's publishes move tenant
+            # B's p99, not how fast the 1-core host can spin rebinds
+            models = (lr_model(1), lr_model(101))
+            try:
+                while not stop.is_set():
+                    live = sched.registry.current("bulk0")
+                    nxt = models[(publishes[0] + 1) % 2]
+                    sched.registry.publish_servable(
+                        "bulk0", live.servable.rebind(nxt),
+                        metrics=sched.tenant("bulk0").metrics,
+                        mode="delta")
+                    publishes[0] += 1
+                    time.sleep(0.02)
+            except Exception as exc:   # noqa: BLE001
+                pub_errors.append(repr(exc)[:200])
+
+        pub_pairs = []
+        before_samples: list = []
+        during_samples: list = []
+        for _ in range(3):
+            before = interactive_load(n_clients=2, per_client=100,
+                                      samples=before_samples)
+            stop = threading.Event()
+            pub = threading.Thread(target=publisher, args=(stop,),
+                                   daemon=True)
+            pub.start()
+            try:
+                during = interactive_load(n_clients=2, per_client=100,
+                                          samples=during_samples)
+            finally:
+                stop.set()
+                pub.join(10)
+            pub_pairs.append({"before_ms": before, "during_ms": during,
+                              "ratio": round(during / before, 3)})
+        if not pub_errors:
+            mt["publish_pairs"] = pub_pairs
+            before_p99 = round(1e3 * float(np.quantile(
+                np.asarray(before_samples), 0.99)), 3)
+            during_p99 = round(1e3 * float(np.quantile(
+                np.asarray(during_samples), 0.99)), 3)
+            mt["publish_p99_before_ms"] = before_p99
+            mt["publish_p99_during_ms"] = during_p99
+            mt["publishes_during"] = publishes[0]
+            mt["publish_dropped_requests"] = 0   # interactive_load raises
+            #                                      on any lost client
+            results["multitenant_publish_p99_ratio"] = round(
+                during_p99 / before_p99, 3)
+        else:
+            mt["publish_error"] = pub_errors[0]
+    except Exception as exc:   # noqa: BLE001 — nulled, never faked
+        mt["contention_error"] = repr(exc)[:200]
+    finally:
+        sched.close()
+
+    # -- baseline topology: one endpoint per model, no coordination ----------
+    # the topology the scheduler replaces (PR 2): every tenant owns an
+    # endpoint with its own batcher, queue, and serve thread — nine
+    # uncoordinated FIFO loops time-slicing one device with no classes,
+    # no priorities, no cross-tenant fairness.  Same models, same
+    # request streams as the scheduler's high phase.
+    try:
+        endpoints = {}
+        for i, name in enumerate(["inter"] + bulk_names):
+            reg = ModelRegistry()
+            reg.deploy(name, lr_model(i), feats.take(2),
+                       max_batch_rows=128)
+            endpoints[name] = ServingEndpoint(
+                reg, name, max_batch_rows=128, max_wait_ms=0.5,
+                queue_capacity=4096).start()
+        stop = threading.Event()
+        try:
+            def fifo_bulk(worker):
+                crng = np.random.default_rng(900 + worker)
+                while not stop.is_set():
+                    shed = False
+                    for _ in range(4):       # the bulk_flood burst shape
+                        name = bulk_names[int(crng.choice(8, p=zipf_w))]
+                        start = int(crng.integers(0, 900))
+                        try:
+                            endpoints[name].submit(
+                                feats.slice(start, start + 8))
+                        except (ServingOverloadedError, RuntimeError):
+                            shed = True
+                    time.sleep(0.001 if shed else 0.0005)
+
+            fifo_sheds = [0]
+
+            def fifo_interactive():
+                latencies: list = []
+                lock = threading.Lock()
+                errors: list = []
+
+                def client(worker):
+                    crng = np.random.default_rng(100 + worker)
+                    mine = []
+                    try:
+                        # an interactive request shed by ITS endpoint
+                        # (per-endpoint FIFO has no cross-tenant view)
+                        # retries until served; latency runs from the
+                        # FIRST attempt — what the user waiting on the
+                        # click experiences
+                        for _ in range(50):
+                            start = int(crng.integers(0, 1000))
+                            rows = int(crng.integers(1, 5))
+                            req = feats.slice(start, start + rows)
+                            t0 = time.perf_counter()
+                            while True:
+                                try:
+                                    endpoints["inter"].predict(
+                                        req, timeout=120)
+                                    break
+                                except ServingOverloadedError:
+                                    with lock:
+                                        fifo_sheds[0] += 1
+                                    time.sleep(0.002)
+                            mine.append(time.perf_counter() - t0)
+                            time.sleep(0.001)   # the same pacing as
+                            #                     the scheduler sweep
+                    except Exception as exc:   # noqa: BLE001
+                        with lock:
+                            errors.append(repr(exc)[:200])
+                    with lock:
+                        latencies.extend(mine)
+
+                threads = [threading.Thread(target=client, args=(w,),
+                                            daemon=True)
+                           for w in range(2)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(300)
+                if errors:
+                    raise RuntimeError(f"fifo client lost: {errors[:3]}")
+                return round(1e3 * float(np.quantile(
+                    np.asarray(latencies), 0.99)), 3)
+
+            fifo_interactive()                   # warm
+            flood = [threading.Thread(target=fifo_bulk, args=(w,),
+                                      daemon=True)
+                     for w in range(2)]          # same load as the
+            #                                      scheduler's high phase
+            for t in flood:
+                t.start()
+            try:
+                mt["p99_interactive_fifo_ms"] = fifo_interactive()
+            finally:
+                stop.set()
+                for t in flood:
+                    t.join(10)
+            mt["fifo_interactive_sheds"] = fifo_sheds[0]
+            if mt["p99_interactive_contended_ms"]:
+                mt["fifo_vs_scheduler_ratio"] = round(
+                    mt["p99_interactive_fifo_ms"]
+                    / mt["p99_interactive_contended_ms"], 3)
+        finally:
+            stop.set()
+            for ep in endpoints.values():
+                ep.close()
+    except Exception as exc:   # noqa: BLE001 — nulled, never faked
+        mt["fifo_error"] = repr(exc)[:200]
+
+    # -- shed order under interleaved overload -------------------------------
+    try:
+        small = SharedScheduler(max_batch_rows=64, queue_capacity=64)
+        small.add_tenant("i", lr_model(0), feats.take(2),
+                         slo="interactive")
+        small.add_tenant("b", lr_model(1), feats.take(2), slo="bulk")
+        # NOT started: pure admission against a filling queue (the
+        # contract under test is shed ORDER, not drain throughput)
+        crng = np.random.default_rng(77)
+        shed_seq = []
+        for _ in range(200):
+            name = "b" if crng.random() < 0.6 else "i"
+            try:
+                small.submit(name, feats.take(1))
+            except ServingOverloadedError:
+                shed_seq.append(name)
+        counts = small.shed_counts()
+        mt["shed_counts"] = counts
+        first_interactive_shed = (shed_seq.index("i")
+                                  if "i" in shed_seq else None)
+        bulk_before = (all(s == "b" for s in
+                           shed_seq[:first_interactive_shed])
+                       if first_interactive_shed is not None else True)
+        results["multitenant_shed_bulk_only"] = bool(
+            counts["bulk"] > 0 and bulk_before)
+        small.close()
+    except Exception as exc:   # noqa: BLE001
+        mt["shed_error"] = repr(exc)[:200]
+
+    # -- embedding-row cache on the zipfian key mix --------------------------
+    try:
+        from flink_ml_tpu.models.recommendation.widedeep import WideDeep
+
+        vocab = (4096, 1024)
+        n = 512
+        wrng = np.random.default_rng(13)
+
+        def zipf_ids(size, v, a=1.3):
+            return ((wrng.zipf(a, size=size) - 1) % v).astype(np.int32)
+
+        dense = wrng.normal(size=(n, 8)).astype(np.float32)
+        cat = np.stack([zipf_ids(n, v) for v in vocab],
+                       axis=1).astype(np.int32)
+        label = (cat[:, 0] < 8).astype(np.int64)
+        train = Table({"denseFeatures": dense, "catFeatures": cat,
+                       "label": label})
+        model = (WideDeep().set_vocab_sizes(list(vocab))
+                 .set_max_iter(1).fit(train))
+        servable = make_servable(
+            model, train.drop("label").take(2), emb_cache=True,
+            cache_block_rows=64, cache_capacity_blocks=20,
+            max_batch_rows=64)
+        servable.warm_up()
+        cache = servable.cache
+        cache.reset_counters()   # warm-up faults are not traffic
+        for _ in range(200):
+            rows = int(wrng.integers(1, 9))
+            req = Table({
+                "denseFeatures": wrng.normal(size=(rows, 8))
+                .astype(np.float32),
+                "catFeatures": np.stack(
+                    [zipf_ids(rows, v) for v in vocab], axis=1)})
+            servable.predict(req)
+        snap = cache.snapshot()
+        mt["emb_cache"] = snap
+        results["emb_cache_hit_rate"] = snap["hit_rate"]
+    except Exception as exc:   # noqa: BLE001
+        mt["emb_cache_error"] = repr(exc)[:200]
+
+    # -- shed fast-path A/B (MicroBatcher satellite) -------------------------
+    try:
+        def shed_wall(fast):
+            batcher = MicroBatcher(max_batch_rows=8, queue_capacity=2)
+            for _ in range(2):
+                batcher.submit(feats.take(1))     # saturate
+            batcher.fast_shed = fast
+            per_thread = 4000
+            barrier = threading.Barrier(4 + 1)
+
+            def hammer():
+                barrier.wait()
+                req = feats.take(1)
+                for _ in range(per_thread):
+                    try:
+                        batcher.submit(req)
+                    except ServingOverloadedError:
+                        pass
+
+            threads = [threading.Thread(target=hammer) for _ in range(4)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join(60)
+            return time.perf_counter() - t0
+
+        shed_wall(True)                            # warm both paths
+        shed_wall(False)
+        locked_s = shed_wall(False)
+        fast_s = shed_wall(True)
+        mt["shed_fastpath"] = {
+            "locked_wall_s": round(locked_s, 4),
+            "fastpath_wall_s": round(fast_s, 4),
+            "speedup": round(locked_s / fast_s, 3),
+            "sheds_per_variant": 4 * 4000,
+        }
+    except Exception as exc:   # noqa: BLE001
+        mt["shed_fastpath_error"] = repr(exc)[:200]
+    finally:
+        sys.setswitchinterval(old_switch)
+        if gc_was_enabled:
+            gc.enable()
+            gc.collect()
+
+
 def main() -> None:
     tpu_ok = _probe_tpu_backend()
     if not tpu_ok:
@@ -2909,7 +3459,8 @@ def main() -> None:
                 bench_workset, bench_widedeep, bench_als, bench_gbt,
                 bench_online_ftrl, bench_serving, bench_pipeline,
                 bench_comm, bench_wal, bench_recovery, bench_online,
-                bench_kernels, bench_coldstart, bench_obs):
+                bench_kernels, bench_coldstart, bench_obs,
+                bench_multitenant):
         try:
             leg(results)
         except Exception as exc:   # noqa: BLE001

@@ -59,9 +59,12 @@ __all__ = ["Span", "SpanTracer", "tracer", "CORRELATION_KEYS"]
 #: guessing.  ``request_id`` = one serving request; ``generation`` = the
 #: live model generation; ``step`` = the trainer's global step (a
 #: checkpoint cut and its publish share it); ``window`` = the WAL
-#: window index; ``epoch``/``op``/``bucket`` label loops and dispatch.
+#: window index; ``epoch``/``op``/``bucket`` label loops and dispatch;
+#: ``tenant`` = the multi-tenant scheduler's tenant name (ISSUE 14) —
+#: queue-wait/serve/shed spans carry it, so one trace shows
+#: cross-tenant interleaving on the shared device.
 CORRELATION_KEYS = ("request_id", "generation", "step", "window",
-                    "epoch", "op", "bucket")
+                    "epoch", "op", "bucket", "tenant")
 
 
 class Span:

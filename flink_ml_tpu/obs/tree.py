@@ -105,8 +105,8 @@ class MetricsTree:
 
 
 def default_tree(*, endpoint: Any = None, serving: Any = None,
-                 recovery: Any = None, stream_info: Any = None,
-                 iteration_result: Any = None,
+                 scheduler: Any = None, recovery: Any = None,
+                 stream_info: Any = None, iteration_result: Any = None,
                  tracer: Any = None) -> MetricsTree:
     """A :class:`MetricsTree` pre-wired to every standard surface that
     exists in this process:
@@ -117,6 +117,10 @@ def default_tree(*, endpoint: Any = None, serving: Any = None,
     - ``serving`` — ``endpoint.metrics`` (or a bare ``ServingMetrics``
       via ``serving=``), including its ``kernels.*`` re-export and the
       publish/staleness gauges;
+    - ``scheduler`` — a multi-tenant :class:`~flink_ml_tpu.serving.\
+scheduler.SharedScheduler`'s subtree (class-labeled shed counters,
+      health, and every tenant's own ServingMetrics under
+      ``tenants.<name>.*`` — ISSUE 14);
     - ``warmup`` — the live servable's readiness accounting (absent
       until the first deploy);
     - ``recovery`` — a ``RecoveryReport`` (restarts / MTTR events);
@@ -138,6 +142,8 @@ def default_tree(*, endpoint: Any = None, serving: Any = None,
         metrics = endpoint.metrics
     if metrics is not None:
         tree.register("serving", metrics)
+    if scheduler is not None:
+        tree.register("scheduler", scheduler)
     if endpoint is not None:
         tree.register("warmup", lambda: endpoint.warmup_report)
     if recovery is not None:

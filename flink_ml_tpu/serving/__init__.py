@@ -20,7 +20,16 @@ requests while bounding tail latency:
   endpoint ``health`` gauge (SERVING/DEGRADED) and rollback counter the
   self-healing hot-swap drives (``endpoint.hot_swap(path)`` — a deploy
   that fails load/warm-up rolls back to the live generation and keeps
-  serving; see ``flink_ml_tpu/robustness/``).
+  serving; see ``flink_ml_tpu/robustness/``),
+- :mod:`.scheduler` — the multi-tenant serving fabric (ISSUE 14): ONE
+  admission/placement layer multiplexing many servables on one device
+  — global micro-batching per (servable, bucket) across tenants,
+  per-tenant SLO classes (interactive/standard/bulk) with priority
+  shedding, weighted fair queuing within a class, per-tenant metric
+  subtrees and ``tenant``-keyed trace spans,
+- :mod:`.embcache` — device-resident LRU embedding-row blocks for
+  WideDeep's long-tail vocab: only the zipfian-hot blocks live in HBM,
+  scores stay bit-exact with offline ``transform``.
 
 Quick start::
 
@@ -30,14 +39,28 @@ Quick start::
     prediction = endpoint.predict(request_table)     # == offline transform
     endpoint.registry.deploy("default", "/path/v2")  # atomic hot-swap
     endpoint.close()
+
+Multi-tenant (one process, many models, one device)::
+
+    from flink_ml_tpu.serving import SharedScheduler
+
+    sched = SharedScheduler(queue_capacity=4096)
+    sched.add_tenant("checkout", model_a, example_a, slo="interactive")
+    sched.add_tenant("nightly", model_b, example_b, slo="bulk", weight=0.5)
+    sched.start()
+    prediction = sched.predict("checkout", request_table)
+    sched.close()
 """
 
 from .batcher import MicroBatcher, ServingOverloadedError, ServingRequest
+from .embcache import CachedWideDeepServable, EmbeddingRowCache
 from .endpoint import ServingEndpoint, serve_model
 from .executor import ServableModel, make_servable
 from .metrics import (HEALTH_DEGRADED, HEALTH_SERVING, LatencyTracker,
                       ServingMetrics)
 from .registry import DeployedModel, ModelRegistry
+from .scheduler import (SLO_BULK, SLO_CLASSES, SLO_INTERACTIVE,
+                        SLO_STANDARD, SharedScheduler, Tenant)
 
 __all__ = [
     "MicroBatcher", "ServingOverloadedError", "ServingRequest",
@@ -46,4 +69,7 @@ __all__ = [
     "LatencyTracker", "ServingMetrics",
     "HEALTH_SERVING", "HEALTH_DEGRADED",
     "DeployedModel", "ModelRegistry",
+    "SharedScheduler", "Tenant",
+    "SLO_INTERACTIVE", "SLO_STANDARD", "SLO_BULK", "SLO_CLASSES",
+    "EmbeddingRowCache", "CachedWideDeepServable",
 ]

@@ -30,9 +30,25 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+import numpy as np
+
 from ..data.table import Table
 
-__all__ = ["MicroBatcher", "ServingRequest", "ServingOverloadedError"]
+__all__ = ["MicroBatcher", "ServingRequest", "ServingOverloadedError",
+           "concat_request_tables"]
+
+
+def concat_request_tables(tables) -> Table:
+    """One batch Table from the requests' tables, in batch order — THE
+    shared micro-batch assembly (endpoint serve loop + multi-tenant
+    scheduler): column-aligned concat, zero copies for a single-request
+    batch."""
+    if len(tables) == 1:
+        return tables[0]
+    names = tables[0].column_names
+    return Table({
+        name: np.concatenate([t[name] for t in tables], axis=0)
+        for name in names})
 
 #: process-wide request-id source — THE ``request_id`` correlation id of
 #: the span-tracing contract (``obs/trace.py``): assigned at submit,
@@ -86,6 +102,25 @@ class MicroBatcher:
         self._pending: deque = deque()
         self._cond = threading.Condition()
         self._closed = False
+        #: lock-free shed fast path (ISSUE 14 satellite): when True
+        #: (production default), a submit against an already-full queue
+        #: sheds on ONE unlocked read of the queue length — under
+        #: saturation, thousands of shed decisions per second must not
+        #: serialize on the hot queue lock they would otherwise all
+        #: contend for.  The read is racy by design: it can only fire
+        #: when the queue is AT capacity, where a concurrent drain
+        #: making one slot free means at worst one spurious shed at the
+        #:  saturation boundary — admission control's documented
+        #: semantics either way.  The authoritative check under the
+        #: lock still guards every admit.  (Toggle exists for the
+        #: bench_multitenant A/B.)
+        self.fast_shed = True
+
+    def _shed_error(self) -> ServingOverloadedError:
+        return ServingOverloadedError(
+            f"serving queue full ({self.queue_capacity} requests "
+            "pending); request shed — retry with backoff or route "
+            "to another replica")
 
     # -- producer side ------------------------------------------------------
     def submit(self, table: Table) -> ServingRequest:
@@ -96,14 +131,15 @@ class MicroBatcher:
             raise ValueError(
                 f"request has {rows} rows > max_batch_rows="
                 f"{self.max_batch_rows}; split it client-side")
+        # len(deque) is a single atomic read under the GIL — no lock
+        if self.fast_shed and len(self._pending) >= self.queue_capacity \
+                and not self._closed:
+            raise self._shed_error()
         with self._cond:
             if self._closed:
                 raise RuntimeError("serving endpoint is closed")
             if len(self._pending) >= self.queue_capacity:
-                raise ServingOverloadedError(
-                    f"serving queue full ({self.queue_capacity} requests "
-                    "pending); request shed — retry with backoff or route "
-                    "to another replica")
+                raise self._shed_error()
             request = ServingRequest(table, rows)
             self._pending.append(request)
             self._cond.notify_all()

@@ -27,13 +27,13 @@ import time
 from concurrent.futures import Future
 from typing import Any, List, Optional
 
-import numpy as np
-
 from ..data.table import Table
 from ..obs.trace import tracer
-from .batcher import MicroBatcher, ServingOverloadedError, ServingRequest
+from .batcher import (MicroBatcher, ServingOverloadedError,
+                      ServingRequest, concat_request_tables)
 from .metrics import ServingMetrics
 from .registry import ModelRegistry
+
 
 __all__ = ["ServingEndpoint", "serve_model"]
 
@@ -132,11 +132,21 @@ class ServingEndpoint:
     # -- request path -------------------------------------------------------
     def submit(self, table: Table) -> Future:
         """Enqueue one request; sheds with ``ServingOverloadedError`` when
-        the bounded queue is full."""
+        the bounded queue is full.  A shed is stamped with the LIVE
+        generation serving at the time (gauge + tracer instant), so an
+        overload correlated with a publish — e.g. a warm-up stealing
+        cycles from the serve loop — is attributable in the trace
+        instead of an anonymous counter bump (ISSUE 14 satellite)."""
         try:
             request = self._batcher.submit(table)
         except ServingOverloadedError:
-            self.metrics.on_shed(self._batcher.queue_depth)
+            # lock-free generation read: the shed path must not
+            # serialize on the registry lock under the very saturation
+            # it exists to absorb
+            generation = self._registry.live_generation(self._name)
+            self.metrics.on_shed(self._batcher.queue_depth,
+                                 generation=generation)
+            tracer.instant("shed", cat="serving", generation=generation)
             raise
         self.metrics.on_submit(self._batcher.queue_depth)
         return request.future
@@ -153,15 +163,6 @@ class ServingEndpoint:
                 self._process(batch)
             elif self._batcher.closed and self._batcher.empty:
                 return
-
-    @staticmethod
-    def _concat(tables: List[Table]) -> Table:
-        if len(tables) == 1:
-            return tables[0]
-        names = tables[0].column_names
-        return Table({
-            name: np.concatenate([t[name] for t in tables], axis=0)
-            for name in names})
 
     def _process(self, batch: List[ServingRequest]) -> None:
         # ONE capture per batch: the hot-swap atomicity point.  Every
@@ -184,7 +185,7 @@ class ServingEndpoint:
                              generation=deployed.generation):
                 for request in batch:
                     servable.check_schema(request.table)
-                table = self._concat([r.table for r in batch])
+                table = concat_request_tables([r.table for r in batch])
             with tracer.span("serve_batch", cat="serving",
                              generation=deployed.generation,
                              bucket=servable.bucket_for(rows)):

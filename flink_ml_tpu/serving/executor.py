@@ -336,13 +336,19 @@ class _PipelineServable(ServableModel):
         return self.model.transform(table)[0]
 
 
-def make_servable(model, example: Table, **kwargs: Any) -> ServableModel:
+def make_servable(model, example: Table, *, emb_cache: bool = False,
+                  **kwargs: Any) -> ServableModel:
     """Adapt a fitted Model for serving, picking the specialized executor
     for the covered families (linear / KMeans / Wide&Deep; whole
     PipelineModels fuse their chainable stage runs into single-dispatch
     segments; GBT and every other row-independent transform serve through
     the generic adapter, whose predict entry points are bucket-routed
-    since this PR)."""
+    since this PR).
+
+    ``emb_cache=True`` (WideDeep only) serves through the
+    device-resident embedding-row cache (``serving/embcache.py``,
+    ISSUE 14): only the hot table blocks live in HBM;
+    ``cache_block_rows`` / ``cache_capacity_blocks`` size it."""
     from ..api.pipeline import PipelineModel
     from ..models.clustering.kmeans import KMeansModel
     from ..models.common.linear import LinearModelBase
@@ -355,7 +361,16 @@ def make_servable(model, example: Table, **kwargs: Any) -> ServableModel:
     elif isinstance(model, KMeansModel):
         cls = _KMeansServable
     elif isinstance(model, WideDeepModel):
+        if emb_cache:
+            from .embcache import CachedWideDeepServable
+
+            return CachedWideDeepServable(model, example, **kwargs)
         cls = _WideDeepServable
     else:
         cls = ServableModel
+    if emb_cache:
+        raise TypeError(
+            f"emb_cache=True only applies to WideDeepModel (its stacked "
+            f"vocab tables are the cacheable operand), not "
+            f"{type(model).__name__}")
     return cls(model, example, **kwargs)

@@ -75,7 +75,21 @@ class ServingMetrics:
     every other framework metric."""
 
     def __init__(self, group: Optional[MetricGroup] = None,
-                 latency_window: int = 4096):
+                 latency_window: int = 4096,
+                 min_publish_interval_s: float = 0.0):
+        #: minimum spacing between the EXPENSIVE publish work (the
+        #: O(window) quantile pass + the kernel-gauge republish).  The
+        #: default 0.0 keeps the classic refresh-per-batch behavior;
+        #: the multi-tenant scheduler sets a small interval on its
+        #: per-tenant bundles — ONE serve loop drives every tenant's
+        #: metrics, so per-batch O(window) work there multiplies by the
+        #: tenant count and comes straight out of serving latency
+        #: (ISSUE 14).  Counters/gauges on the request path are always
+        #: live; only the derived quantile/kernel gauges are spaced,
+        #: and ``snapshot()`` forces a refresh so exports never read
+        #: stale.
+        self._min_publish_interval = min_publish_interval_s
+        self._last_expensive_publish = 0.0
         self.group = group or MetricGroup("serving")
         self.requests = self.group.counter("requests")
         self.batches = self.group.counter("batches")
@@ -96,6 +110,10 @@ class ServingMetrics:
         self._publish_rate_value = 0.0
         self._health = self.group.gauge("health")
         self._health.set(HEALTH_SERVING)
+        #: generation live at the most recent shed (NaN = never shed —
+        #: absent in exports, the staleness-gauge stance)
+        self._shed_generation = self.group.gauge("last_shed_generation")
+        self._shed_generation.set(float("nan"))
         self._queue_depth = self.group.gauge("queue_depth")
         self._fill = self.group.gauge("batch_fill_ratio")
         self._p50 = self.group.gauge("latency_p50_ms")
@@ -115,9 +133,16 @@ class ServingMetrics:
         self._kernel_group = self.group.add_group("kernels")
         self._kernel_published = -1
 
-    def on_shed(self, queue_depth: int) -> None:
+    def on_shed(self, queue_depth: int,
+                generation: Optional[int] = None) -> None:
+        """One shed (admission control dropped a request).  ``generation``
+        stamps the live model generation serving at the time — the
+        publish-correlation hook (never-shed endpoints read NaN, the
+        absent-in-exports sentinel, like staleness)."""
         self.shed.inc()
         self._queue_depth.set(queue_depth)
+        if generation is not None:
+            self._shed_generation.set(generation)
 
     @property
     def health(self) -> str:
@@ -209,15 +234,23 @@ class ServingMetrics:
                 self._rate.set(round(self._rate_value, 2))
             self._rate_t = now
 
-    def publish(self) -> None:
+    def publish(self, force: bool = False) -> None:
         """Refresh the p50/p99 gauges from the latency ring — ONE
         np.quantile pass for both, and skipped entirely when no new
         samples arrived since the last publish (an idle endpoint's metric
         tick must not pay an O(window) sort under the ring lock every
-        time).  Kernel-registry gauges refresh on the same cadence
-        (skip-if-unchanged on the dispatch counter)."""
+        time), or when ``min_publish_interval_s`` hasn't elapsed
+        (``force`` — the snapshot path — overrides).  Kernel-registry
+        gauges refresh on the same cadence (skip-if-unchanged on the
+        dispatch counter)."""
         from ..kernels.registry import kernel_stats
 
+        if self._min_publish_interval and not force:
+            now = time.monotonic()
+            if now - self._last_expensive_publish \
+                    < self._min_publish_interval:
+                return
+            self._last_expensive_publish = now
         if kernel_stats.dispatches != self._kernel_published:
             kernel_stats.publish(self._kernel_group)
             self._kernel_published = kernel_stats.dispatches
@@ -230,4 +263,5 @@ class ServingMetrics:
         self._published_count = count
 
     def snapshot(self) -> Dict[str, object]:
+        self.publish(force=True)    # exports never read interval-stale
         return self.group.snapshot()

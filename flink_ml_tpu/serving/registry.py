@@ -222,6 +222,16 @@ class ModelRegistry:
                 metrics.on_deploy(generation)
         return deployed
 
+    def live_generation(self, name: str) -> Optional[int]:
+        """LOCK-FREE best-effort read of the live generation (None when
+        nothing is deployed).  The shed paths stamp their events with
+        this — under saturation thousands of sheds per second must not
+        serialize on the registry lock the serve loops and deploys
+        contend on.  Safe: the dict read is GIL-atomic and the held
+        ``DeployedModel`` is immutable."""
+        deployed = self._live.get(name)
+        return deployed.generation if deployed is not None else None
+
     def current(self, name: str) -> DeployedModel:
         """The live version — one atomic read; callers serving a batch
         call this ONCE and use the returned reference throughout."""

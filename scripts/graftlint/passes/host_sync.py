@@ -52,6 +52,11 @@ SCAN_CALLEES = {"scan", "masked_chunk_scan", "while_loop", "fori_loop"}
 #: scan/while carries on every training hot path, so a device_get
 #: sneaking into a step-shaped helper there would fence every adopter's
 #: dispatch stream at once)
+#: (``serving/`` joined with ISSUE 14: the multi-tenant scheduler's one
+#: serve loop multiplexes EVERY tenant — a host sync in a step-shaped
+#: helper on its dispatch path would stall every tenant's traffic at
+#: once, not one endpoint's, and the embedding-cache pool ops must stay
+#: async for the miss path to overlap with serving)
 SCAN_ROOTS = (
     "flink_ml_tpu/iteration",
     "flink_ml_tpu/models",
@@ -59,6 +64,7 @@ SCAN_ROOTS = (
     "flink_ml_tpu/online",
     "flink_ml_tpu/ops",
     "flink_ml_tpu/parallel",
+    "flink_ml_tpu/serving",
 )
 
 

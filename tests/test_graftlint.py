@@ -627,6 +627,41 @@ def test_atomic_writes_pass_visits_obs_package():
         assert "atomic-writes" in mod.suppressions.get(f.line, set())
 
 
+def test_scheduler_modules_visited_by_lock_and_host_sync_passes():
+    """ISSUE 14: the multi-tenant scheduler joined the scanned surfaces.
+    ``lock-discipline`` roots at the whole package — assert the walk
+    genuinely VISITS the new modules (a root listing that misses them
+    guards nothing) and that both are clean: the scheduler's whole
+    design is compute-under-the-condvar, block outside it, and the
+    embedding cache's pool faults must never run under a held lock.
+    ``host-sync``'s step-tree roots grew ``flink_ml_tpu/serving`` (the
+    one serve loop multiplexes EVERY tenant — a host sync in a
+    step-shaped helper there stalls all of them at once)."""
+    from scripts.graftlint.passes.host_sync import SCAN_ROOTS
+
+    assert "flink_ml_tpu/serving" in SCAN_ROOTS
+    assert "flink_ml_tpu" in LockDisciplinePass.roots
+    project = Project(repo=REPO)
+    lock_visited = {
+        os.path.relpath(m.path, REPO)
+        for m in project.iter_modules(
+            [os.path.join(REPO, r) for r in LockDisciplinePass.roots])}
+    new_modules = [os.path.join("flink_ml_tpu", "serving", name)
+                   for name in ("scheduler.py", "embcache.py")]
+    for rel in new_modules:
+        assert rel in lock_visited, f"lock-discipline never visits {rel}"
+    sync_visited = {
+        os.path.relpath(m.path, REPO)
+        for m in project.iter_modules(
+            [os.path.join(REPO, r) for r in SCAN_ROOTS])}
+    for rel in new_modules:
+        assert rel in sync_visited, f"host-sync never visits {rel}"
+    for rel in new_modules:
+        mod = project.module(os.path.join(REPO, rel))
+        assert LockDisciplinePass().check_module(mod, project) == []
+        assert HostSyncPass().check_module(mod, project) == []
+
+
 def test_atomic_writes_pass_guards_durability_module():
     """robustness/durability.py joined the durable roots this PR; its
     two protocol-level exceptions are inline-suppressed, so the raw pass

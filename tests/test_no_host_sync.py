@@ -133,6 +133,26 @@ def test_checker_covers_obs_package():
         assert chs.check_file(path) == []
 
 
+def test_checker_covers_serving_package():
+    """ISSUE 14 satellite: the serving package joined the scanned roots
+    — the multi-tenant scheduler's ONE serve loop multiplexes every
+    tenant, so a host sync in a step-shaped helper on its dispatch path
+    would stall all tenants' traffic at once (not one endpoint's), and
+    the embedding cache's pool set/gather must stay async dispatches.
+    Assert the root is registered AND that the walk actually visits its
+    modules (a registered-but-empty root would silently guard
+    nothing)."""
+    assert "flink_ml_tpu/serving" in chs.SCAN_ROOTS
+    visited = [p for p in chs._module_paths()
+               if os.sep + os.path.join("flink_ml_tpu", "serving") + os.sep
+               in p]
+    names = {os.path.basename(p) for p in visited}
+    assert {"scheduler.py", "embcache.py", "batcher.py", "endpoint.py",
+            "executor.py", "registry.py", "metrics.py"} <= names
+    for path in visited:
+        assert chs.check_file(path) == []
+
+
 def test_checker_covers_ops_package():
     """ISSUE 10 satellite: the ops/ kernel modules joined the scanned
     roots — the kernel registry routes every training hot path through
