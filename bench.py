@@ -2295,6 +2295,182 @@ def bench_online(results: dict) -> None:
         endpoint.close()
 
 
+def _elastic_child() -> None:
+    """Child process for :func:`bench_elastic` — runs on a fresh virtual
+    8-device CPU fleet (the parent sets XLA_FLAGS/JAX_PLATFORMS) so the
+    leg never has to repartition the parent's backend mid-bench.  Prints
+    ONE JSON line with the measured fields."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as _np
+
+    from flink_ml_tpu.data.datacache import DataCacheReader, DataCacheWriter
+    from flink_ml_tpu.iteration.checkpoint import (
+        CheckpointConfig,
+        CheckpointManager,
+    )
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+    from flink_ml_tpu.parallel.elastic import ElasticCoordinator
+    from flink_ml_tpu.parallel.grad_reduce import GradReduceConfig
+    from flink_ml_tpu.robustness import (
+        FaultPlan,
+        RecoveryReport,
+        RetryPolicy,
+        resilient_fit,
+    )
+
+    out: dict = {"devices": jax.device_count()}
+    n, d, batch, chips = 1920, 16, 240, 2
+    rng = _np.random.default_rng(29)
+    true_w = rng.normal(size=(d,))
+    gr = GradReduceConfig(mode="topk", density=0.25, bucket_count=2,
+                          overlap=True, axis="data", dcn_axis="dcn")
+
+    with tempfile.TemporaryDirectory() as td:
+        cache = os.path.join(td, "cache")
+        writer = DataCacheWriter(cache, segment_rows=480)
+        for _ in range(n // 480):
+            X = rng.normal(size=(480, d)).astype(_np.float32)
+            writer.append({"features": X,
+                           "label": (X @ true_w > 0).astype(_np.float32)})
+        writer.finish()
+
+        def reader():
+            return DataCacheReader(cache, batch_rows=batch)
+
+        def fit(coord, ck, **kw):
+            cfg = SGDConfig(learning_rate=0.3, max_epochs=4, tol=0.0,
+                            grad_reduce=gr)
+            info: dict = {}
+            state, log = sgd_fit_outofcore(
+                logistic_loss, reader, num_features=d, config=cfg,
+                mesh=coord.mesh(), membership=coord,
+                cache_decoded=False, steps_per_dispatch=2,
+                checkpoint=ck, checkpoint_every_steps=2,
+                stream_info=info, **kw)
+            return state, log, info
+
+        # -- step-time vs fleet size (warm epochs only: epoch 0 pays
+        # the compile; per-step wall over the 8-batch epochs after it)
+        steps = n // batch
+        by_fleet = {}
+        for workers in (1, 2, 4):
+            coord = ElasticCoordinator(chips_per_worker=chips,
+                                       initial_workers=workers)
+            _, _, info = fit(coord, CheckpointConfig(
+                os.path.join(td, f"ck_f{workers}"), max_to_keep=99))
+            warm = info["epoch_seconds"][1:]
+            by_fleet[str(workers)] = round(
+                1000.0 * float(_np.mean(warm)) / steps, 3)
+        out["step_ms_by_fleet"] = by_fleet
+
+        # -- resize-pause + exactness: a join at chunk boundary 2 vs a
+        # fixed fleet of the new size restoring the same cut
+        coord = ElasticCoordinator(chips_per_worker=chips,
+                                   initial_workers=2)
+        plan = FaultPlan().inject(coord.SCOPE, at=2, kind="join")
+        report = RecoveryReport()
+        cfgE = SGDConfig(learning_rate=0.3, max_epochs=4, tol=0.0,
+                         grad_reduce=gr)
+        t0 = time.perf_counter()
+        with plan:
+            state_e, log_e = resilient_fit(
+                sgd_fit_outofcore, logistic_loss,
+                lambda: plan.wrap_source(reader()),
+                num_features=d, config=cfgE, cache_decoded=False,
+                steps_per_dispatch=2, checkpoint_every_steps=2,
+                checkpoint=CheckpointConfig(os.path.join(td, "ck_e"),
+                                            max_to_keep=99),
+                elastic=coord,
+                backoff=RetryPolicy(base_delay=0.0, sleep=lambda s: None),
+                report=report)
+        out["elastic_wall_s"] = round(time.perf_counter() - t0, 3)
+        ev = next((e for e in report.events if e.kind == "resize"), None)
+        out["resizes"] = report.resizes
+        out["resize_pause_s"] = (round(ev.mttr_s, 4)
+                                 if ev and ev.mttr_s is not None else None)
+        # replay = steps between the restored cut and the boundary that
+        # requested the resize — 0 when the boundary cut landed intact
+        out["resize_steps_replayed"] = (
+            None if ev is None or ev.restored_step is None
+            else 6 - int(ev.restored_step))
+
+        # fixed fleet of the new size from the same cut
+        ck_fix = os.path.join(td, "ck_fix")
+        os.makedirs(ck_fix)
+        shutil.copytree(os.path.join(td, "ck_f2", "ckpt-00000006"),
+                        os.path.join(ck_fix, "ckpt-00000006"))
+        coord3 = ElasticCoordinator(chips_per_worker=chips,
+                                    initial_workers=3)
+        state_b, log_b, _ = fit(
+            coord3, CheckpointManager(CheckpointConfig(ck_fix,
+                                                       max_to_keep=99)),
+            resume=True)
+        out["elastic_bitexact"] = bool(
+            _np.array_equal(state_e.coefficients, state_b.coefficients)
+            and state_e.intercept == state_b.intercept
+            and list(log_e) == list(log_b))
+    print(json.dumps(out))
+
+
+def bench_elastic(results: dict) -> None:
+    """Elastic-training leg (elastic_metric_version 1, ISSUE 15):
+    step-time vs fleet size and the resize-pause wall.
+
+    Membership elasticity is a host/collective-layout story, not a
+    kernel story, so the leg measures on a virtual 8-device CPU fleet
+    in a SUBPROCESS — the parent's backend (TPU or single-device CPU)
+    is never repartitioned mid-bench, and the leg produces real numbers
+    on every host.  Reported: per-step wall at fleet sizes 1/2/4 (x2
+    chips, topk+overlap hierarchical grad_reduce — the elastic
+    posture), the resize pause (detect -> restore complete, the
+    supervisor's ``kind="resize"`` event MTTR), steps replayed by the
+    resize (0 at a boundary cut by construction), and the bit-exactness
+    verdict of the resized run vs a fixed fleet of the new size
+    restoring the same cut.  Measured fields start null and stay null
+    (never faked) if the child fails."""
+    import subprocess
+    import sys
+
+    elastic: dict = {
+        "elastic_metric_version": 1,
+        "config": "LR dense 1920x16, 8 batches/epoch, W=2, cut every 2 "
+                  "steps; topk0.25+overlap hier (dcn x data), 2 chips/"
+                  "worker; fleet sweep 1/2/4 workers; join at boundary 2",
+        "backend": "virtual-cpu-8",
+        "devices": None,
+        "step_ms_by_fleet": None,
+        "resize_pause_s": None,
+        "resize_steps_replayed": None,
+        "resizes": None,
+        "elastic_wall_s": None,
+        "elastic_bitexact": None,
+    }
+    results["notes"]["elastic"] = elastic
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import bench; bench._elastic_child()"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env, capture_output=True, text=True, timeout=600)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"elastic child rc={r.returncode}: {r.stderr[-300:]}")
+        elastic.update(json.loads(r.stdout.strip().splitlines()[-1]))
+    except Exception as exc:   # noqa: BLE001 — nulls stay null
+        elastic["elastic_error"] = repr(exc)[:300]
+
+
 def bench_wal(results: dict) -> None:
     """Write-ahead window log durability cost (VERDICT r3 weak #7): live
     windows/s through the full per-window fsync pair, host-side only
@@ -3460,7 +3636,7 @@ def main() -> None:
                 bench_online_ftrl, bench_serving, bench_pipeline,
                 bench_comm, bench_wal, bench_recovery, bench_online,
                 bench_kernels, bench_coldstart, bench_obs,
-                bench_multitenant):
+                bench_multitenant, bench_elastic):
         try:
             leg(results)
         except Exception as exc:   # noqa: BLE001

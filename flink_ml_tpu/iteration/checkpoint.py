@@ -44,7 +44,47 @@ from ..robustness.durability import (
 )
 from ..robustness.faults import fault_point
 
-__all__ = ["save_pytree", "load_pytree", "CheckpointManager", "CheckpointConfig"]
+__all__ = ["save_pytree", "load_pytree", "CheckpointManager",
+           "CheckpointConfig", "mesh_shape_meta", "require_fleet_compat"]
+
+
+def mesh_shape_meta(mesh, participant_count: Optional[int] = None
+                    ) -> Dict[str, Any]:
+    """The fleet-identity metadata every elastic-aware cut carries: the
+    writing mesh's axis sizes plus the reduction participant count.  A
+    restore onto a DIFFERENT fleet consults this to know what it is
+    re-sharding from (``require_fleet_compat``) — a cut without it can
+    only safely restore onto a fleet of the original shape."""
+    meta: Dict[str, Any] = {
+        "mesh_shape": {str(a): int(mesh.shape[a]) for a in mesh.axis_names}}
+    if participant_count is not None:
+        meta["participant_count"] = int(participant_count)
+    return meta
+
+
+def require_fleet_compat(meta: Dict[str, Any], *, saved_participants: int,
+                         current_participants: int, path: str = "") -> None:
+    """Gate a cross-fleet restore on the cut carrying mesh-shape
+    metadata.  ``CheckpointManager.latest()`` historically assumed a cut
+    from the same mesh shape; with elastic fleets a cut can legally
+    restore onto a different one — but ONLY when the manifest records
+    what fleet wrote it (``mesh_shape``/``participant_count``, attached
+    by the elastic-aware fits).  A legacy cut restored onto a different
+    fleet raises a diagnosable :class:`CorruptStateError` instead of a
+    silent wrong-shape restore."""
+    if saved_participants == current_participants:
+        return
+    if meta.get("mesh_shape") is None \
+            and meta.get("participant_count") is None:
+        where = f" at {path}" if path else ""
+        raise CorruptStateError(
+            f"checkpoint{where} holds reducer state for "
+            f"{saved_participants} participant(s) but is being restored "
+            f"onto a fleet of {current_participants}, and the cut "
+            "predates mesh-shape metadata (no 'mesh_shape'/"
+            "'participant_count' in its manifest) — refusing the "
+            "wrong-shape restore; restore onto a fleet of the original "
+            "size, or re-cut the checkpoint with an elastic-aware fit")
 
 _LEAF = "__leaf__"
 
@@ -333,7 +373,15 @@ class CheckpointManager:
         the previous one; only when NO valid checkpoint exists does this
         return None.  The self-healing contract resilient_fit rides: a
         corrupted newest checkpoint costs replayed steps, never the
-        run."""
+        run.
+
+        The returned ``meta`` may carry the writing fleet's identity
+        (``mesh_shape``/``participant_count`` — :func:`mesh_shape_meta`,
+        attached by elastic-aware fits).  Restoring onto a *different*
+        fleet is the caller's re-shard job; callers must gate it with
+        :func:`require_fleet_compat` so a legacy cut (no fleet
+        metadata) fails diagnosably instead of restoring wrong-shaped
+        state."""
         self.wait()
         for epoch in reversed(self.list_epochs()):
             path = self._ckpt_path(epoch)

@@ -1331,7 +1331,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                       resume: bool = False,
                       retry_policy=None,
                       publish_cb: Optional[Callable] = None,
-                      step_probe: bool = False
+                      step_probe: bool = False,
+                      membership=None
                       ) -> Tuple[LinearState, list]:
     """Out-of-core variant of :func:`sgd_fit`: the dataset never has to fit
     in host RAM or HBM (the Criteo-1TB shape, BASELINE.md north star).
@@ -1500,6 +1501,30 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     reader must not consume a batch on a failed pull, or be idempotent
     at the failed position (seekable readers are).
 
+    **Elastic membership** (``membership=``, an
+    :class:`~flink_ml_tpu.parallel.elastic.ElasticCoordinator`): the
+    fleet becomes a runtime input.  Once per chunk boundary the fit
+    calls ``membership.poll(global_step)`` — the seam injected
+    ``preempt``/``join`` faults and lease expiry flow through — and
+    when membership moved, it cuts a boundary checkpoint (carrying
+    mesh-shape metadata) and raises
+    :class:`~flink_ml_tpu.parallel.elastic.ResizeRequested`:
+    ``resilient_fit(elastic=...)`` rebuilds the mesh at the new dcn
+    extent and re-enters with ``resume=True``, where the restore below
+    re-shards the whole carry (params replicate; participant-stacked
+    reducer state — EF residual, pending overlap buffer, adaptive
+    policy, rounding keys — routes through
+    :func:`~flink_ml_tpu.parallel.grad_reduce.reshard_state`).  A
+    resize at a chunk boundary is bit-exact vs a fixed fleet of the
+    new size restoring the same cut (same reduce order); a worker
+    death mid-chunk degrades to the crash path and resumes onto the
+    surviving fleet.  Elastic fits are single-process and dense-layout
+    (the mixed/sparse ELL paths keep their fixed meshes for now); with
+    no ``grad_reduce`` the batch shards over EVERY mesh axis jointly
+    (dcn x data — exact data parallelism over the whole fleet), with a
+    hierarchical ``grad_reduce`` the existing dcn-composed layout
+    already does.
+
     **Step probe** (``step_probe=True``, ISSUE 13): a
     :class:`~flink_ml_tpu.obs.StepProbe` rides the donated chunk carry
     recording the per-step ``loss`` — zero host sync inside the scan
@@ -1559,6 +1584,36 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                     "data axis per host")
             # the batch shards over every reduction axis jointly
             n_local_dev = n_dev_red
+    if membership is not None:
+        if procs > 1:
+            raise ValueError(
+                "elastic membership is single-process: the coordinator "
+                "owns the device pool of THIS process (multi-host "
+                "elasticity needs a control plane, not a mesh reshape)")
+        if mixed or sparse:
+            raise ValueError(
+                "elastic membership supports the dense streaming layout; "
+                "the mixed/sparse ELL paths bake per-device routing into "
+                "their compiled programs and keep a fixed mesh for now")
+        if gr is None and len(mesh.axis_names) > 1:
+            # exact data parallelism over the whole fleet: the batch
+            # shards over every mesh axis jointly (dcn x data), so a
+            # resized dcn extent changes the shard count, not the math
+            gr_batch_axis = tuple(str(a) for a in mesh.axis_names)
+            n_local_dev = int(np.prod([int(mesh.shape[a])
+                                       for a in mesh.axis_names]))
+            n_dev_red = n_local_dev
+        elif gr is not None and membership.dcn_axis in mesh.shape \
+                and membership.dcn_axis not in gr_axes:
+            # a flat compressed config on an elastic (dcn, data) mesh
+            # would silently REPLICATE the batch over the resizable
+            # axis — every worker doing identical work, no elasticity
+            raise ValueError(
+                f"elastic membership with grad_reduce must reduce over "
+                f"the elastic axis {membership.dcn_axis!r}: set "
+                f"dcn_axis={membership.dcn_axis!r} (hierarchical) on "
+                "the GradReduceConfig, or drop grad_reduce for the "
+                "exact joint-sharded path")
     stream_ell = (mixed and plan_mixed_impl(
         num_features, mesh, allow_sharded=True,
         allow_multiprocess=True) == "ell")
@@ -1585,6 +1640,11 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         manager = checkpoint
     elif isinstance(checkpoint, CheckpointConfig):
         manager = CheckpointManager(checkpoint)
+    if membership is not None and manager is None:
+        raise ValueError(
+            "elastic membership requires a checkpoint manager: a resize "
+            "IS a restore onto the new mesh, so without durable cuts "
+            "there is nothing to resize from")
 
     x_p = P(gr_batch_axis, None)
     v_p = P(gr_batch_axis)
@@ -1790,8 +1850,30 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
             # collide with: the slot key is the global step, so post-resume
             # saves keep ascending and GC never deletes newer checkpoints.
             global_step, saved, meta = restored
+            saved_params = saved["params"]
+            if gr is not None and isinstance(saved_params, dict):
+                from ...iteration.checkpoint import require_fleet_compat
+                from ...parallel import grad_reduce as GR
+
+                n_saved = GR.state_participants(
+                    saved_params.get(GR_STATE_KEY))
+                if n_saved is not None and n_saved != n_dev_red:
+                    # resize-as-restore: the cut came from a different
+                    # fleet — legal only when it says which one
+                    # (mesh-shape metadata); the participant-stacked
+                    # reducer state re-shards onto the new extent
+                    require_fleet_compat(
+                        meta, saved_participants=n_saved,
+                        current_participants=n_dev_red,
+                        path=manager.config.directory)
+                    ici = (int(mesh.shape[gr.axis])
+                           if gr.dcn_axis is not None else 1)
+                    saved_params = dict(saved_params)
+                    saved_params[GR_STATE_KEY] = GR.reshard_state(
+                        saved_params[GR_STATE_KEY], n_dev_red,
+                        ici_size=ici)
             params = replicate(jax.tree_util.tree_map(jnp.asarray,
-                                                      saved["params"]), mesh)
+                                                      saved_params), mesh)
             start_epoch = int(meta["train_epoch"])
             skip_steps = int(meta["step_in_epoch"])
             resume_n_batches = int(meta["n_batches"])
@@ -1825,6 +1907,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         return host
 
     def _save(epoch, step_in_epoch, loss_sum, n_batches, converged=False):
+        from ...iteration.checkpoint import mesh_shape_meta
+
         manager.save(global_step, {
             "params": params,
             "loss_sum": (loss_sum if loss_sum is not None
@@ -1833,6 +1917,9 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
             "train_epoch": epoch, "step_in_epoch": step_in_epoch,
             "n_batches": n_batches, "prev_loss": prev_loss,
             "loss_log": loss_log, "converged": converged,
+            # fleet identity: what a restore onto a DIFFERENT mesh
+            # (elastic resize) needs to know it is re-sharding from
+            **mesh_shape_meta(mesh, participant_count=n_dev_red),
         })
 
     epoch_secs: list = []
@@ -2060,6 +2147,7 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                     # mid-epoch cuts land at chunk boundaries: save when the
                     # chunk crossed a checkpoint_every_steps multiple (and
                     # publish AFTER the save — never serve ahead of durable)
+                    cut_done = False
                     if (checkpoint_every_steps > 0
                             and (manager is not None or publish_cb is not None)
                             and step_in_epoch // checkpoint_every_steps
@@ -2067,9 +2155,26 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                             // checkpoint_every_steps):
                         if manager is not None:
                             _save(epoch, step_in_epoch, loss_sum, n_batches)
+                            cut_done = True
                         if publish_cb is not None:
                             publish_cb(global_step,
                                        lambda p=params: _publish_params(p))
+                    # elastic membership: one poll per chunk boundary —
+                    # injected preempt/join faults and lease expiry land
+                    # here; a changed fleet cuts a boundary checkpoint
+                    # and hands the resize to the supervisor (restore
+                    # onto the new mesh)
+                    if membership is not None \
+                            and membership.poll(global_step):
+                        if manager is not None and not cut_done:
+                            _save(epoch, step_in_epoch, loss_sum,
+                                  n_batches)
+                        from ...parallel.elastic import ResizeRequested
+
+                        raise ResizeRequested(
+                            step=global_step,
+                            fleet_size=membership.fleet_size,
+                            membership_epoch=membership.membership_epoch)
             else:
                 for dev_batch in pipeline:
                     params, value = batch_step(params, *dev_batch)

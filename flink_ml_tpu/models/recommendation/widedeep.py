@@ -300,7 +300,11 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
                       prefetch_depth: int = 2, prefetch_workers: int = 1,
                       prefetch_put_workers: int = 1,
                       prefetch_stats=None,
-                      steps_per_dispatch: int = 8) -> "WideDeepModel":
+                      steps_per_dispatch: int = 8,
+                      checkpoint=None,
+                      checkpoint_every_steps: int = 0,
+                      resume: bool = False,
+                      membership=None) -> "WideDeepModel":
         """Out-of-core ``fit``: epochs stream from ``make_reader()`` (the
         ``sgd_fit_outofcore`` reader protocol — a fresh per-epoch
         iterator of host batch dicts with this estimator's column names;
@@ -330,7 +334,24 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
         pipeline, and every process must deliver the SAME number of
         equal-sized batches per epoch (mismatches deadlock in the
         collectives).  Multi-process fits keep the classic per-batch
-        loop (chunk assembly is per-process-local)."""
+        loop (chunk assembly is per-process-local).
+
+        **Checkpoints + elastic membership** (``checkpoint=``,
+        ``checkpoint_every_steps=``, ``resume=``, ``membership=`` —
+        the ``sgd_fit_outofcore`` protocol, chunked single-process
+        path): cuts land at chunk boundaries carrying params, Adam
+        state, the running loss accumulators AND mesh-shape metadata;
+        ``resume=True`` restores the newest valid cut, re-seeks the
+        reader and continues deterministically.  With an
+        :class:`~flink_ml_tpu.parallel.elastic.ElasticCoordinator` the
+        fit polls membership once per chunk boundary and a changed
+        fleet cuts a checkpoint and raises
+        :class:`~flink_ml_tpu.parallel.elastic.ResizeRequested` for
+        ``resilient_fit(elastic=...)`` to restore onto the new mesh —
+        params and optimizer state are replicated, so the re-shard is
+        pure placement and the resize is bit-exact vs a fixed fleet of
+        the new size restoring the same cut.  Elastic fits shard the
+        batch over EVERY mesh axis jointly (dcn x data)."""
         from ...data.prefetch import prefetch_to_device
         from ...parallel.mesh import (
             assemble_process_local,
@@ -353,7 +374,38 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
         mesh = mesh or default_mesh()
         put_fn = (assemble_process_local
                   if mesh_process_count(mesh) > 1 else None)
-        batcher = FixedRowBatcher(local_axis_multiple(mesh))
+        chunked = mesh_process_count(mesh) == 1
+
+        from ...iteration.checkpoint import (
+            CheckpointConfig,
+            CheckpointManager,
+            mesh_shape_meta,
+        )
+
+        manager = None
+        if isinstance(checkpoint, CheckpointManager):
+            manager = checkpoint
+        elif isinstance(checkpoint, CheckpointConfig):
+            manager = CheckpointManager(checkpoint)
+        if manager is not None and not chunked:
+            raise ValueError(
+                "checkpointing the streaming WideDeep fit needs the "
+                "chunked single-process path (cuts land at chunk "
+                "boundaries)")
+        if membership is not None and manager is None:
+            raise ValueError(
+                "elastic membership requires a checkpoint manager: a "
+                "resize IS a restore onto the new mesh")
+        batch_axes = "data"
+        row_multiple = local_axis_multiple(mesh)
+        if membership is not None and len(mesh.axis_names) > 1:
+            # elastic fleet: the batch shards over every mesh axis
+            # jointly (dcn x data) so the resized dcn extent changes the
+            # shard count, not the math
+            batch_axes = tuple(str(a) for a in mesh.axis_names)
+            row_multiple = int(np.prod([int(mesh.shape[a])
+                                        for a in mesh.axis_names]))
+        batcher = FixedRowBatcher(row_multiple)
         dense_col, cat_col = self.DENSE_FEATURES_COL, self.CAT_FEATURES_COL
         label_col = self.get_label_col()
 
@@ -372,10 +424,10 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
             # (mask-weighted loss; lazy update drops weight-0 ids)
             return batcher.pad((dense, cat, y, mask), have=y.shape[0])
 
-        specs = (P("data", None), P("data", None), P("data"), P("data"))
+        specs = (P(batch_axes, None), P(batch_axes, None), P(batch_axes),
+                 P(batch_axes))
         # chunked dispatch (single-process): W batches per jitted scan —
         # W=1 is the bit-exact fallback through the SAME scan program
-        chunked = mesh_process_count(mesh) == 1
         W = max(1, int(steps_per_dispatch)) if chunked else 1
         if chunked:
             from ...data.prefetch import chunk_consumer_plan
@@ -421,26 +473,105 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
         epoch_sums: List = []   # per-epoch (device scalar, n_batches):
         max_epochs = self.get_max_iter()  # fetched ONCE after the loop so
         add = jax.jit(jnp.add)            # epoch boundaries never sync
-        for epoch in range(max_epochs):
+
+        global_step = 0         # checkpoint tick: batches over all epochs
+        start_epoch = 0
+        skip_steps = 0          # batches already consumed in start_epoch
+        resume_loss_sum = None
+        resume_n_batches = 0
+        if manager is not None and resume:
+            restored = manager.restore_latest()
+            if restored is not None:
+                global_step, saved, meta = restored
+                host_params = jax.device_get(saved["params"])
+                raw_step, _ = _make_train_ops(
+                    host_params, self.LEARNING_RATE,
+                    bool(self.LAZY_EMB_OPT))
+                params = replicate(host_params, mesh)
+                opt_state = replicate(jax.device_get(saved["opt_state"]),
+                                      mesh)
+                step_fn = (_build_chunk_step(raw_step) if chunked
+                           else jax.jit(raw_step, donate_argnums=(0, 1)))
+                start_epoch = int(meta["train_epoch"])
+                skip_steps = int(meta["step_in_epoch"])
+                resume_n_batches = int(meta["n_batches"])
+                if resume_n_batches:
+                    resume_loss_sum = jnp.asarray(saved["loss_sum"],
+                                                  jnp.float32)
+                epoch_sums = [(jnp.asarray(s, jnp.float32), int(n))
+                              for s, n in saved["epoch_sums"]]
+
+        def _save(epoch, step_in_epoch, loss_sum, n_batches):
+            manager.save(global_step, {
+                "params": params, "opt_state": opt_state,
+                "loss_sum": (loss_sum if loss_sum is not None
+                             else jnp.zeros((), jnp.float32)),
+                "epoch_sums": [(s, int(n)) for s, n in epoch_sums],
+            }, {
+                "train_epoch": epoch, "step_in_epoch": step_in_epoch,
+                "n_batches": n_batches,
+                **mesh_shape_meta(mesh, participant_count=row_multiple),
+            })
+
+        for epoch in range(start_epoch, max_epochs):
             reader = _reader_for_epoch(make_reader, epoch)
-            loss_sum = None
-            n_batches = 0
+            if epoch == start_epoch and skip_steps:
+                from ..common.sgd import _seek_or_skip
+
+                reader = _seek_or_skip(reader, skip_steps)
+            loss_sum = resume_loss_sum
+            n_batches = resume_n_batches
+            step_in_epoch = skip_steps
+            resume_loss_sum, resume_n_batches, skip_steps = None, 0, 0
             if chunked:
-                for chunk, cmask, n_valid in prefetch_to_device(
-                        reader, depth=chunk_depth,
-                        transform=to_host_batch, sharding=sharding,
-                        workers=prefetch_workers,
-                        put_workers=prefetch_put_workers,
-                        stats=prefetch_stats, chunks=W):
-                    if step_fn is None:
-                        params, opt_state, raw_step = _lazy_init(
-                            int(chunk[0].shape[2]))
-                        step_fn = _build_chunk_step(raw_step)
-                    if loss_sum is None:
-                        loss_sum = jnp.zeros((), jnp.float32)
-                    (params, opt_state), loss_sum = step_fn(
-                        (params, opt_state), loss_sum, chunk, cmask)
-                    n_batches += n_valid
+                # closed explicitly on every exit so a supervised
+                # restart (resize/crash recovery) never races a zombie
+                # reader thread for the shared source
+                pipeline = prefetch_to_device(
+                    reader, depth=chunk_depth,
+                    transform=to_host_batch, sharding=sharding,
+                    workers=prefetch_workers,
+                    put_workers=prefetch_put_workers,
+                    stats=prefetch_stats, chunks=W)
+                try:
+                    for chunk, cmask, n_valid in pipeline:
+                        if step_fn is None:
+                            params, opt_state, raw_step = _lazy_init(
+                                int(chunk[0].shape[2]))
+                            step_fn = _build_chunk_step(raw_step)
+                        if loss_sum is None:
+                            loss_sum = jnp.zeros((), jnp.float32)
+                        (params, opt_state), loss_sum = step_fn(
+                            (params, opt_state), loss_sum, chunk, cmask)
+                        n_batches += n_valid
+                        step_in_epoch += n_valid
+                        global_step += n_valid
+                        cut_done = False
+                        if (manager is not None
+                                and checkpoint_every_steps > 0
+                                and step_in_epoch // checkpoint_every_steps
+                                > (step_in_epoch - n_valid)
+                                // checkpoint_every_steps):
+                            _save(epoch, step_in_epoch, loss_sum,
+                                  n_batches)
+                            cut_done = True
+                        # elastic membership: one poll per chunk
+                        # boundary; a changed fleet cuts here and hands
+                        # the resize to the supervisor
+                        if membership is not None \
+                                and membership.poll(global_step):
+                            if manager is not None and not cut_done:
+                                _save(epoch, step_in_epoch, loss_sum,
+                                      n_batches)
+                            from ...parallel.elastic import ResizeRequested
+
+                            raise ResizeRequested(
+                                step=global_step,
+                                fleet_size=membership.fleet_size,
+                                membership_epoch=(
+                                    membership.membership_epoch))
+                finally:
+                    pipeline.close()
             else:
                 for dev_batch in prefetch_to_device(
                         reader, depth=prefetch_depth,
@@ -460,6 +591,8 @@ class WideDeep(WideDeepParams, Estimator["WideDeepModel"]):
             if loss_sum is None:
                 raise ValueError("make_reader() returned an empty epoch")
             epoch_sums.append((loss_sum, n_batches))
+            if manager is not None:
+                _save(epoch + 1, 0, None, 0)   # epoch-boundary cut
         loss_log = [float(np.asarray(fetch_replicated(s))) / n
                     for s, n in epoch_sums]
 

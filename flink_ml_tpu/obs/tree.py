@@ -107,7 +107,7 @@ class MetricsTree:
 def default_tree(*, endpoint: Any = None, serving: Any = None,
                  scheduler: Any = None, recovery: Any = None,
                  stream_info: Any = None, iteration_result: Any = None,
-                 tracer: Any = None) -> MetricsTree:
+                 tracer: Any = None, elastic: Any = None) -> MetricsTree:
     """A :class:`MetricsTree` pre-wired to every standard surface that
     exists in this process:
 
@@ -131,7 +131,13 @@ scheduler.SharedScheduler`'s subtree (class-labeled shed counters,
     - ``iteration`` — an ``IterationResult``'s ``side`` (the workset
       ``epoch_trace`` + termination reason);
     - ``trace`` — span-tracer volume counters (never the spans
-      themselves — those export via the tracer's own writers).
+      themselves — those export via the tracer's own writers);
+    - ``elastic`` — an
+      :class:`~flink_ml_tpu.parallel.elastic.ElasticCoordinator`'s
+      fleet gauges (fleet size, membership epoch, join/leave/death/
+      suppression counters, resizes) so an operator can correlate a
+      loss-curve kink or a step-time shift with the membership
+      transition that caused it.
     """
     from ..kernels.registry import kernel_stats
 
@@ -156,6 +162,8 @@ scheduler.SharedScheduler`'s subtree (class-labeled shed counters,
         tree.register("trace", lambda: {
             "enabled": tracer.enabled, "spans": tracer.count,
             "dropped": tracer.dropped})
+    if elastic is not None:
+        tree.register("elastic", elastic)
     return tree
 
 

@@ -13,6 +13,8 @@ from .mesh import (  # noqa: F401
 from . import collectives  # noqa: F401
 from . import grad_reduce  # noqa: F401
 from .grad_reduce import GradReduceConfig  # noqa: F401
+from . import elastic  # noqa: F401
+from .elastic import ElasticCoordinator, ResizeRequested  # noqa: F401
 from .moe import (  # noqa: F401
     EXPERT_AXIS,
     MoEParams,

@@ -1,0 +1,437 @@
+"""Elastic data-parallel membership — the fleet as a runtime input.
+
+The meshes every fit in this repo trains on were frozen at ``fit()``
+time: one preempted worker killed the run, and ``resilient_fit`` could
+only restart onto the *same* fleet.  MLFabric (PAPERS.md) treats
+membership as an input the scheduler reacts to; at production scale
+preemption is routine, so this module makes the **dcn axis of the
+hybrid mesh grow and shrink between chunk boundaries**:
+
+- :class:`ElasticCoordinator` — a heartbeat **lease table** over live
+  workers with an injected clock (`clock=`), so lease expiry is a
+  deterministic, testable event rather than a wall-clock race.  Each
+  worker owns ``chips_per_worker`` devices from a fixed pool; the
+  current fleet materializes as a ``(dcn, data)`` mesh over the live
+  workers' devices in join order.
+- Membership churn is **injectable through the fault seams**: the
+  streaming fits call :meth:`ElasticCoordinator.poll` once per chunk
+  boundary, which fires the ``elastic.membership`` fault scope — a
+  scheduled ``"join"`` / ``"preempt"`` fault (:mod:`..robustness.faults`)
+  becomes a deterministic join/leave transition, so chaos tests replay
+  bit-identically, schedule for schedule, exactly like crash injection.
+- A **resize is a restore onto a different mesh**: when ``poll``
+  reports a changed fleet, the fit cuts a chunk-boundary checkpoint
+  (PR 5 layout, now carrying mesh-shape metadata) and raises
+  :class:`ResizeRequested`; ``resilient_fit(elastic=...)`` rebuilds the
+  mesh at the new dcn extent and re-runs with ``resume=True``.  The
+  restore re-shards the full training carry — params/optimizer state
+  replicate onto the new mesh, and the participant-stacked reducer
+  state (EF residuals, ``pending`` overlap buffers, adaptive
+  rung/EMA/tick, rounding keys) routes through
+  :func:`~.grad_reduce.reshard_state`, which re-embeds residuals at
+  their new shard slices the way the PR 3 hierarchical composition
+  already does.
+
+Exactness contract: a resize at a chunk boundary is **bit-exact vs a
+fixed fleet of the new size** restoring the same cut (same reduce
+order — both sides route through the same reshard mapping and the same
+compiled program).  A worker *death mid-chunk* degrades to the existing
+crash path: the supervisor revokes the victim's lease
+(:meth:`ElasticCoordinator.on_failure`) and recovery resumes from the
+newest valid cut onto the surviving fleet.  Both transitions share one
+code path and one ``RecoveryReport``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["ElasticCoordinator", "FleetView", "ResizeRequested",
+           "WorkerLease", "MEMBERSHIP_SCOPE"]
+
+#: The fault scope :meth:`ElasticCoordinator.poll` fires once per chunk
+#: boundary — schedule ``"preempt"`` / ``"join"`` faults against it to
+#: drive deterministic membership churn (indices count chunk boundaries
+#: across the whole supervised run, attempts included).
+MEMBERSHIP_SCOPE = "elastic.membership"
+
+
+class ResizeRequested(RuntimeError):
+    """Raised by an elastic fit at a chunk boundary AFTER the boundary
+    checkpoint is durable: membership changed, so training must restore
+    onto the new fleet's mesh.  Handled by
+    ``resilient_fit(elastic=...)`` — reaching user code means a fit ran
+    with ``membership=`` but without an elastic supervisor."""
+
+    def __init__(self, *, step: int, fleet_size: int,
+                 membership_epoch: int):
+        super().__init__(
+            f"fleet changed to {fleet_size} worker(s) (membership epoch "
+            f"{membership_epoch}) at step {step}; restore onto the new "
+            "mesh")
+        self.step = step
+        self.fleet_size = fleet_size
+        self.membership_epoch = membership_epoch
+
+
+@dataclass
+class WorkerLease:
+    """One worker's seat in the fleet: the devices it contributes and
+    the heartbeat lease that keeps it alive.  ``expires_at`` is in the
+    coordinator's injected clock domain; ``order`` is the join order
+    (the deterministic LIFO victim rule keys on it)."""
+
+    worker_id: str
+    devices: Tuple[Any, ...]
+    joined_at: float
+    expires_at: float
+    order: int
+
+
+@dataclass(frozen=True)
+class FleetView:
+    """An immutable snapshot of membership: what :meth:`mesh` was built
+    from, and what the obs gauges export."""
+
+    epoch: int
+    workers: Tuple[str, ...]
+
+    @property
+    def size(self) -> int:
+        return len(self.workers)
+
+
+class ElasticCoordinator:
+    """Heartbeat lease table + mesh factory for an elastic dcn fleet.
+
+    Workers own ``chips_per_worker`` devices from ``devices`` (default:
+    every local device), assigned lowest-free-first so the fleet's
+    device layout — and therefore the mesh, the programs, and the
+    numerics — is a pure function of the transition history.  The mesh
+    is ``{dcn_axis: fleet_size, data_axis: chips_per_worker}`` over the
+    live workers' devices in join order: heavy collectives ride the
+    intra-worker axis, the elastic (resized) extent is the leading dcn
+    axis — the ``hybrid_mesh`` layout with the host dimension made
+    dynamic.
+
+    Transitions:
+
+    - :meth:`register` / :meth:`leave` — planned join/leave;
+    - :meth:`fail` — unplanned death (lease revoked; the supervisor's
+      :meth:`on_failure` calls this with the deterministic LIFO victim
+      when a crash carries no worker identity);
+    - :meth:`expire` — clock-driven: a worker whose lease lapsed
+      (missed heartbeats past ``lease_timeout_s``) is declared dead.
+      ``lease_timeout_s=None`` (the single-process harness default)
+      disables expiry — transitions then come only from explicit calls
+      and injected faults.
+
+    Every transition bumps ``membership_epoch`` and appends to
+    ``transitions`` (the audit log chaos tests read, the
+    ``plan.fires`` analog).  ``min_workers``/``max_workers`` bound the
+    fleet; a transition that would cross a bound is *suppressed* and
+    counted (``suppressed``) rather than raised — a chaos schedule must
+    not be able to kill the run by shrinking past the floor.
+    """
+
+    SCOPE = MEMBERSHIP_SCOPE
+
+    def __init__(self, *, chips_per_worker: int = 1,
+                 initial_workers: Optional[int] = None,
+                 min_workers: int = 1,
+                 max_workers: Optional[int] = None,
+                 lease_timeout_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 devices: Optional[List[Any]] = None,
+                 dcn_axis: str = "dcn", data_axis: str = "data"):
+        import jax
+
+        if chips_per_worker < 1:
+            raise ValueError("chips_per_worker must be >= 1")
+        self._pool: List[Any] = list(
+            devices if devices is not None else jax.devices())
+        pool_max = len(self._pool) // chips_per_worker
+        if pool_max < 1:
+            raise ValueError(
+                f"device pool of {len(self._pool)} cannot seat one worker "
+                f"of {chips_per_worker} chip(s)")
+        self.chips_per_worker = int(chips_per_worker)
+        self.min_workers = int(min_workers)
+        self.max_workers = int(max_workers if max_workers is not None
+                               else pool_max)
+        self.max_workers = min(self.max_workers, pool_max)
+        if not 1 <= self.min_workers <= self.max_workers:
+            raise ValueError(
+                f"need 1 <= min_workers ({self.min_workers}) <= "
+                f"max_workers ({self.max_workers})")
+        self.lease_timeout_s = lease_timeout_s
+        self.clock = clock
+        self.dcn_axis = dcn_axis
+        self.data_axis = data_axis
+        self._lock = threading.RLock()
+        self._leases: Dict[str, WorkerLease] = {}
+        self._epoch = 0            # membership epoch: bumps per transition
+        self._built_epoch = -1     # epoch the last mesh() materialized
+        self._next_id = 0
+        self._next_order = 0
+        #: audit log: (kind, worker_id, membership_epoch) per transition,
+        #: kinds join/leave/preempt/death/expire/suppressed
+        self.transitions: List[Tuple[str, str, int]] = []
+        self.counters: Dict[str, int] = {
+            "joins": 0, "leaves": 0, "preemptions": 0, "deaths": 0,
+            "expirations": 0, "suppressed": 0, "resizes": 0,
+        }
+        n0 = initial_workers if initial_workers is not None else pool_max
+        if not self.min_workers <= n0 <= self.max_workers:
+            raise ValueError(
+                f"initial_workers={n0} outside "
+                f"[{self.min_workers}, {self.max_workers}]")
+        for _ in range(n0):
+            self.register()
+        # the initial fleet is the baseline, not a pending resize
+        self.transitions.clear()
+        self.counters["joins"] = 0
+        self._epoch = 0
+        self._built_epoch = 0
+
+    # -- lease table -------------------------------------------------------
+
+    def _expiry(self, now: float) -> float:
+        if self.lease_timeout_s is None:
+            return float("inf")
+        return now + self.lease_timeout_s
+
+    def _free_devices(self) -> List[Any]:
+        held = {id(d) for lease in self._leases.values()
+                for d in lease.devices}
+        return [d for d in self._pool if id(d) not in held]
+
+    def _record(self, kind: str, worker_id: str) -> None:
+        self._epoch += 1
+        self.transitions.append((kind, worker_id, self._epoch))
+        from ..obs.trace import tracer
+
+        tracer.instant("membership", cat="train", x_kind=kind,
+                       x_worker=worker_id, x_fleet=len(self._leases))
+
+    def register(self, worker_id: Optional[str] = None) -> Optional[str]:
+        """A worker joins: seat it on the next free devices (lowest pool
+        index first — deterministic layout).  Returns the worker id, or
+        ``None`` when the join was suppressed (fleet already at
+        ``max_workers`` / pool exhausted)."""
+        with self._lock:
+            if len(self._leases) >= self.max_workers:
+                self.counters["suppressed"] += 1
+                self.transitions.append(
+                    ("suppressed", worker_id or "<join>", self._epoch))
+                return None
+            free = self._free_devices()
+            devs = tuple(free[:self.chips_per_worker])
+            if worker_id is None:
+                worker_id = f"w{self._next_id}"
+            self._next_id += 1
+            if worker_id in self._leases:
+                raise ValueError(f"worker {worker_id!r} already registered")
+            now = self.clock()
+            self._leases[worker_id] = WorkerLease(
+                worker_id=worker_id, devices=devs, joined_at=now,
+                expires_at=self._expiry(now), order=self._next_order)
+            self._next_order += 1
+            self.counters["joins"] += 1
+            self._record("join", worker_id)
+            return worker_id
+
+    def heartbeat(self, worker_id: str) -> None:
+        """Renew a worker's lease (no membership change)."""
+        with self._lock:
+            lease = self._leases.get(worker_id)
+            if lease is None:
+                raise KeyError(f"no live lease for worker {worker_id!r}")
+            lease.expires_at = self._expiry(self.clock())
+
+    def _remove(self, worker_id: str, kind: str) -> bool:
+        if worker_id not in self._leases:
+            raise KeyError(f"no live lease for worker {worker_id!r}")
+        if len(self._leases) <= self.min_workers:
+            self.counters["suppressed"] += 1
+            self.transitions.append(("suppressed", worker_id, self._epoch))
+            return False
+        del self._leases[worker_id]
+        self.counters[{"leave": "leaves", "preempt": "preemptions",
+                       "death": "deaths", "expire": "expirations"}[kind]] += 1
+        self._record(kind, worker_id)
+        return True
+
+    def leave(self, worker_id: str) -> bool:
+        """Planned departure (drained at the next chunk boundary)."""
+        with self._lock:
+            return self._remove(worker_id, "leave")
+
+    def fail(self, worker_id: str) -> bool:
+        """Unplanned death: the lease is revoked immediately."""
+        with self._lock:
+            return self._remove(worker_id, "death")
+
+    def expire(self) -> List[str]:
+        """Clock-driven reaping: every worker whose lease lapsed is
+        declared dead.  Returns the expired worker ids."""
+        with self._lock:
+            now = self.clock()
+            lapsed = [w for w, lease in self._leases.items()
+                      if lease.expires_at < now]
+            return [w for w in lapsed if self._remove(w, "expire")]
+
+    def _newest(self) -> Optional[str]:
+        if not self._leases:
+            return None
+        return max(self._leases.values(), key=lambda l: l.order).worker_id
+
+    def preempt(self) -> Optional[str]:
+        """The injected-``"preempt"`` transition: remove the newest
+        live worker (LIFO — deterministic by construction, so a seeded
+        schedule always removes the same seat)."""
+        with self._lock:
+            victim = self._newest()
+            if victim is not None and self._remove(victim, "preempt"):
+                return victim
+            return None
+
+    # -- fleet views -------------------------------------------------------
+
+    @property
+    def fleet_size(self) -> int:
+        with self._lock:
+            return len(self._leases)
+
+    @property
+    def membership_epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def live_workers(self) -> Tuple[str, ...]:
+        """Live worker ids in join order (the mesh's dcn order)."""
+        with self._lock:
+            return tuple(sorted(self._leases,
+                                key=lambda w: self._leases[w].order))
+
+    def fleet(self) -> FleetView:
+        with self._lock:
+            return FleetView(epoch=self._epoch, workers=self.live_workers())
+
+    def mesh(self):
+        """Materialize the CURRENT fleet as a ``(dcn, data)`` mesh over
+        the live workers' devices in join order — and mark that fleet
+        consumed, so :meth:`poll` reports ``True`` only for membership
+        the training mesh has not absorbed yet."""
+        from jax.sharding import Mesh
+
+        with self._lock:
+            workers = self.live_workers()
+            devs = [d for w in workers
+                    for d in self._leases[w].devices]
+            self._built_epoch = self._epoch
+            dev_array = np.asarray(devs, dtype=object).reshape(
+                len(workers), self.chips_per_worker)
+            return Mesh(dev_array, axis_names=(self.dcn_axis,
+                                               self.data_axis))
+
+    # -- the chunk-boundary seam ------------------------------------------
+
+    def poll(self, step: Optional[int] = None) -> bool:
+        """The fits' once-per-chunk-boundary membership check.
+
+        Fires the ``elastic.membership`` fault seam (one invocation per
+        boundary — schedule indices count boundaries across the whole
+        supervised run), translating an injected ``"join"`` into
+        :meth:`register` and an injected ``"preempt"`` into
+        :meth:`preempt`; any other injected kind (e.g. ``"crash"``)
+        propagates to the caller like a crash at any other seam.  Then
+        reaps lapsed leases and reports whether membership moved past
+        the fleet the current mesh was built from — ``True`` means the
+        caller must cut a boundary checkpoint and raise
+        :class:`ResizeRequested`."""
+        from ..robustness.faults import (
+            InjectedJoin,
+            InjectedPreemption,
+            fault_point,
+        )
+
+        try:
+            fault_point(self.SCOPE)
+        except InjectedPreemption:
+            self.preempt()
+        except InjectedJoin:
+            self.register()
+        self.expire()
+        with self._lock:
+            return self._epoch != self._built_epoch
+
+    def on_failure(self, exc: Optional[BaseException] = None
+                   ) -> Optional[str]:
+        """The supervisor's crash hook: first reap lapsed leases (a real
+        worker death surfaces as silence — missed heartbeats); if no
+        lease had lapsed AND the failure is worker-loss-shaped (an
+        injected crash or a lost-peer connection/timeout — a disk-full
+        or corrupt-state error is NOT a dead worker, and shrinking on
+        it would monotonically evict healthy seats on I/O blips),
+        revoke the newest worker's lease (the deterministic stand-in
+        for 'the crashed worker' in the single-process harness, bounded
+        by ``min_workers``).  Returns the removed worker id, or
+        ``None`` when the fleet stayed put (recovery then resumes on
+        the same mesh — plain crash recovery)."""
+        from ..robustness.faults import InjectedCrash
+
+        expired = self.expire()
+        if expired:
+            return expired[0]
+        if exc is not None and not isinstance(
+                exc, (InjectedCrash, ConnectionError, TimeoutError)):
+            return None
+        with self._lock:
+            victim = self._newest()
+            if (victim is not None
+                    and len(self._leases) > self.min_workers
+                    and self._remove(victim, "death")):
+                return victim
+            return None
+
+    def note_resize(self) -> None:
+        """Supervisor hook: count a completed resize transition (the
+        restore-onto-new-mesh the ``resizes`` gauge reports)."""
+        with self._lock:
+            self.counters["resizes"] += 1
+
+    # -- observability -----------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Fleet-state snapshot for a :class:`~..obs.tree.MetricsTree`
+        (``default_tree(elastic=...)``)."""
+        with self._lock:
+            return {
+                "fleet_size": len(self._leases),
+                "membership_epoch": self._epoch,
+                "workers": list(self.live_workers()),
+                "chips_per_worker": self.chips_per_worker,
+                "min_workers": self.min_workers,
+                "max_workers": self.max_workers,
+                **{k: int(v) for k, v in self.counters.items()},
+            }
+
+    def publish(self, group) -> None:
+        """Export the fleet gauges into a ``MetricGroup`` subtree
+        (``elastic.fleet_size`` etc.) next to every other framework
+        metric."""
+        sub = group.add_group("elastic")
+        snap = self.snapshot()
+        for key in ("fleet_size", "membership_epoch", "chips_per_worker",
+                    "min_workers", "max_workers"):
+            sub.gauge(key).set(snap[key])
+        for key in ("joins", "leaves", "preemptions", "deaths",
+                    "expirations", "suppressed", "resizes"):
+            sub.gauge(key).set(snap[key])

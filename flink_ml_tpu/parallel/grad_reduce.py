@@ -85,7 +85,9 @@ __all__ = [
     "plan_buckets",
     "reduce_gradients",
     "reduction_axes",
+    "reshard_state",
     "squeeze_state",
+    "state_participants",
     "unsqueeze_state",
     "wants_overlap",
 ]
@@ -300,6 +302,80 @@ def squeeze_state(state: dict) -> dict:
 def unsqueeze_state(state: dict) -> dict:
     """Restore the leading participant dim on the way out of ``shard_map``."""
     return jax.tree_util.tree_map(lambda a: a[None], state)
+
+
+def state_participants(state: Optional[dict]) -> Optional[int]:
+    """The participant count a stacked reducer state was built for (the
+    leading dim every leaf shares), or ``None`` for empty/absent state."""
+    leaves = jax.tree_util.tree_leaves(state or {})
+    if not leaves:
+        return None
+    return int(np.shape(leaves[0])[0])
+
+
+def reshard_state(state: dict, n_new: int, *,
+                  ici_size: int = 1) -> dict:
+    """Re-shard participant-stacked reducer state onto a fleet of
+    ``n_new`` participants — THE resize-as-restore mapping (elastic PR).
+    The mapping depends only on the state's leaf keys and the (fixed)
+    ICI extent, never on the reduce mode — which is why there is no
+    config parameter.
+
+    Mass-carrying leaves (``ef`` residual, ``pending`` overlap buffer)
+    are **total-preserving**: the old participants' contributions are
+    summed — per ICI position for the hierarchical layout, so each
+    shard-domain residual stays embedded at its own slice exactly as
+    :func:`_embed_shard` placed it — and the total is seated on the new
+    fleet's first dcn group, the rest zero-initialized.  Policy state
+    (``ema``/``rung``/``tick``) is replicated content by construction
+    and broadcasts from participant 0; rounding ``key`` rows re-derive
+    deterministically by folding the new participant index into
+    participant 0's carried key.
+
+    Deterministic and host-side: an elastic resize AND a fixed fleet of
+    the new size restoring the same cut both route through this
+    function, which is what makes the two bit-exact from the boundary
+    onward (the fit-level contract asserted in tests/test_faults.py).
+    """
+    n_old = state_participants(state)
+    if n_old is None or n_old == n_new:
+        return state
+    if ici_size < 1 or n_old % ici_size or n_new % ici_size:
+        raise ValueError(
+            f"cannot reshard reducer state from {n_old} to {n_new} "
+            f"participants at ici_size={ici_size}: both fleet sizes must "
+            "be multiples of the (fixed) ICI extent")
+    d_new = n_new // ici_size
+
+    def collapse(a):
+        a = np.asarray(a, np.float32)
+        tail = a.shape[1:]
+        total = a.reshape((n_old // ici_size, ici_size) + tail).sum(axis=0)
+        out = np.zeros((d_new, ici_size) + tail, np.float32)
+        out[0] = total
+        return out.reshape((n_new,) + tail)
+
+    def broadcast0(a):
+        a = np.asarray(a)
+        return np.broadcast_to(a[:1], (n_new,) + a.shape[1:]).copy()
+
+    out: dict = {}
+    for key, value in state.items():
+        if key in ("ef", "pending"):
+            out[key] = jax.tree_util.tree_map(collapse, value)
+        elif key in ("ema", "rung", "tick"):
+            out[key] = broadcast0(value)
+        elif key == "key":
+            base = jnp.asarray(np.asarray(value)[0])
+            out[key] = np.asarray(jax.vmap(
+                lambda i: jax.random.fold_in(base, i))(
+                    jnp.arange(n_new, dtype=jnp.int32)))
+        else:
+            raise ValueError(
+                f"unknown reducer-state leaf {key!r}: teach reshard_state "
+                "its resize semantics before restoring it onto a "
+                "different fleet")
+    return out
 
 
 # ---------------------------------------------------------------------------

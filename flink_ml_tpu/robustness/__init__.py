@@ -29,6 +29,8 @@ from .faults import (
     FaultPlan,
     InjectedCrash,
     InjectedDiskFullError,
+    InjectedJoin,
+    InjectedPreemption,
     InjectedTransientError,
     corrupt_file,
     fault_point,
@@ -49,6 +51,7 @@ from .supervisor import RecoveryEvent, RecoveryReport, resilient_fit
 
 __all__ = [
     "FaultPlan", "InjectedCrash", "InjectedDiskFullError",
+    "InjectedJoin", "InjectedPreemption",
     "InjectedTransientError", "corrupt_file", "fault_point",
     "COMMIT_MARKER", "MANIFEST_NAME", "CorruptStateError", "commit_dir",
     "is_committed",

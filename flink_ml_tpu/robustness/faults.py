@@ -32,10 +32,20 @@ Fault kinds:
 - ``"torn"`` / ``"flip"`` — **data** faults at file scopes: the bytes
   just written are truncated / bit-flipped *before* the commit rename,
   producing a committed-but-invalid artifact that only manifest/CRC
-  validation (:mod:`.durability`) can catch.
+  validation (:mod:`.durability`) can catch;
+- ``"preempt"`` / ``"join"`` — **membership** faults (elastic PR):
+  raise :class:`InjectedPreemption` / :class:`InjectedJoin` at the
+  seam, which the elastic coordinator's chunk-boundary ``poll``
+  (``parallel/elastic.py``) translates into a deterministic
+  leave/join transition.  Seedable like every other kind
+  (:meth:`FaultPlan.inject_random` works unchanged), and — because
+  :meth:`FaultPlan.fire` runs BEFORE the wrapped operation —
+  ``wrap_source``-style wrappers stay lossless across a resize: a
+  membership fault never consumes an item.
 
-Control faults (transient/crash/enospc) are valid at every scope; data
-faults only where a file path reaches the injection point.
+Control faults (transient/crash/enospc and the membership pair) are
+valid at every scope; data faults only where a file path reaches the
+injection point.
 """
 
 from __future__ import annotations
@@ -48,6 +58,7 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 __all__ = [
     "FaultPlan", "InjectedCrash", "InjectedDiskFullError",
+    "InjectedJoin", "InjectedPreemption",
     "InjectedTransientError", "corrupt_file", "fault_point", "active_plan",
 ]
 
@@ -70,7 +81,22 @@ class InjectedDiskFullError(OSError):
         super().__init__(errno.ENOSPC, message)
 
 
-_CONTROL_KINDS = ("transient", "crash", "enospc")
+class InjectedPreemption(RuntimeError):
+    """A membership fault: the scheduler reclaimed a worker.  Raised at
+    the seam BEFORE the wrapped operation (nothing is consumed — the
+    lossless ``wrap_source`` contract holds across a resize) and
+    translated by the elastic coordinator's ``poll`` into a
+    deterministic leave transition; it is NOT a retryable error and
+    must never be swallowed by a retry loop."""
+
+
+class InjectedJoin(RuntimeError):
+    """The membership fault dual of :class:`InjectedPreemption`: a new
+    worker asks to join.  Same raise-before-the-operation contract;
+    translated by the coordinator's ``poll`` into a join transition."""
+
+
+_CONTROL_KINDS = ("transient", "crash", "enospc", "preempt", "join")
 _DATA_KINDS = ("torn", "flip")
 
 
@@ -218,6 +244,12 @@ class FaultPlan:
             if spec.kind == "enospc":
                 raise InjectedDiskFullError(
                     f"injected ENOSPC at {scope}[{idx}]")
+            if spec.kind == "preempt":
+                raise InjectedPreemption(
+                    f"injected preemption at {scope}[{idx}]")
+            if spec.kind == "join":
+                raise InjectedJoin(
+                    f"injected join at {scope}[{idx}]")
             if path is None:
                 raise ValueError(
                     f"data fault {spec.kind!r} scheduled at {scope}[{idx}] "

@@ -52,27 +52,42 @@ def default_recoverable(exc: BaseException) -> bool:
 
 @dataclass
 class RecoveryEvent:
-    """One detected failure + the recovery that followed."""
+    """One detected failure — or planned fleet resize — + the recovery
+    that followed.  ``kind`` is ``"crash"`` (unplanned: injected crash,
+    I/O failure, worker death) or ``"resize"`` (planned elasticity: a
+    membership change detected at a chunk boundary); both ride the same
+    restore-and-continue transition, so ``mttr_s`` doubles as the
+    resize-pause wall (detect -> restore complete) the elastic bench
+    leg reports."""
     error: str
     detected_at: float
     backoff_s: float = 0.0
     restored_step: Optional[int] = None
     mttr_s: Optional[float] = None   # detect -> restore complete
+    kind: str = "crash"
+    fleet_size: Optional[int] = None  # live workers AFTER the transition
 
 
 @dataclass
 class RecoveryReport:
-    """Filled in place by :func:`resilient_fit` (pass ``report=``)."""
+    """Filled in place by :func:`resilient_fit` (pass ``report=``).
+    Crash-elasticity and planned-elasticity share this one report:
+    ``restarts`` counts unplanned recoveries, ``resizes`` counts
+    planned membership transitions, and both append to ``events``."""
     restarts: int = 0
+    resizes: int = 0
     recovered: bool = False
     events: List[RecoveryEvent] = field(default_factory=list)
 
     def as_dict(self) -> dict:
         return {
             "restarts": self.restarts,
+            "resizes": self.resizes,
             "recovered": self.recovered,
             "events": [{
                 "error": e.error,
+                "kind": e.kind,
+                "fleet_size": e.fleet_size,
                 "backoff_s": round(e.backoff_s, 4),
                 "restored_step": e.restored_step,
                 "mttr_s": (round(e.mttr_s, 4)
@@ -89,6 +104,8 @@ def resilient_fit(fit: Callable, *args: Any,
                   = default_recoverable,
                   report: Optional[RecoveryReport] = None,
                   clock: Callable[[], float] = time.perf_counter,
+                  elastic: Any = None,
+                  max_resizes: int = 64,
                   **kwargs: Any) -> Any:
     """Run ``fit(*args, checkpoint=manager, resume=..., **kwargs)`` under
     supervision; returns whatever ``fit`` returns.
@@ -107,10 +124,32 @@ def resilient_fit(fit: Callable, *args: Any,
     back off on the policy's deterministic schedule (attempt i sleeps
     ``backoff.delay(i)``); a failure that ``recoverable`` rejects — or
     restart ``max_restarts + 1`` — re-raises immediately.
+
+    **Elastic fleets** (``elastic=`` — an
+    :class:`~flink_ml_tpu.parallel.elastic.ElasticCoordinator`): the
+    supervised fit must accept ``membership=``/``mesh=`` keywords
+    (``sgd_fit_outofcore`` and ``WideDeep.fit_outofcore`` do) — both
+    are injected per attempt, with the mesh rebuilt from the
+    coordinator's CURRENT fleet.  Two transitions share this one loop:
+
+    - *planned elasticity*: the fit raises
+      :class:`~flink_ml_tpu.parallel.elastic.ResizeRequested` at a
+      chunk boundary after cutting a checkpoint; the supervisor records
+      a ``kind="resize"`` event (no backoff, no restart budget
+      consumed — a resize is not a failure) and re-runs with
+      ``resume=True`` on the new mesh, which restores and re-shards the
+      carry there.  ``max_resizes`` bounds a pathological churn loop.
+    - *crash elasticity*: any recoverable failure additionally asks the
+      coordinator for the post-crash fleet
+      (:meth:`~flink_ml_tpu.parallel.elastic.ElasticCoordinator
+      .on_failure` — lapsed leases reaped, else the deterministic
+      victim), so recovery resumes onto the *surviving* fleet through
+      exactly the same restore-and-reshard path.
     """
     # local import: checkpoint.py imports robustness.durability, so a
     # top-level import here would cycle through the package __init__
     from ..iteration.checkpoint import CheckpointConfig, CheckpointManager
+    from ..parallel.elastic import ResizeRequested
 
     manager = (CheckpointManager(checkpoint)
                if isinstance(checkpoint, CheckpointConfig) else checkpoint)
@@ -127,12 +166,37 @@ def resilient_fit(fit: Callable, *args: Any,
     rep = report if report is not None else RecoveryReport()
     resume = bool(kwargs.pop("resume", False))
     restarts = 0
+    resizes = 0
     while True:
+        if elastic is not None:
+            kwargs["membership"] = elastic
+            kwargs["mesh"] = elastic.mesh()
         event: Optional[RecoveryEvent] = None
         if rep.events and rep.events[-1].mttr_s is None:
             event = rep.events[-1]
         try:
             result = fit(*args, checkpoint=manager, resume=resume, **kwargs)
+        except ResizeRequested as exc:
+            _close_event(event, manager, clock)
+            if elastic is None:
+                # a fit ran with membership= but nobody owns the resize
+                raise
+            if resizes >= max_resizes:
+                raise RuntimeError(
+                    f"fleet resized {resizes} times without the fit "
+                    "completing (max_resizes) — membership is churning "
+                    "faster than training progresses") from exc
+            resizes += 1
+            rep.resizes = resizes
+            elastic.note_resize()
+            rep.events.append(RecoveryEvent(
+                error=repr(exc)[:200], detected_at=clock(),
+                kind="resize", fleet_size=elastic.fleet_size))
+            tracer.instant("fleet_resize", cat="train",
+                           x_fleet=elastic.fleet_size,
+                           x_step=exc.step)
+            resume = True
+            continue
         except Exception as exc:  # noqa: BLE001 — classified below
             _close_event(event, manager, clock)
             if restarts >= max_restarts or not recoverable(exc):
@@ -140,9 +204,14 @@ def resilient_fit(fit: Callable, *args: Any,
             restarts += 1
             rep.restarts = restarts
             pause = backoff.delay(restarts - 1)
+            fleet_size = None
+            if elastic is not None:
+                # worker death: recovery resumes onto the surviving fleet
+                elastic.on_failure(exc)
+                fleet_size = elastic.fleet_size
             rep.events.append(RecoveryEvent(
                 error=repr(exc)[:200], detected_at=clock(),
-                backoff_s=pause))
+                backoff_s=pause, fleet_size=fleet_size))
             tracer.instant("recovery_restart", cat="train",
                            x_error=repr(exc)[:80])
             backoff.sleep(pause)
@@ -164,6 +233,14 @@ def _close_event(event: Optional["RecoveryEvent"], manager: Any,
     if restore_at is not None and restore_at >= event.detected_at:
         event.mttr_s = restore_at - event.detected_at
         event.restored_step = getattr(manager, "last_restored_step", None)
+        if event.kind == "resize":
+            # the resize-pause span: detect -> restore complete, where
+            # training resumes on the new fleet (both stamps from the
+            # supervisor's clock — the perf_counter timebase unless a
+            # test injected its own)
+            tracer.add("resize_pause", event.detected_at, restore_at,
+                       cat="train", x_fleet=event.fleet_size,
+                       step=event.restored_step)
     else:
         # no checkpoint existed yet: recovery was a cold re-run
         event.mttr_s = clock() - event.detected_at

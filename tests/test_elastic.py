@@ -1,0 +1,354 @@
+"""Elastic membership runtime — unit coverage.
+
+The chunk-boundary / fit-level contracts (bit-exact resize, kill+rejoin
+trajectory, torn-cut-during-resize, EF/pending across two resizes,
+death-mid-chunk) live in tests/test_faults.py next to the rest of the
+chaos suite; this file covers the machinery underneath: the heartbeat
+lease table under an injected clock, the FaultPlan membership kinds,
+the reducer-state reshard mapping, and the legacy-cut fleet gate.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from flink_ml_tpu.iteration.checkpoint import (
+    CorruptStateError,
+    mesh_shape_meta,
+    require_fleet_compat,
+)
+from flink_ml_tpu.parallel import grad_reduce as GR
+from flink_ml_tpu.parallel.elastic import (
+    ElasticCoordinator,
+    ResizeRequested,
+)
+from flink_ml_tpu.robustness import (
+    FaultPlan,
+    InjectedJoin,
+    InjectedPreemption,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- lease table -------------------------------------------------------------
+
+def test_lease_expiry_under_injected_clock():
+    """Missed heartbeats past the lease timeout reap the worker — the
+    real-deployment death signal, fully deterministic under the
+    injected clock."""
+    clock = FakeClock()
+    c = ElasticCoordinator(chips_per_worker=1, initial_workers=3,
+                           lease_timeout_s=5.0, clock=clock)
+    assert c.fleet_size == 3
+    clock.advance(4.0)
+    c.heartbeat("w0")
+    c.heartbeat("w1")          # w2 goes silent
+    clock.advance(2.0)         # w2's lease lapsed (6.0 > 5.0)
+    assert c.expire() == ["w2"]
+    assert c.live_workers() == ("w0", "w1")
+    assert c.counters["expirations"] == 1
+    # heartbeats renewed w0/w1 to 9.0 — still alive
+    assert c.expire() == []
+
+
+def test_heartbeat_unknown_worker_raises():
+    c = ElasticCoordinator(chips_per_worker=1, initial_workers=1)
+    with pytest.raises(KeyError, match="nope"):
+        c.heartbeat("nope")
+
+
+def test_membership_epoch_bumps_per_transition_and_floor_suppresses():
+    c = ElasticCoordinator(chips_per_worker=1, initial_workers=2,
+                           min_workers=2, max_workers=3)
+    assert c.membership_epoch == 0
+    assert c.register() == "w2"
+    assert c.membership_epoch == 1
+    # at max: join suppressed, epoch unchanged
+    assert c.register() is None
+    assert c.membership_epoch == 1
+    assert c.counters["suppressed"] == 1
+    assert c.leave("w2")
+    assert c.membership_epoch == 2
+    # at the min floor: preempt suppressed — a chaos schedule cannot
+    # shrink the fleet past min_workers and kill the run
+    assert c.preempt() is None
+    assert c.fleet_size == 2
+    assert c.counters["suppressed"] == 2
+
+
+def test_mesh_follows_join_order_and_marks_fleet_consumed():
+    devs = jax.devices()
+    c = ElasticCoordinator(chips_per_worker=2, initial_workers=2,
+                           devices=devs)
+    m = c.mesh()
+    assert dict(m.shape) == {"dcn": 2, "data": 2}
+    assert list(m.devices.flat) == devs[:4]
+    assert c.poll() is False
+    c.register()
+    assert c.poll() is True           # changed since the mesh was built
+    m2 = c.mesh()
+    assert dict(m2.shape) == {"dcn": 3, "data": 2}
+    assert list(m2.devices.flat) == devs[:6]
+    assert c.poll() is False          # consumed
+    # LIFO preempt frees the newest worker's devices
+    c.preempt()
+    assert list(c.mesh().devices.flat) == devs[:4]
+
+
+def test_on_failure_prefers_lapsed_lease_then_lifo_victim():
+    clock = FakeClock()
+    c = ElasticCoordinator(chips_per_worker=1, initial_workers=3,
+                           lease_timeout_s=5.0, clock=clock)
+    from flink_ml_tpu.robustness import InjectedCrash, \
+        InjectedDiskFullError
+
+    clock.advance(6.0)
+    c.heartbeat("w1")
+    c.heartbeat("w2")          # w0 silent -> its lease lapsed
+    assert c.on_failure(RuntimeError("boom")) == "w0"
+    assert c.counters["expirations"] == 1
+    # a failure that is not worker-loss-shaped (disk full, logic bug)
+    # never evicts a healthy seat — plain crash recovery instead
+    assert c.on_failure(InjectedDiskFullError("disk full")) is None
+    assert c.fleet_size == 2
+    # no lapsed lease + a crash: deterministic LIFO victim
+    assert c.on_failure(InjectedCrash("boom")) == "w2"
+    assert c.counters["deaths"] == 1
+    # min_workers floor: the fleet stays put, plain crash recovery
+    assert c.on_failure(InjectedCrash("boom")) is None
+    assert c.fleet_size == 1
+
+
+def test_snapshot_and_metric_group_publish():
+    from flink_ml_tpu.obs.tree import default_tree
+    from flink_ml_tpu.utils.metrics import MetricGroup
+
+    c = ElasticCoordinator(chips_per_worker=1, initial_workers=2)
+    c.register()
+    c.preempt()
+    snap = default_tree(elastic=c).snapshot()["elastic"]
+    assert snap["fleet_size"] == 2
+    assert snap["joins"] == 1 and snap["preemptions"] == 1
+    assert snap["membership_epoch"] == 2
+    g = MetricGroup("root")
+    c.publish(g)
+    flat = g.snapshot()
+    assert flat["elastic.fleet_size"] == 2
+    assert flat["elastic.preemptions"] == 1
+
+
+# -- FaultPlan membership kinds ---------------------------------------------
+
+def test_fault_plan_membership_kinds_raise_and_are_seedable():
+    plan = (FaultPlan().inject("s", at=0, kind="preempt")
+            .inject("s", at=1, kind="join"))
+    with pytest.raises(InjectedPreemption):
+        plan.fire("s")
+    with pytest.raises(InjectedJoin):
+        plan.fire("s")
+    assert plan.fires == [("s", 0, "preempt"), ("s", 1, "join")]
+    # seeded random schedules work for the membership kinds unchanged
+    a = FaultPlan(seed=9).inject_random("s", rate=0.2, horizon=40,
+                                        kind="preempt")
+    b = FaultPlan(seed=9).inject_random("s", rate=0.2, horizon=40,
+                                        kind="preempt")
+    assert a.scheduled("s") == b.scheduled("s") != []
+
+
+def test_wrap_source_membership_fault_is_lossless():
+    """A membership fault fires BEFORE the pull — the retried next()
+    still sees every item, so wrappers stay lossless across a resize
+    (the satellite contract)."""
+    plan = FaultPlan().inject("source.pull", at=1, kind="preempt")
+    src = plan.wrap_source([10, 11, 12])
+    assert next(src) == 10
+    with pytest.raises(InjectedPreemption):
+        next(src)
+    assert next(src) == 11
+    assert next(src) == 12
+
+
+def test_poll_translates_injected_churn_deterministically():
+    c = ElasticCoordinator(chips_per_worker=1, initial_workers=3)
+    c.mesh()
+    plan = (FaultPlan().inject(c.SCOPE, at=1, kind="preempt")
+            .inject(c.SCOPE, at=3, kind="join"))
+    with plan:
+        assert c.poll(0) is False
+        assert c.poll(1) is True      # preempt: newest worker left
+        assert c.live_workers() == ("w0", "w1")
+        c.mesh()
+        assert c.poll(2) is False
+        assert c.poll(3) is True      # join: a fresh seat
+    assert [t[0] for t in c.transitions] == ["preempt", "join"]
+    # a non-membership kind at the seam propagates like any crash
+    c.mesh()
+    plan2 = FaultPlan().inject(c.SCOPE, at=0, kind="crash")
+    from flink_ml_tpu.robustness import InjectedCrash
+
+    with plan2, pytest.raises(InjectedCrash):
+        c.poll(4)
+
+
+# -- reducer-state reshard ---------------------------------------------------
+
+def _topk_state(n, shape=(6,), overlap=True):
+    cfg = GR.GradReduceConfig(mode="topk", density=0.5, overlap=overlap)
+    like = {"w": np.zeros(shape, np.float32)}
+    st = jax.device_get(GR.init_state(cfg, like, n))
+    return cfg, st
+
+
+def test_reshard_state_preserves_total_mass_and_layout():
+    cfg, st = _topk_state(4)
+    rng = np.random.default_rng(0)
+    st["ef"]["w"] = rng.normal(size=(4, 6)).astype(np.float32)
+    st["pending"]["w"] = rng.normal(size=(4, 6)).astype(np.float32)
+    out = GR.reshard_state(st, 6)
+    assert out["ef"]["w"].shape == (6, 6)
+    # totals preserved exactly (the applied-mass invariant the drain
+    # and the EF recursion both ride)
+    np.testing.assert_array_equal(out["ef"]["w"].sum(0),
+                                  st["ef"]["w"].sum(0))
+    np.testing.assert_array_equal(out["pending"]["w"].sum(0),
+                                  st["pending"]["w"].sum(0))
+    # collapsed onto the first participant, rest zero
+    assert np.all(out["ef"]["w"][1:] == 0)
+
+
+def test_reshard_state_hier_keeps_slice_structure():
+    """Hierarchical EF residuals live embedded at each participant's ICI
+    slice; the resize must keep slice i's mass in slice-i rows so the
+    next reduce-scatter routes it home (the PR 3 re-embedding rule)."""
+    cfg = GR.GradReduceConfig(mode="topk", density=0.5, axis="data",
+                              dcn_axis="dcn")
+    like = {"w": np.zeros((8,), np.float32)}
+    st = jax.device_get(GR.init_state(cfg, like, 4))   # dcn=2 x ici=2
+    ef = np.zeros((4, 8), np.float32)
+    # participant (d, i) holds residual only in ICI slice i (4 elems)
+    for d in range(2):
+        for i in range(2):
+            ef[d * 2 + i, i * 4:(i + 1) * 4] = (d + 1) * (i + 1)
+    st["ef"] = {"w": ef}
+    out = GR.reshard_state(st, 6, ici_size=2)          # dcn 2 -> 3
+    w = out["ef"]["w"]
+    assert w.shape == (6, 8)
+    # dcn group 0 carries the per-slice totals, groups 1..2 are zero
+    np.testing.assert_array_equal(w[0], [3, 3, 3, 3, 0, 0, 0, 0])
+    np.testing.assert_array_equal(w[1], [0, 0, 0, 0, 6, 6, 6, 6])
+    assert np.all(w[2:] == 0)
+
+
+def test_reshard_state_policy_and_keys_deterministic():
+    cfg = GR.GradReduceConfig(mode="topk", density=0.25, adaptive=True,
+                              density_ladder=(0.1, 0.25, "int8", "exact"))
+    like = {"w": np.zeros((6,), np.float32)}
+    st = jax.device_get(GR.init_state(cfg, like, 2))
+    st["ema"] = np.asarray([[0.3], [0.3]], np.float32)
+    st["rung"] = np.asarray([[2], [2]], np.int32)
+    st["tick"] = np.asarray([5, 5], np.int32)
+    a = GR.reshard_state(st, 4)
+    b = GR.reshard_state(st, 4)
+    # policy state broadcasts (it is replicated content by construction)
+    np.testing.assert_array_equal(a["ema"],
+                                  np.full((4, 1), np.float32(0.3)))
+    np.testing.assert_array_equal(a["rung"], np.full((4, 1), 2))
+    np.testing.assert_array_equal(a["tick"], np.full((4,), 5))
+    # rounding keys re-derive deterministically and stay distinct
+    np.testing.assert_array_equal(a["key"], b["key"])
+    assert len({tuple(np.asarray(k).tolist()) for k in a["key"]}) == 4
+
+
+def test_reshard_state_same_size_is_identity_and_validates():
+    cfg, st = _topk_state(4)
+    assert GR.reshard_state(st, 4) is st
+    with pytest.raises(ValueError, match="ICI"):
+        GR.reshard_state(st, 6, ici_size=4)
+    cfg2, st2 = _topk_state(2)
+    st2["mystery"] = np.zeros((2, 3), np.float32)
+    with pytest.raises(ValueError, match="mystery"):
+        GR.reshard_state(st2, 4)
+    assert GR.state_participants(st) == 4
+    assert GR.state_participants({}) is None
+    assert GR.state_participants(None) is None
+
+
+# -- fleet-compat gate -------------------------------------------------------
+
+def test_require_fleet_compat_legacy_cut_raises_diagnosable():
+    with pytest.raises(CorruptStateError, match="mesh-shape metadata"):
+        require_fleet_compat({"epoch": 4}, saved_participants=4,
+                             current_participants=6, path="/ck/ckpt-4")
+    # same fleet: legacy cuts keep restoring fine
+    require_fleet_compat({"epoch": 4}, saved_participants=4,
+                         current_participants=4)
+    # a cut that says which fleet wrote it passes the gate (the caller
+    # then reshards)
+    mesh = ElasticCoordinator(chips_per_worker=2,
+                              initial_workers=2).mesh()
+    meta = mesh_shape_meta(mesh, participant_count=4)
+    assert meta["mesh_shape"] == {"dcn": 2, "data": 2}
+    assert meta["participant_count"] == 4
+    require_fleet_compat(meta, saved_participants=4,
+                         current_participants=6)
+
+
+def test_resize_requested_carries_fleet_identity():
+    exc = ResizeRequested(step=12, fleet_size=3, membership_epoch=2)
+    assert exc.step == 12 and exc.fleet_size == 3
+    assert "3 worker" in str(exc)
+
+
+def test_membership_without_checkpoint_or_supervisor_fails_loudly(tmp_path):
+    """The two misuse modes: an elastic fit without durable cuts has
+    nothing to resize from (ValueError at the fit), and a
+    ResizeRequested with no elastic supervisor must propagate, not be
+    swallowed as a crash."""
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    c = ElasticCoordinator(chips_per_worker=1, initial_workers=2)
+    with pytest.raises(ValueError, match="checkpoint"):
+        sgd_fit_outofcore(
+            logistic_loss, lambda: iter([]), num_features=4,
+            config=SGDConfig(max_epochs=1), mesh=c.mesh(), membership=c)
+
+    from flink_ml_tpu.iteration import CheckpointConfig
+    from flink_ml_tpu.robustness import resilient_fit
+
+    def fake_fit(*, checkpoint, resume):
+        raise ResizeRequested(step=0, fleet_size=2, membership_epoch=1)
+
+    with pytest.raises(ResizeRequested):
+        resilient_fit(fake_fit,
+                      checkpoint=CheckpointConfig(str(tmp_path / "ck")))
+
+
+def test_membership_flat_compressed_config_rejected(tmp_path):
+    """A flat (non-hierarchical) compressed grad_reduce on an elastic
+    (dcn, data) mesh would silently replicate the batch over the
+    resizable axis — refused with sizing guidance instead."""
+    from flink_ml_tpu.iteration import CheckpointConfig
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+    from flink_ml_tpu.parallel.grad_reduce import GradReduceConfig
+
+    c = ElasticCoordinator(chips_per_worker=2, initial_workers=2)
+    cfg = SGDConfig(max_epochs=1, grad_reduce=GradReduceConfig(
+        mode="topk", density=0.25))
+    with pytest.raises(ValueError, match="dcn_axis"):
+        sgd_fit_outofcore(
+            logistic_loss, lambda: iter([]), num_features=4, config=cfg,
+            mesh=c.mesh(), membership=c,
+            checkpoint=CheckpointConfig(str(tmp_path / "ck")))

@@ -963,3 +963,549 @@ def test_workset_iterate_crash_mid_run_resumes_bitexact(tmp_path):
         np.testing.assert_array_equal(
             np.asarray(result.workset.bounds[key]),
             np.asarray(oracle.workset.bounds[key]))
+
+
+# -- elastic data-parallel training (ISSUE 15) -------------------------------
+#
+# The elastic contract, asserted at the FIT level: a resize at a chunk
+# boundary is bit-exact vs a fixed fleet of the new size restoring the
+# same cut (same reduce order), EF residuals and pending overlap
+# buffers included; a worker death mid-chunk degrades to the crash path
+# and resumes onto the surviving fleet; kill+rejoin churn stays within
+# the PR 6 adaptive tolerance of the fixed-fleet loss trajectory.
+
+def _elastic_coord(workers, chips=2):
+    from flink_ml_tpu.parallel.elastic import ElasticCoordinator
+
+    return ElasticCoordinator(chips_per_worker=chips,
+                              initial_workers=workers)
+
+
+def _elastic_gr():
+    from flink_ml_tpu.parallel.grad_reduce import GradReduceConfig
+
+    # topk + buckets + overlap + hierarchical: the richest carry — EF
+    # residual, pending buffer, rounding-free policy state — all of
+    # which must survive the resize re-shard
+    return GradReduceConfig(mode="topk", density=0.25, bucket_count=2,
+                            overlap=True, axis="data", dcn_axis="dcn")
+
+
+def _elastic_cache(tmp_path, name):
+    # 1440 rows / 240 = 6 batches per epoch; W=2 -> 3 chunk boundaries
+    # per epoch; 240 is divisible by every fleet extent used here
+    # (2x2=4, 3x2=6, 4x2=8, 1x2=2)
+    from flink_ml_tpu.data.datacache import DataCacheWriter
+
+    rng = np.random.default_rng(13)
+    true_w = rng.normal(size=(8,))
+    cache = str(tmp_path / name)
+    writer = DataCacheWriter(cache, segment_rows=480)
+    for _ in range(3):
+        X = rng.normal(size=(480, 8)).astype(np.float32)
+        writer.append({"features": X,
+                       "label": (X @ true_w > 0).astype(np.float32)})
+    writer.finish()
+    return cache
+
+
+def _copy_cut(src_dir, dst_dir, step):
+    import shutil
+
+    name = f"ckpt-{step:08d}"
+    os.makedirs(dst_dir, exist_ok=True)
+    shutil.copytree(os.path.join(src_dir, name),
+                    os.path.join(dst_dir, name))
+
+
+def test_elastic_resize_at_boundary_bitexact_vs_fixed_fleet(tmp_path):
+    """THE elastic acceptance: a join at a chunk boundary (fleet 2 -> 3
+    over the dcn axis) under topk+overlap+hierarchical grad_reduce is
+    bit-exact — final params AND loss log — vs a fixed fleet of the new
+    size restoring the exact same cut.  EF residual and pending overlap
+    buffer both ride the re-shard (they are nonzero at the boundary by
+    construction of the config)."""
+    from flink_ml_tpu.data.datacache import DataCacheReader
+    from flink_ml_tpu.iteration.checkpoint import CheckpointManager
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    cache = _elastic_cache(tmp_path, "c_el")
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=3, tol=0.0,
+                    grad_reduce=_elastic_gr())
+    kw = dict(num_features=8, config=cfg, cache_decoded=False,
+              steps_per_dispatch=2, checkpoint_every_steps=2)
+
+    def reader():
+        return DataCacheReader(cache, batch_rows=240)
+
+    # elastic run: join fires at chunk boundary 2 (global step 6 — the
+    # end-of-epoch-0 boundary), so epochs 1-2 train on the grown fleet
+    coord = _elastic_coord(2)
+    plan = FaultPlan().inject(coord.SCOPE, at=2, kind="join")
+    report = RecoveryReport()
+    with plan:
+        state_e, log_e = resilient_fit(
+            sgd_fit_outofcore, logistic_loss,
+            lambda: plan.wrap_source(reader()),
+            checkpoint=CheckpointConfig(str(tmp_path / "ck_e"),
+                                        max_to_keep=99),
+            elastic=coord,
+            backoff=RetryPolicy(base_delay=0.0, sleep=lambda s: None),
+            report=report, **kw)
+
+    assert report.resizes == 1 and report.restarts == 0
+    assert report.events[0].kind == "resize"
+    assert report.events[0].fleet_size == 3
+    assert report.events[0].mttr_s is not None   # the resize pause
+    assert report.events[0].restored_step == 6
+    assert coord.fleet_size == 3
+
+    # fixed fleet 2 with cuts kept: its step-6 cut is byte-identical to
+    # the elastic run's (same program up to the boundary)
+    c2 = _elastic_coord(2)
+    state_a, log_a = sgd_fit_outofcore(
+        logistic_loss, reader, mesh=c2.mesh(), membership=c2,
+        checkpoint=CheckpointConfig(str(tmp_path / "ck_a"),
+                                    max_to_keep=99), **kw)
+    # the cut records what fleet wrote it (the satellite contract)
+    mgr = CheckpointManager(CheckpointConfig(str(tmp_path / "ck_a")))
+    _, _, meta = mgr.latest()
+    assert meta["mesh_shape"] == {"dcn": 2, "data": 2}
+    assert meta["participant_count"] == 4
+
+    # fixed fleet of the NEW size restoring the same cut
+    _copy_cut(str(tmp_path / "ck_a"), str(tmp_path / "ck_b"), 6)
+    c3 = _elastic_coord(3)
+    state_b, log_b = sgd_fit_outofcore(
+        logistic_loss, reader, mesh=c3.mesh(), membership=c3,
+        checkpoint=CheckpointManager(CheckpointConfig(
+            str(tmp_path / "ck_b"), max_to_keep=99)),
+        resume=True, **kw)
+
+    np.testing.assert_array_equal(state_e.coefficients,
+                                  state_b.coefficients)
+    assert state_e.intercept == state_b.intercept
+    np.testing.assert_array_equal(log_e, log_b)
+    # and the resized run genuinely diverged from the fixed-2 run (the
+    # comparison is not vacuous)
+    assert not np.array_equal(state_e.coefficients, state_a.coefficients)
+
+
+def test_elastic_kill_and_rejoin_matches_fixed_fleet_trajectory(tmp_path):
+    """Chaos churn: a worker is killed at one boundary and a fresh one
+    joins a few chunks later.  The churned run's final loss must stay
+    within the PR 6 adaptive tolerance (1e-3) of the fixed-fleet run —
+    elasticity perturbs the compression schedule, never the
+    optimization."""
+    from flink_ml_tpu.data.datacache import DataCacheReader
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    cache = _elastic_cache(tmp_path, "c_churn")
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=4, tol=0.0,
+                    grad_reduce=_elastic_gr())
+    kw = dict(num_features=8, config=cfg, cache_decoded=False,
+              steps_per_dispatch=2, checkpoint_every_steps=2)
+
+    def reader():
+        return DataCacheReader(cache, batch_rows=240)
+
+    # fixed-fleet reference: 2 workers throughout
+    c_ref = _elastic_coord(2)
+    _, log_ref = sgd_fit_outofcore(
+        logistic_loss, reader, mesh=c_ref.mesh(), membership=c_ref,
+        checkpoint=CheckpointConfig(str(tmp_path / "ck_ref")), **kw)
+
+    # kill a worker every ~4 boundaries, add one back in between —
+    # periodic churn through the whole run (boundary indices count
+    # across supervised attempts, so the schedule is deterministic)
+    coord = _elastic_coord(2)
+    plan = (FaultPlan(seed=4)
+            .inject(coord.SCOPE, at=2, kind="preempt")
+            .inject(coord.SCOPE, at=4, kind="join")
+            .inject(coord.SCOPE, at=6, kind="preempt")
+            .inject(coord.SCOPE, at=8, kind="join"))
+    report = RecoveryReport()
+    with plan:
+        _, log_e = resilient_fit(
+            sgd_fit_outofcore, logistic_loss,
+            lambda: plan.wrap_source(reader()),
+            checkpoint=CheckpointConfig(str(tmp_path / "ck_ch"),
+                                        max_to_keep=99),
+            elastic=coord,
+            backoff=RetryPolicy(base_delay=0.0, sleep=lambda s: None),
+            report=report, **kw)
+
+    assert report.resizes == 4
+    assert coord.counters["preemptions"] == 2
+    assert coord.counters["joins"] == 2
+    assert coord.fleet_size == 2
+    assert len(log_e) == len(log_ref)
+    assert abs(log_e[-1] - log_ref[-1]) < 1e-3, (
+        "kill+rejoin churn drifted past the adaptive tolerance: "
+        f"{log_e[-1]} vs fixed-fleet {log_ref[-1]}")
+
+
+def test_elastic_torn_checkpoint_during_resize_resumes_bitexact(tmp_path):
+    """The resize's own boundary cut commits TORN bytes: the restore
+    onto the new fleet must quarantine it, fall back to the previous
+    valid cut, and replay the gap on the NEW fleet — landing bit-exact
+    on a fixed fleet of the new size restoring that same earlier cut."""
+    from flink_ml_tpu.data.datacache import DataCacheReader
+    from flink_ml_tpu.iteration.checkpoint import CheckpointManager
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    cache = _elastic_cache(tmp_path, "c_torn")
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=3, tol=0.0,
+                    grad_reduce=_elastic_gr())
+    kw = dict(num_features=8, config=cfg, cache_decoded=False,
+              steps_per_dispatch=2, checkpoint_every_steps=2)
+
+    def reader():
+        return DataCacheReader(cache, batch_rows=240)
+
+    # cuts land at steps 2, 4, 6 (writes 0, 1, 2 in epoch 0); the join
+    # fires at boundary 2 — whose cut (write 2, step 6) commits torn
+    coord = _elastic_coord(2)
+    plan = (FaultPlan(seed=6)
+            .inject("checkpoint.write", at=2, kind="torn")
+            .inject(coord.SCOPE, at=2, kind="join"))
+    report = RecoveryReport()
+    manager_e = CheckpointManager(CheckpointConfig(
+        str(tmp_path / "ck_e"), max_to_keep=99))
+    with plan:
+        state_e, log_e = resilient_fit(
+            sgd_fit_outofcore, logistic_loss,
+            lambda: plan.wrap_source(reader()),
+            checkpoint=manager_e, elastic=coord,
+            backoff=RetryPolicy(base_delay=0.0, sleep=lambda s: None),
+            report=report, **kw)
+
+    assert report.resizes == 1
+    # the torn step-6 cut was quarantined; the resize fell back to the
+    # step-4 cut and replayed batches 5-6 on the grown fleet
+    assert any(n.endswith(".corrupt")
+               for n in os.listdir(tmp_path / "ck_e"))
+    assert manager_e.last_restored_step == 4
+
+    # baseline: fixed 2 to get a clean step-4 cut, then fixed 3 from it
+    c2 = _elastic_coord(2)
+    sgd_fit_outofcore(
+        logistic_loss, reader, mesh=c2.mesh(), membership=c2,
+        checkpoint=CheckpointConfig(str(tmp_path / "ck_a"),
+                                    max_to_keep=99), **kw)
+    _copy_cut(str(tmp_path / "ck_a"), str(tmp_path / "ck_b"), 4)
+    c3 = _elastic_coord(3)
+    state_b, log_b = sgd_fit_outofcore(
+        logistic_loss, reader, mesh=c3.mesh(), membership=c3,
+        checkpoint=CheckpointManager(CheckpointConfig(
+            str(tmp_path / "ck_b"), max_to_keep=99)),
+        resume=True, **kw)
+
+    np.testing.assert_array_equal(state_e.coefficients,
+                                  state_b.coefficients)
+    assert state_e.intercept == state_b.intercept
+    np.testing.assert_array_equal(log_e, log_b)
+
+
+def test_elastic_ef_and_pending_survive_two_consecutive_resizes(tmp_path):
+    """Grow then shrink (2 -> 3 -> 2) with EF residual + pending overlap
+    buffer live across BOTH re-shards: the double-resized run must be
+    bit-exact vs a run that freshly restores the first boundary's cut
+    onto the grown fleet and then takes the second resize itself —
+    i.e. the carry that crossed resize #1 is indistinguishable from a
+    fresh restore of the same cut."""
+    from flink_ml_tpu.data.datacache import DataCacheReader
+    from flink_ml_tpu.iteration.checkpoint import CheckpointManager
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    cache = _elastic_cache(tmp_path, "c_two")
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=4, tol=0.0,
+                    grad_reduce=_elastic_gr())
+    kw = dict(num_features=8, config=cfg, cache_decoded=False,
+              steps_per_dispatch=2, checkpoint_every_steps=2)
+
+    def reader():
+        return DataCacheReader(cache, batch_rows=240)
+
+    # elastic run: join at boundary 2 (step 6), preempt at boundary 5
+    # (step 12 — polls 3/4/5 land at epoch-1 boundaries 8/10/12 because
+    # the post-resize attempt replays zero chunks in epoch 0)
+    coord = _elastic_coord(2)
+    plan = (FaultPlan(seed=8)
+            .inject(coord.SCOPE, at=2, kind="join")
+            .inject(coord.SCOPE, at=5, kind="preempt"))
+    report = RecoveryReport()
+    with plan:
+        state_e, log_e = resilient_fit(
+            sgd_fit_outofcore, logistic_loss,
+            lambda: plan.wrap_source(reader()),
+            checkpoint=CheckpointConfig(str(tmp_path / "ck_e"),
+                                        max_to_keep=99),
+            elastic=coord,
+            backoff=RetryPolicy(base_delay=0.0, sleep=lambda s: None),
+            report=report, **kw)
+    assert report.resizes == 2
+    assert [e.fleet_size for e in report.events] == [3, 2]
+
+    # chained baseline: fixed 2 to step 6, fresh restore onto 3, which
+    # then takes the SECOND resize (preempt at its boundary 5) itself
+    c2 = _elastic_coord(2)
+    sgd_fit_outofcore(
+        logistic_loss, reader, mesh=c2.mesh(), membership=c2,
+        checkpoint=CheckpointConfig(str(tmp_path / "ck_a"),
+                                    max_to_keep=99), **kw)
+    _copy_cut(str(tmp_path / "ck_a"), str(tmp_path / "ck_b"), 6)
+    c3 = _elastic_coord(3)
+    # the resumed run's boundary counter restarts at 0: its epoch-1
+    # boundaries poll at indices 0/1/2, so index 2 IS step 12 — the
+    # same boundary the double-resized run's index 5 landed on
+    plan_b = FaultPlan(seed=8).inject(c3.SCOPE, at=2, kind="preempt")
+    report_b = RecoveryReport()
+    with plan_b:
+        state_b, log_b = resilient_fit(
+            sgd_fit_outofcore, logistic_loss,
+            lambda: plan_b.wrap_source(reader()),
+            checkpoint=CheckpointManager(CheckpointConfig(
+                str(tmp_path / "ck_b"), max_to_keep=99)),
+            elastic=c3, resume=True,
+            backoff=RetryPolicy(base_delay=0.0, sleep=lambda s: None),
+            report=report_b, **kw)
+    assert report_b.resizes == 1
+
+    np.testing.assert_array_equal(state_e.coefficients,
+                                  state_b.coefficients)
+    assert state_e.intercept == state_b.intercept
+    np.testing.assert_array_equal(log_e, log_b)
+
+
+def test_elastic_worker_death_mid_chunk_degrades_to_crash_path(tmp_path):
+    """A worker dies MID-chunk (crash at a source pull, not at a
+    boundary): the supervisor revokes the victim's lease and recovery
+    restores the newest pre-crash cut onto the SURVIVING fleet —
+    bit-exact vs a fixed fleet of the surviving size restoring that
+    same cut.  Crash-elasticity and planned-elasticity share the code
+    path; this exercises the crash side."""
+    from flink_ml_tpu.data.datacache import DataCacheReader
+    from flink_ml_tpu.iteration.checkpoint import CheckpointManager
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    cache = _elastic_cache(tmp_path, "c_death")
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=3, tol=0.0,
+                    grad_reduce=_elastic_gr())
+    kw = dict(num_features=8, config=cfg, cache_decoded=False,
+              steps_per_dispatch=2, checkpoint_every_steps=2)
+
+    def reader():
+        return DataCacheReader(cache, batch_rows=240)
+
+    # 7 pulls/epoch (6 batches + end-of-stream probe); pull 9 = epoch 1
+    # batch 2 — mid-chunk, after the step-8 boundary cut
+    coord = _elastic_coord(3)
+    plan = FaultPlan(seed=2).inject("source.pull", at=9, kind="crash")
+    report = RecoveryReport()
+    manager_e = CheckpointManager(CheckpointConfig(
+        str(tmp_path / "ck_e"), max_to_keep=99))
+    with plan:
+        state_e, log_e = resilient_fit(
+            sgd_fit_outofcore, logistic_loss,
+            lambda: plan.wrap_source(reader()),
+            checkpoint=manager_e, elastic=coord, max_restarts=2,
+            backoff=RetryPolicy(base_delay=0.0, sleep=lambda s: None),
+            report=report, **kw)
+
+    assert report.restarts == 1 and report.resizes == 0
+    assert report.recovered
+    assert report.events[0].kind == "crash"
+    assert report.events[0].fleet_size == 2      # surviving fleet
+    assert coord.fleet_size == 2
+    assert coord.counters["deaths"] == 1
+    restored = manager_e.last_restored_step
+    assert restored is not None and restored >= 6
+
+    # baseline: fixed 3 (no faults) writes byte-identical pre-crash
+    # cuts; fixed 2 restores the same cut the recovery used
+    c3 = _elastic_coord(3)
+    sgd_fit_outofcore(
+        logistic_loss, reader, mesh=c3.mesh(), membership=c3,
+        checkpoint=CheckpointConfig(str(tmp_path / "ck_a"),
+                                    max_to_keep=99), **kw)
+    _copy_cut(str(tmp_path / "ck_a"), str(tmp_path / "ck_b"), restored)
+    c2 = _elastic_coord(2)
+    state_b, log_b = sgd_fit_outofcore(
+        logistic_loss, reader, mesh=c2.mesh(), membership=c2,
+        checkpoint=CheckpointManager(CheckpointConfig(
+            str(tmp_path / "ck_b"), max_to_keep=99)),
+        resume=True, **kw)
+
+    np.testing.assert_array_equal(state_e.coefficients,
+                                  state_b.coefficients)
+    assert state_e.intercept == state_b.intercept
+    np.testing.assert_array_equal(log_e, log_b)
+
+
+def test_elastic_legacy_cut_onto_different_fleet_raises(tmp_path):
+    """A cut whose meta predates mesh-shape metadata (the pre-elastic
+    layout) restored onto a DIFFERENT fleet must fail with a
+    diagnosable CorruptStateError — never a silent wrong-shape
+    restore.  (Same-fleet restores of legacy cuts keep working; that
+    path is every pre-elastic resume test in this file.)"""
+    import json
+
+    from flink_ml_tpu.data.datacache import DataCacheReader
+    from flink_ml_tpu.iteration.checkpoint import CheckpointManager
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    cache = _elastic_cache(tmp_path, "c_leg")
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=2, tol=0.0,
+                    grad_reduce=_elastic_gr())
+    kw = dict(num_features=8, config=cfg, cache_decoded=False,
+              steps_per_dispatch=2, checkpoint_every_steps=2)
+
+    def reader():
+        return DataCacheReader(cache, batch_rows=240)
+
+    c2 = _elastic_coord(2)
+    sgd_fit_outofcore(
+        logistic_loss, reader, mesh=c2.mesh(), membership=c2,
+        checkpoint=CheckpointConfig(str(tmp_path / "ck"),
+                                    max_to_keep=99), **kw)
+
+    # strip the fleet metadata from EVERY cut — legacy saves — and
+    # rewrite the CRC manifests so validation still passes
+    from flink_ml_tpu.robustness.durability import write_manifest
+
+    ck = tmp_path / "ck"
+    for name in os.listdir(ck):
+        if not name.startswith("ckpt-") or name.endswith(".corrupt"):
+            continue
+        sj = ck / name / "structure.json"
+        doc = json.loads(sj.read_text())
+        for key in ("mesh_shape", "participant_count"):
+            doc["meta"].pop(key, None)
+        sj.write_text(json.dumps(doc))
+        write_manifest(str(ck / name))
+
+    c3 = _elastic_coord(3)
+    with pytest.raises(CorruptStateError, match="mesh-shape metadata"):
+        sgd_fit_outofcore(
+            logistic_loss, reader, mesh=c3.mesh(), membership=c3,
+            checkpoint=CheckpointManager(CheckpointConfig(
+                str(tmp_path / "ck"), max_to_keep=99)),
+            resume=True, **kw)
+
+
+def test_widedeep_elastic_resize_bitexact_vs_fixed_fleet(tmp_path):
+    """The second elastic adopter: WideDeep's streaming fit consumes
+    membership at chunk boundaries; a join resize (params + Adam state
+    replicated onto the grown mesh) is bit-exact vs a fixed fleet of
+    the new size restoring the same cut."""
+    import jax.tree_util as jtu
+
+    from flink_ml_tpu.iteration.checkpoint import CheckpointManager
+    from flink_ml_tpu.models.recommendation.widedeep import WideDeep
+
+    rng = np.random.default_rng(11)
+    n, d, batch = 1440, 4, 240
+    vocab = (7, 5, 3)
+    dense = rng.normal(size=(n, d)).astype(np.float32)
+    cat = np.stack([rng.integers(0, v, size=n) for v in vocab],
+                   1).astype(np.int32)
+    y = (rng.random(n) > 0.5).astype(np.float32)
+
+    def reader():
+        for i in range(0, n, batch):
+            yield {"denseFeatures": dense[i:i + batch],
+                   "catFeatures": cat[i:i + batch],
+                   "label": y[i:i + batch]}
+
+    wd = WideDeep().set_vocab_sizes(list(vocab)).set_max_iter(3)
+
+    def fit(**kw):
+        return wd.fit_outofcore(lambda: reader(), steps_per_dispatch=2,
+                                checkpoint_every_steps=2, **kw)
+
+    coord = _elastic_coord(2)
+    plan = FaultPlan().inject(coord.SCOPE, at=2, kind="join")
+    report = RecoveryReport()
+    with plan:
+        model_e = resilient_fit(
+            fit, checkpoint=CheckpointConfig(str(tmp_path / "ck_e"),
+                                             max_to_keep=99),
+            elastic=coord,
+            backoff=RetryPolicy(base_delay=0.0, sleep=lambda s: None),
+            report=report)
+    assert report.resizes == 1
+
+    c2 = _elastic_coord(2)
+    fit(mesh=c2.mesh(), membership=c2,
+        checkpoint=CheckpointConfig(str(tmp_path / "ck_a"),
+                                    max_to_keep=99))
+    _copy_cut(str(tmp_path / "ck_a"), str(tmp_path / "ck_b"), 6)
+    c3 = _elastic_coord(3)
+    model_b = fit(mesh=c3.mesh(), membership=c3,
+                  checkpoint=CheckpointManager(CheckpointConfig(
+                      str(tmp_path / "ck_b"), max_to_keep=99)),
+                  resume=True)
+
+    for a, b in zip(jtu.tree_leaves(model_e._params),
+                    jtu.tree_leaves(model_b._params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(model_e._loss_log, model_b._loss_log)
+
+
+def test_elastic_exact_mode_resize_bitexact(tmp_path):
+    """Elastic without grad_reduce: the batch shards over every mesh
+    axis jointly (dcn x data) and the implicit-psum path resizes
+    through the same restore — there is no reducer state, so the
+    re-shard is pure placement, and the contract still holds bit-exact
+    vs the fixed fleet of the new size."""
+    from flink_ml_tpu.data.datacache import DataCacheReader
+    from flink_ml_tpu.iteration.checkpoint import CheckpointManager
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    cache = _elastic_cache(tmp_path, "c_exact")
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=2, tol=0.0)
+    kw = dict(num_features=8, config=cfg, cache_decoded=False,
+              steps_per_dispatch=2, checkpoint_every_steps=2)
+
+    def reader():
+        return DataCacheReader(cache, batch_rows=240)
+
+    coord = _elastic_coord(2)
+    plan = FaultPlan().inject(coord.SCOPE, at=1, kind="join")
+    report = RecoveryReport()
+    with plan:
+        state_e, log_e = resilient_fit(
+            sgd_fit_outofcore, logistic_loss,
+            lambda: plan.wrap_source(reader()),
+            checkpoint=CheckpointConfig(str(tmp_path / "ck_e"),
+                                        max_to_keep=99),
+            elastic=coord,
+            backoff=RetryPolicy(base_delay=0.0, sleep=lambda s: None),
+            report=report, **kw)
+    assert report.resizes == 1
+
+    c2 = _elastic_coord(2)
+    sgd_fit_outofcore(
+        logistic_loss, reader, mesh=c2.mesh(), membership=c2,
+        checkpoint=CheckpointConfig(str(tmp_path / "ck_a"),
+                                    max_to_keep=99), **kw)
+    _copy_cut(str(tmp_path / "ck_a"), str(tmp_path / "ck_b"), 4)
+    c3 = _elastic_coord(3)
+    state_b, log_b = sgd_fit_outofcore(
+        logistic_loss, reader, mesh=c3.mesh(), membership=c3,
+        checkpoint=CheckpointManager(CheckpointConfig(
+            str(tmp_path / "ck_b"), max_to_keep=99)),
+        resume=True, **kw)
+
+    np.testing.assert_array_equal(state_e.coefficients,
+                                  state_b.coefficients)
+    assert state_e.intercept == state_b.intercept
+    np.testing.assert_array_equal(log_e, log_b)

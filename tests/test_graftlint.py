@@ -1109,3 +1109,33 @@ def test_unfenced_timing_scope_and_repo_clean():
     assert "bench.py" in scanned
     assert any(s.startswith(os.path.join("flink_ml_tpu", "obs"))
                for s in scanned)
+
+
+def test_elastic_module_visited_by_lock_and_host_sync_passes():
+    """ISSUE 15: the elastic coordinator joined the scanned surfaces.
+    ``lock-discipline`` roots at the whole package — assert the walk
+    genuinely VISITS ``parallel/elastic.py`` (the lease table computes
+    under an RLock and must never block there: an expire/poll holding
+    the lock across a device_put or queue op would stall the training
+    loop at every chunk boundary) and that ``host-sync`` — whose roots
+    include ``flink_ml_tpu/parallel`` — sees it too; both must report
+    it clean."""
+    from scripts.graftlint.passes.host_sync import SCAN_ROOTS
+
+    assert "flink_ml_tpu/parallel" in SCAN_ROOTS
+    assert "flink_ml_tpu" in LockDisciplinePass.roots
+    rel = os.path.join("flink_ml_tpu", "parallel", "elastic.py")
+    project = Project(repo=REPO)
+    lock_visited = {
+        os.path.relpath(m.path, REPO)
+        for m in project.iter_modules(
+            [os.path.join(REPO, r) for r in LockDisciplinePass.roots])}
+    assert rel in lock_visited, "lock-discipline never visits elastic.py"
+    sync_visited = {
+        os.path.relpath(m.path, REPO)
+        for m in project.iter_modules(
+            [os.path.join(REPO, r) for r in SCAN_ROOTS])}
+    assert rel in sync_visited, "host-sync never visits elastic.py"
+    mod = project.module(os.path.join(REPO, rel))
+    assert LockDisciplinePass().check_module(mod, project) == []
+    assert HostSyncPass().check_module(mod, project) == []

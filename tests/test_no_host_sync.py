@@ -168,3 +168,20 @@ def test_checker_covers_ops_package():
             "emb_grad_pallas.py"} <= names
     for path in visited:
         assert chs.check_file(path) == []
+
+
+def test_checker_covers_elastic_module():
+    """ISSUE 15 satellite: the elastic membership runtime lives in
+    ``flink_ml_tpu/parallel`` — already a scanned root — but a root
+    listing is only a guard if the walk actually VISITS the new module.
+    The coordinator's ``poll`` runs once per chunk boundary on the
+    training hot path: a host sync in a step-shaped helper there would
+    fence every elastic fit's dispatch stream at each boundary."""
+    assert "flink_ml_tpu/parallel" in chs.SCAN_ROOTS
+    visited = [p for p in chs._module_paths()
+               if os.sep + os.path.join("flink_ml_tpu", "parallel") + os.sep
+               in p]
+    names = {os.path.basename(p) for p in visited}
+    assert {"elastic.py", "grad_reduce.py", "mesh.py"} <= names
+    for path in visited:
+        assert chs.check_file(path) == []
