@@ -1624,15 +1624,20 @@ def bench_serving(results: dict) -> None:
 
 
 def bench_comm(results: dict) -> None:
-    """Gradient-reduction comm leg (comm_metric_version 2): per-step
+    """Gradient-reduction comm leg (comm_metric_version 3): per-step
     gradient bytes-on-wire, compression ratio, the exact-vs-topk
     step-time A/B, the **adaptive step-time vs bytes-on-wire Pareto**
     (>= 3 operating points, bytes computed from each run's REALIZED
-    per-leaf rungs), and the **overlap A/B** — blocking vs one-step-stale
+    per-leaf rungs), the **overlap A/B** — blocking vs one-step-stale
     bucketed reduction at equal density through the SAME
-    ``_linear_update_reduced`` scan the trainers run — at the bench LR
-    gradient shape (2^20 f32 weights), through the SAME
-    ``parallel/grad_reduce.py`` reducer the trainers adopt.
+    ``_linear_update_reduced`` scan the trainers run — and (v3) the
+    **wire-protocol A/B**: old all-gather vs recursive-halving/doubling
+    at densities 0.01/0.05/0.1/0.5, with the analytic per-participant
+    byte grid published for 2/4/8 dcn groups, a measured step-time
+    Pareto per (density, protocol), and the per-round ``fill_in`` curve
+    + dense-switchover rate read back from the rd runs' fill accounting
+    state — at the bench LR gradient shape (2^20 f32 weights), through
+    the SAME ``parallel/grad_reduce.py`` reducer the trainers adopt.
 
     On a single-device run there IS no gradient reduction, so every
     measured field is nulled, not faked (the ``gap_closed_fraction``
@@ -1676,7 +1681,7 @@ def bench_comm(results: dict) -> None:
     overlap_cfg = GradReduceConfig(mode="topk", density=density,
                                    bucket_count=buckets, overlap=True)
     comm: dict = {
-        "comm_metric_version": 2,
+        "comm_metric_version": 3,
         "config": f"dense LR grad d={d}, topk density={density}, "
                   f"int8 block 256, {buckets} buckets, ladder {ladder}",
         "accounting": {
@@ -1695,6 +1700,34 @@ def bench_comm(results: dict) -> None:
     }
     n_dev = jax.device_count()
     comm["devices"] = n_dev
+
+    # ---- wire-protocol tier (v3): the analytic old-vs-new byte grid is
+    # pure shape math and ALWAYS publishes — per-participant bytes of the
+    # all-gather protocol vs the recursive-halving/doubling rounds, per
+    # (density, dcn-group-count) cell
+    wire_densities = (0.01, 0.05, density, 0.5)
+    wire_groups = (2, 4, 8)
+    analytic_grid = []
+    for dens in wire_densities:
+        w_cfg = GradReduceConfig(mode="topk", density=dens)
+        for groups in wire_groups:
+            w = GR.payload_bytes(like, w_cfg, hop_size=groups)["wire"]
+            analytic_grid.append({
+                "density": dens, "dcn_groups": groups,
+                "rounds": w["rounds"],
+                "allgather_bytes": w["allgather_bytes"],
+                "rd_bytes_best": w["rd_bytes_best"],
+                "rd_bytes_worst": w["rd_bytes_worst"],
+                "reduction_vs_allgather_best":
+                    w["reduction_vs_allgather_best"],
+            })
+    comm["wire_protocol"] = {
+        "protocol_default": GR.resolved_wire_protocol(
+            GradReduceConfig(mode="topk", density=density)),
+        "densities": list(wire_densities),
+        "dcn_groups": list(wire_groups),
+        "analytic": analytic_grid,
+    }
 
     def pareto_point(label, cfg, step_ms, rungs):
         acc = GR.payload_bytes(like, cfg, rungs=rungs)
@@ -1728,6 +1761,19 @@ def bench_comm(results: dict) -> None:
                          None, None),
         ] + [pareto_point(label, cfg, None, None)
              for label, cfg in adaptive_points.items()]
+        # protocol Pareto keeps its analytic bytes (largest-group cell)
+        # with step_ms null; the fill curve is a RUN observation — null
+        comm["wire_protocol"]["pareto"] = [
+            {"density": cell["density"], "protocol": proto,
+             "step_ms": None,
+             "bytes_on_wire": (cell["rd_bytes_best"] if proto == "rd"
+                               else cell["allgather_bytes"])}
+            for cell in analytic_grid
+            if cell["dcn_groups"] == wire_groups[-1]
+            for proto in ("allgather", "rd")]
+        comm["wire_protocol"]["fill_in_curve"] = None
+        comm["wire_protocol"]["switch_rate"] = None
+        comm["wire_protocol"]["rd_bytes_measured"] = None
         results["notes"]["comm"] = comm
         return
 
@@ -1754,7 +1800,7 @@ def bench_comm(results: dict) -> None:
     reduce_cfgs = {"exact": GradReduceConfig(mode="exact"),
                    "topk": GradReduceConfig(mode="topk", density=density),
                    **adaptive_points}
-    warmed, states = {}, {}
+    warmed, states, gens = {}, {}, {}
     for label, cfg in reduce_cfgs.items():
         fn = build(cfg)
         state = GR.init_state(cfg, {"w": jnp.zeros((d,), jnp.float32)},
@@ -1765,9 +1811,10 @@ def bench_comm(results: dict) -> None:
 
     def time_mode(label, trials=8):
         fn, state = warmed[label], states[label]
+        gen_fn = gens.get(label, gen)
         t0 = time.perf_counter()
         for i in range(1, trials + 1):
-            red, state = fn(gen(jax.random.PRNGKey(i)), state)
+            red, state = fn(gen_fn(jax.random.PRNGKey(i)), state)
         np.asarray(red)
         states[label] = state
         return 1e3 * (time.perf_counter() - t0) / trials
@@ -1790,6 +1837,58 @@ def bench_comm(results: dict) -> None:
         rungs = np.asarray(states[label]["rung"])[0]
         pareto.append(pareto_point(label, cfg, ms, rungs))
     comm["pareto"] = pareto
+
+    # ---- wire-protocol A/B (v3): old all-gather vs recursive doubling
+    # at each density on the live mesh — measured step time per point,
+    # bytes from the rd runs' OWN fill accounting (the allgather side is
+    # exact shape math; nothing is faked).  Participant gradients here
+    # are CORRELATED — shared signal + per-participant minibatch noise,
+    # the data-parallel regime (same weights, different batches) whose
+    # top-k support overlap is what the halving/doubling rounds exploit;
+    # fully independent supports make the union approach P*k and the
+    # doubling broadcast degrade toward allgather parity, which the
+    # fill_in curve makes visible rather than hiding.
+    @jax.jit
+    def gen_corr(key):
+        kb, kn = jax.random.split(key)
+        base = jax.random.normal(kb, (d,), jnp.float32)
+        noise = jax.random.normal(kn, (n_dev, d), jnp.float32)
+        return base[None, :] + 0.25 * noise
+
+    wire_pareto = []
+    fill_curves = {}
+    switch_rates = {}
+    for dens in wire_densities:
+        for proto in ("allgather", "rd"):
+            cfg = GradReduceConfig(mode="topk", density=dens,
+                                   wire_protocol=proto)
+            label = f"wire_{proto}_{dens}"
+            fn = build(cfg)
+            st = GR.init_state(cfg, {"w": jnp.zeros((d,), jnp.float32)},
+                               n_dev)
+            red, st = fn(gen_corr(jax.random.PRNGKey(0)), st)
+            np.asarray(red)              # compile + warm before timing
+            warmed[label], states[label] = fn, st
+            gens[label] = gen_corr
+            ms = round(time_mode(label), 3)
+            acc = GR.payload_bytes(
+                like, cfg, hop_size=n_dev,
+                fill=states[label].get("fill"))
+            w = acc["wire"]
+            wire_pareto.append({
+                "density": dens, "protocol": proto, "step_ms": ms,
+                "bytes_on_wire": (w["rd_bytes_measured"]
+                                  if proto == "rd"
+                                  else w["allgather_bytes"])})
+            if proto == "rd":
+                fill_curves[str(dens)] = w["fill_rounds_measured"]
+                switch_rates[str(dens)] = w["switch_rate_measured"]
+    comm["wire_protocol"]["pareto"] = wire_pareto
+    comm["wire_protocol"]["fill_in_curve"] = fill_curves
+    comm["wire_protocol"]["switch_rate"] = switch_rates
+    comm["wire_protocol"]["rd_bytes_measured"] = {
+        p["density"]: p["bytes_on_wire"] for p in wire_pareto
+        if p["protocol"] == "rd"}
 
     # ---- overlap A/B: blocking vs one-step-stale bucketed reduction at
     # EQUAL density, through the real _linear_update_reduced scan (the

@@ -355,9 +355,10 @@ def _linear_update(loss_fn: LossFn, config: SGDConfig):
 
 
 #: Reserved params-pytree key the compressed-reduction trainers use to
-#: carry reducer state (EF residual / rounding key) in the SAME donated
-#: scan carry as the weights — which is exactly what makes it ride every
-#: existing checkpoint cut and restore untouched.
+#: carry reducer state (EF residual / rounding key / the wire-protocol
+#: tier's fill-in + union accounting) in the SAME donated scan carry as
+#: the weights — which is exactly what makes it ride every existing
+#: checkpoint cut and restore untouched.
 GR_STATE_KEY = "_gr"
 
 
@@ -1513,7 +1514,8 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
     extent and re-enters with ``resume=True``, where the restore below
     re-shards the whole carry (params replicate; participant-stacked
     reducer state — EF residual, pending overlap buffer, adaptive
-    policy, rounding keys — routes through
+    policy, rounding keys, and the wire-protocol tier's per-round
+    fill-in/union accounting — routes through
     :func:`~flink_ml_tpu.parallel.grad_reduce.reshard_state`).  A
     resize at a chunk boundary is bit-exact vs a fixed fleet of the
     new size restoring the same cut (same reduce order); a worker

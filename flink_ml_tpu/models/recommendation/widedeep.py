@@ -1017,8 +1017,10 @@ def _build_reduced_sharded_step(mesh, gr, sharded_params, opt, opt_state,
     """The compressed-reduction variant of :func:`build_sharded_train_step`
     (see its docstring for the contract): manual ``shard_map`` over the
     reduction axes, every OTHER mesh axis (``model``) left to GSPMD auto
-    partitioning, dense-tower grads through ``reduce_gradients``, table
-    grads exact."""
+    partitioning, dense-tower grads through ``reduce_gradients`` — on
+    the recursive-halving/doubling wire protocol by default, so the
+    reducer state here also carries the per-round fill-in/union
+    accounting leaves — table grads exact."""
     from ...parallel import grad_reduce as GR
     from ...parallel.collectives import shard_map_fn
 

@@ -56,6 +56,33 @@ r11 (communication-scheduled training) adds three orthogonal knobs:
   or an ``"int8"``/``"exact"`` fallback — per leaf every
   ``adaptive_window`` steps.  Selection is computed from psum'd norms, so
   every participant takes the same ``lax.switch`` branch.
+
+r20 (topology-aware wire protocol) replaces the all-gather transport of
+the compressed hop:
+
+- ``wire_protocol`` — ``"auto"`` (default) runs the top-k family's
+  sparse all-reduce as **recursive halving/doubling**
+  (:func:`~.collectives.sparse_all_reduce_rd`) whenever the compressed
+  hop spans a single named axis (the dcn hop of every hierarchical
+  config, and flat single-axis reductions), falling back to the legacy
+  all-gather form for multi-axis hops; ``"rd"`` / ``"allgather"`` force
+  one or the other.  Per-round fill-in lands in the ``fill`` /
+  ``union`` reducer-state leaves and :func:`payload_bytes` turns it
+  into measured bytes-on-wire next to the analytic best/worst bounds.
+  Exact mode never routes through the sparse protocol, so it stays
+  bit-identical to the legacy path.
+- ``dcn_schedule="earliest"`` — hierarchical bucketed reduces chain an
+  ``optimization_barrier`` token through the buckets in consumption
+  order (earliest-needed bucket first, MLFabric's schedule), so the dcn
+  collectives issue in the order the overlap pipeline applies them
+  instead of racing; ``"free"`` keeps the unordered launch.  The
+  barrier is the identity, so the two schedules are bit-identical in
+  value (asserted in tests) — only issue order changes.
+- ``int8_accum="fixed"`` — the int8 hop quantizes against a SHARED
+  (pmax'd) per-block scale and accumulates int32 per hop
+  (:func:`~.collectives.fixed_point_all_reduce` — SwitchML pool
+  semantics), one rounding per participant no matter the hop count;
+  ``"dequant"`` keeps the legacy dequantize-to-f32-then-sum.
 """
 
 from __future__ import annotations
@@ -70,6 +97,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .collectives import (
+    FILL_DOUBLING_BASE,
+    FILL_POSTFOLD_SLOT,
+    FILL_PREFOLD_SLOT,
+    FILL_ROUND_SLOTS,
+    FILL_SWITCH_SLOT,
+    FILL_UNION_SLOT,
+    FILL_VEC_LEN,
+    rd_topology,
+)
+
 __all__ = [
     "BucketPlan",
     "GradReduceConfig",
@@ -77,6 +115,7 @@ __all__ = [
     "bucket_report",
     "drain_pending",
     "effective_ladder",
+    "hop_axis",
     "init_state",
     "mesh_layout",
     "needs_state",
@@ -86,6 +125,7 @@ __all__ = [
     "reduce_gradients",
     "reduction_axes",
     "reshard_state",
+    "resolved_wire_protocol",
     "squeeze_state",
     "state_participants",
     "unsqueeze_state",
@@ -93,6 +133,9 @@ __all__ = [
 ]
 
 MODES = ("exact", "topk", "int8")
+WIRE_PROTOCOLS = ("auto", "rd", "allgather")
+INT8_ACCUMS = ("dequant", "fixed")
+DCN_SCHEDULES = ("earliest", "free")
 
 AxisSpec = Union[str, Tuple[str, ...]]
 
@@ -125,6 +168,15 @@ class GradReduceConfig:
     climbs one rung toward fidelity, below half the target it descends
     one rung toward thrift.  An empty ladder defaults to
     ``(density / 4, density, "exact")``.
+
+    ``wire_protocol`` selects the sparse transport of the top-k family:
+    ``"auto"`` (recursive halving/doubling on single-named-axis hops,
+    all-gather otherwise), ``"rd"``, or ``"allgather"``.
+    ``int8_accum`` selects the int8 hop's accumulator: ``"dequant"``
+    (legacy f32 dequantize-then-sum) or ``"fixed"`` (shared scales,
+    int32 per-hop accumulation).  ``dcn_schedule`` orders hierarchical
+    bucket transfers: ``"earliest"`` (consumption order, default) or
+    ``"free"`` (unordered launch).
     """
 
     mode: str = "exact"
@@ -139,8 +191,31 @@ class GradReduceConfig:
     adaptive_window: int = 8
     adaptive_target: float = 0.5
     density_ladder: Tuple = ()
+    wire_protocol: str = "auto"
+    int8_accum: str = "dequant"
+    dcn_schedule: str = "earliest"
 
     def __post_init__(self):
+        if self.wire_protocol not in WIRE_PROTOCOLS:
+            raise ValueError(f"wire_protocol must be one of "
+                             f"{WIRE_PROTOCOLS}, got {self.wire_protocol!r}")
+        if self.int8_accum not in INT8_ACCUMS:
+            raise ValueError(f"int8_accum must be one of {INT8_ACCUMS}, "
+                             f"got {self.int8_accum!r}")
+        if self.dcn_schedule not in DCN_SCHEDULES:
+            raise ValueError(f"dcn_schedule must be one of "
+                             f"{DCN_SCHEDULES}, got {self.dcn_schedule!r}")
+        single_hop = self.dcn_axis is not None or \
+            isinstance(self.axis, str) or len(tuple(self.axis)) == 1
+        if self.wire_protocol == "rd" and not single_hop:
+            raise ValueError(
+                "wire_protocol='rd' runs pairwise ppermute rounds over ONE "
+                "named axis; this config's compressed hop spans "
+                f"axis={self.axis!r} — set a dcn_axis or use 'allgather'")
+        if self.int8_accum == "fixed" and not single_hop:
+            raise ValueError(
+                "int8_accum='fixed' accumulates int32 over ONE named axis; "
+                f"this config's hop spans axis={self.axis!r}")
         if self.mode not in MODES:
             raise ValueError(f"mode must be one of {MODES}, got {self.mode!r}")
         if self.mode == "topk" and not 0.0 < self.density <= 1.0:
@@ -226,6 +301,37 @@ def reduction_axes(config: GradReduceConfig) -> Tuple[str, ...]:
     return axes
 
 
+def hop_axis(config: GradReduceConfig) -> Optional[str]:
+    """The single named axis the COMPRESSED hop runs over — the dcn axis
+    of a hierarchical config, or the flat reduction axis when it is one
+    name — or ``None`` when the flat hop spans multiple axes (pairwise
+    rounds need one ring of partners)."""
+    if config.dcn_axis is not None:
+        return config.dcn_axis
+    if isinstance(config.axis, str):
+        return config.axis
+    axes = tuple(config.axis)
+    return axes[0] if len(axes) == 1 else None
+
+
+def resolved_wire_protocol(config: GradReduceConfig) -> str:
+    """The sparse transport the top-k family actually runs:
+    ``wire_protocol="auto"`` resolves to recursive halving/doubling
+    (``"rd"``) whenever :func:`hop_axis` names a single axis, and to the
+    legacy ``"allgather"`` for multi-axis flat hops (config validation
+    already rejects forcing ``"rd"`` there)."""
+    if config.wire_protocol == "allgather":
+        return "allgather"
+    return "rd" if hop_axis(config) is not None else "allgather"
+
+
+def _rd_engaged(config: GradReduceConfig) -> bool:
+    """Whether this config's reduce carries per-round fill-in state —
+    i.e. a top-k-family transport runs the recursive-doubling protocol."""
+    return (config.mode == "topk" or config.adaptive) and \
+        resolved_wire_protocol(config) == "rd"
+
+
 def needs_state(config: GradReduceConfig) -> bool:
     return config.mode in ("topk", "int8")
 
@@ -268,6 +374,16 @@ def init_state(config: GradReduceConfig, grads_like: Any,
     (the first pipelined step reduces zeros, a deterministic no-op).
     All of it rides the same participant-stacked layout, so adopters'
     checkpoints round-trip the whole schedule for free.
+
+    When the recursive-doubling wire protocol is engaged
+    (:func:`resolved_wire_protocol`), two accounting leaves ride along:
+    ``fill`` — the last step's per-transport-unit fill-in vector (the
+    per-round sent-entry counts, union size, switchover flag and fold
+    traffic of :func:`~.collectives.sparse_all_reduce_rd`, raw so
+    ``payload_bytes(fill=...)`` reports calibrated measured bytes) —
+    and ``union`` — a smoothed (EMA) union-density per unit, the
+    switchover statistic.  Both have fleet-size-independent trailing
+    shapes, so elastic resizes re-seat them without reshaping.
     """
 
     def stack(g):
@@ -288,9 +404,23 @@ def init_state(config: GradReduceConfig, grads_like: Any,
         state["rung"] = jnp.full((n_participants, n_leaves),
                                  _initial_rung(config), jnp.int32)
         state["tick"] = jnp.zeros((n_participants,), jnp.int32)
+    if _rd_engaged(config):
+        n_units = _fill_units(grads_like, config)
+        state["fill"] = jnp.zeros((n_participants, n_units, FILL_VEC_LEN),
+                                  jnp.float32)
+        state["union"] = jnp.zeros((n_participants, n_units), jnp.float32)
     if wants_overlap(config):
         state["pending"] = jax.tree_util.tree_map(stack, grads_like)
     return state
+
+
+def _fill_units(grads_like: Any, config: GradReduceConfig) -> int:
+    """Transport units the fill accounting is keyed on: buckets when the
+    reduce is bucketed/adaptive, leaves otherwise — exactly the units
+    :func:`_transport_units` accounts."""
+    if _bucketed(config):
+        return len(plan_buckets(grads_like, config).ranges)
+    return len(jax.tree_util.tree_leaves(grads_like))
 
 
 def squeeze_state(state: dict) -> dict:
@@ -336,6 +466,19 @@ def reshard_state(state: dict, n_new: int, *,
     the new size restoring the same cut both route through this
     function, which is what makes the two bit-exact from the boundary
     onward (the fit-level contract asserted in tests/test_faults.py).
+
+    The wire-protocol accounting leaves resize by their own rules:
+    ``fill`` (last-step per-round sent counts) measures the OLD fleet's
+    round structure — a different participant count has a different
+    core/rounds/fold layout, so carrying the numbers over would
+    misattribute bytes; it re-seats as zeros and the first post-resize
+    step repopulates it.  ``union`` (union-density EMA) describes the
+    gradient, not the fleet — psum-uniform within each dcn hop group,
+    varying only across ICI columns — so it broadcasts from participant
+    0 like the other policy leaves (a smoothed-statistic re-seed the
+    next steps re-diverge, not an exact invariant).  Both have
+    fleet-size-independent trailing shapes by construction, so the same
+    rule applies at any resize.
     """
     n_old = state_participants(state)
     if n_old is None or n_old == n_new:
@@ -363,8 +506,11 @@ def reshard_state(state: dict, n_new: int, *,
     for key, value in state.items():
         if key in ("ef", "pending"):
             out[key] = jax.tree_util.tree_map(collapse, value)
-        elif key in ("ema", "rung", "tick"):
+        elif key in ("ema", "rung", "tick", "union"):
             out[key] = broadcast0(value)
+        elif key == "fill":
+            a = np.asarray(value, np.float32)
+            out[key] = np.zeros((n_new,) + a.shape[1:], np.float32)
         elif key == "key":
             base = jnp.asarray(np.asarray(value)[0])
             out[key] = np.asarray(jax.vmap(
@@ -441,30 +587,51 @@ def plan_buckets(grads_like: Any, config: GradReduceConfig) -> BucketPlan:
 # ---------------------------------------------------------------------------
 
 
-def _topk_allreduce(flat: jnp.ndarray, axes: AxisSpec, density: float
-                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """All-gather sparse all-reduce of one flat leaf: every participant
-    contributes its top-k (index, value) pairs; each scatter-adds the
-    gathered pairs locally.  Returns ``(reduced, unsent)`` where
-    ``unsent`` is this participant's residual (its accumulated gradient
-    with the sent entries zeroed)."""
-    from .collectives import sparse_all_reduce
+def _topk_allreduce(flat: jnp.ndarray, axes: AxisSpec, density: float,
+                    protocol: str = "allgather",
+                    uniform_axes: Optional[Tuple[str, ...]] = None
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sparse all-reduce of one flat leaf: every participant contributes
+    its top-k (index, value) pairs.  ``protocol="rd"`` routes the pairs
+    through recursive halving/doubling over the (single) named axis;
+    ``"allgather"`` keeps the legacy every-participant-receives-all
+    form.  Returns ``(reduced, unsent, fill)`` where ``unsent`` is this
+    participant's residual (its accumulated gradient with the sent
+    entries zeroed) and ``fill`` is the per-round fill-in vector (zeros
+    under allgather, which has no rounds to account).  ``uniform_axes``
+    (every axis of the enclosing shard_map, hierarchical callers pass
+    :func:`reduction_axes`) keeps the rd switchover predicate
+    mesh-uniform — see :func:`~.collectives.sparse_all_reduce_rd`."""
+    from .collectives import sparse_all_reduce, sparse_all_reduce_rd
 
     k = _topk_k(flat.size, density)
     _, idx = lax.top_k(jnp.abs(flat), k)
     vals = flat[idx]
     unsent = flat.at[idx].set(0.0)
-    reduced = sparse_all_reduce(idx, vals, flat.size, axes)
-    return reduced, unsent
+    if protocol == "rd":
+        ax = axes if isinstance(axes, str) else tuple(axes)[0]
+        reduced, fill = sparse_all_reduce_rd(idx, vals, flat.size, ax,
+                                             uniform_axes=uniform_axes)
+    else:
+        reduced = sparse_all_reduce(idx, vals, flat.size, axes)
+        fill = jnp.zeros((FILL_VEC_LEN,), jnp.float32)
+    return reduced, unsent, fill
 
 
 def _int8_allreduce(flat: jnp.ndarray, axes: AxisSpec, block: int,
-                    key: jnp.ndarray) -> jnp.ndarray:
+                    key: jnp.ndarray,
+                    accum: str = "dequant") -> jnp.ndarray:
     """Block-quantized all-reduce of one flat leaf: per-block max-abs
     scales, stochastic rounding (``floor(x/scale + u)``, u~U[0,1) — the
-    unbiased round), int8 payload + f32 scales all-gathered, dequantized
-    and summed locally."""
-    from .collectives import quantized_all_reduce
+    unbiased round).  ``accum="dequant"`` (legacy) all-gathers int8
+    payload + f32 scales and dequantize-sums locally — P dequantized
+    roundings meet in f32, so worst-case error grows with P.
+    ``accum="fixed"`` shares ONE pmax'd scale per block across the hop,
+    accumulates the int32 codes in-fabric
+    (:func:`~.collectives.fixed_point_all_reduce`) and dequantizes the
+    exact integer total once — error stays one rounding per participant
+    independent of P (the SwitchML posture)."""
+    from .collectives import fixed_point_all_reduce, quantized_all_reduce
 
     n = flat.size
     n_pad = -(-n // block) * block
@@ -473,6 +640,14 @@ def _int8_allreduce(flat: jnp.ndarray, axes: AxisSpec, block: int,
     blocks = padded.reshape(-1, block)
     scale = jnp.maximum(jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
                         / 127.0, 1e-12)
+    if accum == "fixed":
+        ax = axes if isinstance(axes, str) else tuple(axes)[0]
+        scale = lax.pmax(scale, ax)
+        u = jax.random.uniform(key, blocks.shape)
+        q = jnp.clip(jnp.floor(blocks / scale + u),
+                     -127, 127).astype(jnp.int32)
+        total_q = fixed_point_all_reduce(q, ax)
+        return (total_q.astype(jnp.float32) * scale).reshape(-1)[:n]
     u = jax.random.uniform(key, blocks.shape)
     q = jnp.clip(jnp.floor(blocks / scale + u), -127, 127).astype(jnp.int8)
     total = quantized_all_reduce(q, scale, axes)
@@ -518,46 +693,55 @@ def _mode_spec(config: GradReduceConfig):
 
 
 def _segment_reducer(spec, config: GradReduceConfig):
-    """Build ``branch(acc, key) -> (reduced, unsent)`` for one flat
+    """Build ``branch(acc, key) -> (reduced, unsent, fill)`` for one flat
     segment at one rung — a density (EF top-k), ``"int8"`` (unbiased, the
     accumulated residual is fully consumed, so ``unsent = 0``) or
     ``"exact"`` (likewise).  Hierarchical configs wrap the rung's
     compressed hop in the ICI reduce-scatter / all-gather pair; the
     top-k rung's unsent comes back embedded in the full segment domain
     (:func:`_embed_shard`).  Every rung shares the signature so the
-    adaptive ``lax.switch`` can select among them."""
+    adaptive ``lax.switch`` can select among them; exact/int8 rungs
+    return a zero fill vector (no sparse rounds to account)."""
     axes = reduction_axes(config)
     hier = config.dcn_axis is not None
+    proto = resolved_wire_protocol(config)
+
+    def no_fill():
+        return jnp.zeros((FILL_VEC_LEN,), jnp.float32)
 
     if spec == "exact":
         def branch(acc, key):
             if not hier:
-                return lax.psum(acc, axes), jnp.zeros_like(acc)
+                return lax.psum(acc, axes), jnp.zeros_like(acc), no_fill()
             shard, _ = _hier_scatter(acc, config.axis)
             shard = lax.psum(shard, config.dcn_axis)
             return (_hier_gather(shard, config.axis, acc.size, (acc.size,)),
-                    jnp.zeros_like(acc))
+                    jnp.zeros_like(acc), no_fill())
     elif spec == "int8":
         def branch(acc, key):
             if not hier:
-                return (_int8_allreduce(acc, axes, config.block_size, key),
-                        jnp.zeros_like(acc))
+                return (_int8_allreduce(acc, axes, config.block_size, key,
+                                        config.int8_accum),
+                        jnp.zeros_like(acc), no_fill())
             shard, _ = _hier_scatter(acc, config.axis)
             shard = _int8_allreduce(shard, config.dcn_axis,
-                                    config.block_size, key)
+                                    config.block_size, key,
+                                    config.int8_accum)
             return (_hier_gather(shard, config.axis, acc.size, (acc.size,)),
-                    jnp.zeros_like(acc))
+                    jnp.zeros_like(acc), no_fill())
     else:
         density = float(spec)
 
         def branch(acc, key):
             if not hier:
-                return _topk_allreduce(acc, axes, density)
+                return _topk_allreduce(acc, axes, density, proto)
             shard, n_pad = _hier_scatter(acc, config.axis)
-            red_s, unsent_s = _topk_allreduce(shard, config.dcn_axis,
-                                              density)
+            red_s, unsent_s, fill = _topk_allreduce(
+                shard, config.dcn_axis, density, proto,
+                uniform_axes=reduction_axes(config))
             return (_hier_gather(red_s, config.axis, acc.size, (acc.size,)),
-                    _embed_shard(unsent_s, config.axis, acc.size, n_pad))
+                    _embed_shard(unsent_s, config.axis, acc.size, n_pad),
+                    fill)
     return branch
 
 
@@ -572,6 +756,35 @@ def _split_flat(flat: jnp.ndarray, plan: BucketPlan):
         plan.leaf_shapes[i]) for i in range(len(plan.leaf_sizes))]
 
 
+def _rd_padded(n: int, ici: int, core: int) -> int:
+    """Elements of one ``n``-element transport unit as seen by the
+    compressed hop: the ICI-scattered shard, padded to a multiple of
+    the recursive-doubling core (the n_pad of sparse_all_reduce_rd)."""
+    m = -(-n // max(ici, 1))
+    return -(-m // core) * core
+
+
+def _update_fill_state(new_state: dict, state: dict, fill_parts,
+                       unit_sizes, config: GradReduceConfig) -> None:
+    """Seat this step's per-unit fill-in vectors in reducer state:
+    ``fill`` keeps the RAW last-step vectors (so payload_bytes reports
+    calibrated measured bytes, not a warm-up-biased EMA), ``union``
+    smooths the union density — the switchover statistic — with the
+    adaptive machinery's EMA idiom.  Runs inside the SPMD context
+    (axis sizes are static there)."""
+    from .collectives import axis_size
+
+    fills = jnp.stack(fill_parts)            # (n_units, FILL_VEC_LEN)
+    new_state["fill"] = fills
+    p = axis_size(hop_axis(config))
+    core = rd_topology(p)[0]
+    ici = axis_size(config.axis) if config.dcn_axis is not None else 1
+    denom = jnp.asarray([_rd_padded(int(n), ici, core)
+                         for n in unit_sizes], jnp.float32)
+    new_state["union"] = 0.9 * state["union"] + 0.1 * (
+        fills[:, FILL_UNION_SLOT] / denom)
+
+
 def _reduce_bucketed(grads: Any, state: dict, config: GradReduceConfig
                      ) -> Tuple[Any, dict]:
     """Bucketed (and/or adaptive) reduce of the whole gradient tree: the
@@ -581,6 +794,16 @@ def _reduce_bucketed(grads: Any, state: dict, config: GradReduceConfig
     max (highest-fidelity) rung of its leaves, selected by ``lax.switch``
     — the rung indices are derived from psum'd norms, so every
     participant takes the same branch and the collectives stay matched.
+
+    Hierarchical compressed configs with ``dcn_schedule="earliest"``
+    thread an ``optimization_barrier`` token through the buckets in
+    index order — bucket ``i`` holds the flat range the optimizer apply
+    consumes ``i``-th, so issue order matches consumption order
+    (MLFabric's earliest-needed-first schedule) instead of leaving B
+    same-priority dcn collectives to race.  The barrier is the
+    identity: values are bit-identical to ``"free"`` (asserted in
+    tests); only the dependency chain — and so XLA's issue order —
+    changes.
     """
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     plan = plan_buckets(grads, config)
@@ -607,17 +830,29 @@ def _reduce_bucketed(grads: Any, state: dict, config: GradReduceConfig
     if config.adaptive:
         rungs = state["rung"]                            # (n_leaves,) i32
         branches = [_segment_reducer(spec, config) for spec in lad]
-    out_parts, unsent_parts = [], []
+    chain = (config.dcn_axis is not None and config.mode != "exact"
+             and config.dcn_schedule == "earliest" and n_buckets > 1)
+    token = acc_flat[:1]
+    out_parts, unsent_parts, fill_parts = [], [], []
     for bi, (lo, hi) in enumerate(plan.ranges):
         acc = acc_flat[lo:hi]
+        if chain:
+            acc, token = lax.optimization_barrier((acc, token))
         if config.adaptive:
             b_rung = jnp.max(rungs[np.asarray(plan.bucket_leaves[bi])])
-            red, unsent = lax.switch(b_rung, branches, acc, bucket_keys[bi])
+            red, unsent, fill = lax.switch(b_rung, branches, acc,
+                                           bucket_keys[bi])
         else:
-            red, unsent = _segment_reducer(_mode_spec(config), config)(
-                acc, bucket_keys[bi])
+            red, unsent, fill = _segment_reducer(
+                _mode_spec(config), config)(acc, bucket_keys[bi])
+        if chain:
+            token = red[:1]
         out_parts.append(red)
         unsent_parts.append(unsent)
+        fill_parts.append(fill)
+    if "fill" in state:
+        _update_fill_state(new_state, state, fill_parts,
+                           plan.bucket_sizes, config)
 
     out_leaves = _split_flat(jnp.concatenate(out_parts) if n_buckets > 1
                              else out_parts[0], plan)
@@ -690,28 +925,36 @@ def reduce_gradients(grads: Any, state: dict, config: GradReduceConfig
         return jax.tree_util.tree_unflatten(treedef, out), state
 
     if config.mode == "topk":
+        proto = resolved_wire_protocol(config)
         ef_leaves = jax.tree_util.tree_leaves(state["ef"])
-        out, new_ef = [], []
+        out, new_ef, fills = [], [], []
         for g, res in zip(leaves, ef_leaves):
             if not hier:
                 acc = (g + res).reshape(-1)
-                reduced, unsent = _topk_allreduce(acc, axes, config.density)
+                reduced, unsent, fill = _topk_allreduce(
+                    acc, axes, config.density, proto)
                 out.append(reduced.reshape(g.shape))
                 new_ef.append(unsent.reshape(g.shape))
+                fills.append(fill)
                 continue
             # hierarchical: residual lives in the full gradient domain but
             # is nonzero only in this participant's own ICI slice, so the
             # reduce-scatter below re-injects it into exactly its shard.
             acc = (g + res).reshape(-1)
             shard, n_pad = _hier_scatter(acc, config.axis)
-            reduced, unsent = _topk_allreduce(shard, config.dcn_axis,
-                                              config.density)
+            reduced, unsent, fill = _topk_allreduce(
+                shard, config.dcn_axis, config.density, proto,
+                uniform_axes=reduction_axes(config))
             out.append(_hier_gather(reduced, config.axis, g.size, g.shape))
             new_ef.append(_embed_shard(unsent, config.axis, g.size,
                                        n_pad).reshape(g.shape))
+            fills.append(fill)
         new_state = dict(state)
         new_state["ef"] = jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(state["ef"]), new_ef)
+        if "fill" in state:
+            _update_fill_state(new_state, state, fills,
+                               [g.size for g in leaves], config)
         return jax.tree_util.tree_unflatten(treedef, out), new_state
 
     # int8: one fresh rounding key per step, split per leaf
@@ -721,12 +964,12 @@ def reduce_gradients(grads: Any, state: dict, config: GradReduceConfig
     for li, g in enumerate(leaves):
         if not hier:
             out.append(_int8_allreduce(g.reshape(-1), axes,
-                                       config.block_size,
-                                       leaf_keys[li]).reshape(g.shape))
+                                       config.block_size, leaf_keys[li],
+                                       config.int8_accum).reshape(g.shape))
             continue
         shard, _ = _hier_scatter(g.reshape(-1), config.axis)
         shard = _int8_allreduce(shard, config.dcn_axis, config.block_size,
-                                leaf_keys[li])
+                                leaf_keys[li], config.int8_accum)
         out.append(_hier_gather(shard, config.axis, g.size, g.shape))
     new_state = dict(state)
     new_state["key"] = key
@@ -815,8 +1058,57 @@ def _transport_units(grads_like: Any, config: GradReduceConfig, rungs=None):
             for bi, (lo, hi) in enumerate(plan.ranges)]
 
 
+def _rd_wire_unit(n: int, k: int, p: int) -> Tuple[float, float]:
+    """Analytic (best, worst) per-participant bytes-on-wire for ONE
+    ``n``-element hop unit shipping ``k`` (index, value) entries under
+    recursive halving/doubling over ``p`` participants — total bytes
+    across the hop divided by ``p``.
+
+    Best case: every participant picks the same support, so the union
+    never grows — halving routes ``k(1 - 1/core)`` entries per rank,
+    doubling gathers the same back (the SparCML ~P/2 saving over the
+    all-gather's ``(p-1)k`` per rank).  Worst case: supports are
+    disjoint, capacity doubles every round until the range bound bites,
+    and the doubling phase ships ``min(sparse, dense-switchover)``.
+    Folding (non-power-of-two p) adds the extras' entry hand-off up
+    front and a dense result broadcast at the end — both counted."""
+    core, rounds, extras = rd_topology(p)
+    n_pad = -(-n // core) * core
+    best = 8.0 * k * extras                       # pre-fold hand-off
+    best += core * 8.0 * k * (1.0 - 1.0 / core)   # halving, union stays k
+    best += 8.0 * k * (core - 1)                  # sparse doubling
+    best += 4.0 * n_pad * extras                  # post-fold dense result
+    worst = 8.0 * k * extras
+    cap = min((2 if extras else 1) * k, n_pad)
+    for r in range(rounds):
+        half = n_pad >> (r + 1)
+        worst += core * 8.0 * min(cap, half)
+        cap = min(2 * cap, half) if half else 0
+    union = min(p * k, n_pad)
+    worst += (core - 1) * min(8.0 * union, 4.0 * n_pad)
+    worst += 4.0 * n_pad * extras
+    return best / p, worst / p
+
+
+def _measured_wire_bytes(fill_rows: np.ndarray, rounds: int) -> float:
+    """Per-participant measured bytes from fill vectors (participant-
+    averaged rows, one per transport unit): 8 B per sparse entry in the
+    halving rounds, doubling billed at 8 B/entry sparse blending to
+    4 B/element dense by the switchover rate, plus the fold traffic."""
+    total = 0.0
+    for row in fill_rows:
+        sw = float(row[FILL_SWITCH_SLOT])
+        total += 8.0 * float(row[:rounds].sum())
+        total += float(row[FILL_DOUBLING_BASE:FILL_DOUBLING_BASE
+                           + rounds].sum()) * (8.0 - 4.0 * sw)
+        total += 8.0 * float(row[FILL_PREFOLD_SLOT])
+        total += 4.0 * float(row[FILL_POSTFOLD_SLOT])
+    return total
+
+
 def payload_bytes(grads_like: Any, config: GradReduceConfig, *,
-                  ici_size: int = 1, rungs=None) -> dict:
+                  ici_size: int = 1, rungs=None, hop_size: int = None,
+                  fill=None) -> dict:
     """Honest per-participant, per-step payload accounting: the bytes each
     participant injects into the reduction it is compressing (indices +
     values for topk, int8 payload + per-block f32 scales for int8), vs the
@@ -837,7 +1129,18 @@ def payload_bytes(grads_like: Any, config: GradReduceConfig, *,
     reduce-scatter + all-gather bytes ride in ``ici_bytes``;
     ``total_wire_bytes`` sums both fabrics — the single number that used
     to be reported (``compressed_bytes``, kept as the DCN-hop alias) hid
-    which fabric the compression actually saved."""
+    which fabric the compression actually saved.
+
+    ``hop_size`` (the compressed hop's participant count) unlocks the
+    schedule-INCLUSIVE ``wire`` section comparing the two sparse
+    transports per participant: the all-gather's ``(P-1) * 8k`` received
+    bytes vs recursive halving/doubling's analytic best (overlapping
+    supports — the ~P/2 saving) and worst (disjoint supports) bounds,
+    per round, fabric split intact (top-k units only; exact/int8 units
+    ship the same bytes under either protocol).  ``fill`` — the ``fill``
+    reducer-state leaf (participant-stacked or squeezed) — adds the
+    MEASURED bytes and per-round fill-in curve of the realized run.
+    The legacy fields above stay payload-only and unchanged."""
     units = _transport_units(grads_like, config, rungs)
     hier = config.dcn_axis is not None
     if hier and ici_size > 1:
@@ -868,6 +1171,52 @@ def payload_bytes(grads_like: Any, config: GradReduceConfig, *,
         report["dcn_compressed_bytes"] = int(compressed)
         report["dcn_compression_ratio"] = report["compression_ratio"]
         report["total_wire_bytes"] = int(compressed) + ici
+    report["wire_protocol"] = (
+        "rd" if _rd_engaged(config) else "allgather")
+    if hop_size is not None and hop_size > 1:
+        tk = [(n, float(spec)) for n, spec in hop_units
+              if not isinstance(spec, str)]
+        if tk:
+            p = int(hop_size)
+            core, rounds, extras = rd_topology(p)
+            allgather = sum(8.0 * _topk_k(n, d) * (p - 1) for n, d in tk)
+            best = worst = 0.0
+            for n, d in tk:
+                b, w = _rd_wire_unit(n, _topk_k(n, d), p)
+                best += b
+                worst += w
+            wire = {
+                "hop_participants": p,
+                "core": core,
+                "rounds": rounds,
+                "extras": extras,
+                "topk_units": len(tk),
+                "allgather_bytes": int(round(allgather)),
+                "rd_bytes_best": int(round(best)),
+                "rd_bytes_worst": int(round(worst)),
+                "rd_bytes_measured": None,
+                "fill_rounds_measured": None,
+                "switch_rate_measured": None,
+                "reduction_vs_allgather_best": (
+                    round(allgather / best, 3) if best else None),
+                "reduction_vs_allgather_measured": None,
+            }
+            if fill is not None:
+                f = np.asarray(fill, np.float32)
+                if f.ndim == 3:          # participant-stacked state leaf
+                    f = f.mean(axis=0)
+                if f.ndim == 1:
+                    f = f[None]
+                measured = _measured_wire_bytes(f, rounds)
+                wire["rd_bytes_measured"] = round(float(measured), 1)
+                wire["fill_rounds_measured"] = [
+                    round(float(v), 2) for v in f[:, :rounds].sum(axis=0)]
+                wire["switch_rate_measured"] = round(
+                    float(f[:, FILL_SWITCH_SLOT].mean()), 3)
+                if measured:
+                    wire["reduction_vs_allgather_measured"] = round(
+                        allgather / measured, 3)
+            report["wire"] = wire
     return report
 
 
@@ -897,6 +1246,11 @@ def bucket_report(grads_like: Any, config: GradReduceConfig,
             return {"mode": "int8", "density": None}
         return {"mode": "topk", "density": float(spec)}
 
+    # the transfer schedule _reduce_bucketed enforces: hierarchical
+    # compressed reduces chain buckets in consumption order (earliest-
+    # needed first); everything else launches unordered.
+    chained = (config.dcn_axis is not None and config.mode != "exact"
+               and config.dcn_schedule == "earliest" and len(units) > 1)
     return {
         "bucket_count": len(units),
         "bucket_bytes": [4 * n for n, _ in units],
@@ -905,4 +1259,9 @@ def bucket_report(grads_like: Any, config: GradReduceConfig,
         "per_leaf": [{"leaf": i, "elems": plan.leaf_sizes[i],
                       **spec_entry(leaf_specs[i])}
                      for i in range(len(plan.leaf_sizes))],
+        "schedule": {
+            "policy": (config.dcn_schedule
+                       if config.dcn_axis is not None else None),
+            "order": list(range(len(units))) if chained else None,
+        },
     }

@@ -29,6 +29,16 @@ Follow-by-reference: branch bodies and shard_map bodies are walked
 transitively through bare-name calls, across modules when the callee
 resolves through a from-import into the repo (the
 ``sgd -> grad_reduce`` shape).
+
+Sub-check 1 also resolves axes THROUGH helper calls: a body that hands
+a literal axis to a round-loop helper (``_rd_round(x, "dcn")`` whose
+``lax.ppermute`` perm list is built from ``axis_size(axis)`` — the
+recursive-doubling wire protocol's shape) is checked at the call site
+by computing which of the callee's parameters flow into collective
+axis arguments (:meth:`_Resolver.axis_params`, transitive).  The repo
+wrappers whose axis is not the lax API's second positional
+(``sparse_all_reduce(_rd)``, ``quantized_all_reduce``) carry their
+positions in ``_AXIS_ARG_POS``.
 """
 
 from __future__ import annotations
@@ -45,8 +55,17 @@ from .base import LintPass
 _COLLECTIVES = {
     "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
     "all_to_all", "ppermute", "ppermute_ring", "reduce_scatter",
-    "sparse_all_reduce", "quantized_all_reduce", "axis_index",
-    "axis_size", "pbroadcast",
+    "sparse_all_reduce", "sparse_all_reduce_rd", "quantized_all_reduce",
+    "fixed_point_all_reduce", "axis_index", "axis_size", "pbroadcast",
+}
+
+#: positional index of the axis argument when it is not the lax-API
+#: default of 1 — the repo's sparse wrappers put the segment length
+#: before the axis
+_AXIS_ARG_POS = {
+    "sparse_all_reduce": 3,
+    "sparse_all_reduce_rd": 3,
+    "quantized_all_reduce": 2,
 }
 
 #: reductions whose result is identical on every participant — deriving
@@ -88,13 +107,34 @@ def _axis_strings(expr) -> Optional[Set[str]]:
 
 def _axis_arg(call: ast.Call):
     """The axis_name argument of a collective call (second positional in
-    the lax API, or the kwarg)."""
+    the lax API — :data:`_AXIS_ARG_POS` overrides for the repo wrappers
+    whose axis rides later — or the kwarg)."""
     for kw in call.keywords:
         if kw.arg in ("axis_name", "axis"):
             return kw.value
-    if len(call.args) >= 2:
-        return call.args[1]
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    pos = _AXIS_ARG_POS.get(name, 1)
+    if len(call.args) > pos:
+        return call.args[pos]
     return None
+
+
+def _bind_args(fn, call: ast.Call):
+    """(param_name, caller_expr) pairs of a call against a resolved
+    callee's positional signature (keywords included; *args and
+    defaults-by-omission simply don't pair, which is the safe no-check
+    direction)."""
+    names = [a.arg for a in fn.args.args]
+    out = []
+    for i, a in enumerate(call.args):
+        if i < len(names):
+            out.append((names[i], a))
+    for kw in call.keywords:
+        if kw.arg:
+            out.append((kw.arg, kw.value))
+    return out
 
 
 class _Resolver:
@@ -103,6 +143,47 @@ class _Resolver:
     def __init__(self, project: Project):
         self.project = project
         self._memo: Dict[Tuple[str, str], Set[str]] = {}
+        self._axis_memo: Dict[Tuple[str, str], Set[str]] = {}
+
+    def axis_params(self, mod: ModuleInfo, fn, depth: int = 0) -> Set[str]:
+        """Parameter names of ``fn`` that flow into a collective's axis
+        argument — directly (``lax.ppermute(x, axis, perm)`` inside a
+        round-loop helper whose ``axis`` is a parameter), or through a
+        further resolved callee's axis params.  This is what lets
+        :meth:`CollectiveConsistencyPass._check_axis_binding` resolve a
+        LITERAL axis at the call site of a helper (the recursive-
+        doubling round loops) instead of only at the collective itself
+        (memoized, cycle-safe, depth-capped)."""
+        key = (mod.path, f"{fn.name}:{fn.lineno}")
+        if key in self._axis_memo:
+            return self._axis_memo[key]
+        self._axis_memo[key] = set()     # cycle guard
+        try:
+            arg_names = {a.arg for a in fn.args.args}
+        except AttributeError:
+            arg_names = set()
+        params: Set[str] = set()
+        for node in ast.walk(getattr(fn, "_node", fn)):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_collective_call(mod, node):
+                ax = _axis_arg(node)
+                if isinstance(ax, ast.Name) and ax.id in arg_names:
+                    params.add(ax.id)
+            elif depth < _MAX_DEPTH:
+                resolved = self.resolve_callee(mod, node)
+                if resolved is None:
+                    continue
+                inner = self.axis_params(resolved[0], resolved[1],
+                                         depth + 1)
+                if not inner:
+                    continue
+                for pname, expr in _bind_args(resolved[1], node):
+                    if pname in inner and isinstance(expr, ast.Name) \
+                            and expr.id in arg_names:
+                        params.add(expr.id)
+        self._axis_memo[key] = params
+        return params
 
     def resolve_callee(self, mod: ModuleInfo, call: ast.Call,
                        ) -> Optional[Tuple[ModuleInfo, ast.AST]]:
@@ -269,19 +350,39 @@ class CollectiveConsistencyPass(LintPass):
         for node in ast.walk(body):
             if not isinstance(node, ast.Call):
                 continue
-            if _is_collective_call(mod, node) is None:
+            if _is_collective_call(mod, node) is not None:
+                self._flag_unbound(mod, call, node,
+                                   _axis_strings(_axis_arg(node)),
+                                   bound, findings)
                 continue
-            axes = _axis_strings(_axis_arg(node))
-            if axes is None:
+            # helper call whose literal args feed a collective's axis
+            # deeper down (the recursive-doubling round-loop shape:
+            # body -> _rd_round(x, "dcn") -> lax.ppermute(x, axis, perm)
+            # with the perm built from axis_size(axis)) — resolve the
+            # callee's axis-bearing params and check the literals here.
+            resolved = resolver.resolve_callee(mod, node)
+            if resolved is None:
                 continue
-            for ax in sorted(axes - bound):
-                findings.append(mod.finding(
-                    self.id, node,
-                    f"collective names axis {ax!r} but the enclosing "
-                    f"shard_map (line {call.lineno}) only binds "
-                    f"{sorted(bound)} — this aborts at lowering",
-                    hint="bind the axis in the mesh/specs or reduce "
-                         "over a bound axis"))
+            inner = resolver.axis_params(resolved[0], resolved[1])
+            if not inner:
+                continue
+            for pname, expr in _bind_args(resolved[1], node):
+                if pname in inner:
+                    self._flag_unbound(mod, call, node,
+                                       _axis_strings(expr), bound,
+                                       findings)
+
+    def _flag_unbound(self, mod, call, node, axes, bound, findings):
+        if axes is None:
+            return
+        for ax in sorted(axes - bound):
+            findings.append(mod.finding(
+                self.id, node,
+                f"collective names axis {ax!r} but the enclosing "
+                f"shard_map (line {call.lineno}) only binds "
+                f"{sorted(bound)} — this aborts at lowering",
+                hint="bind the axis in the mesh/specs or reduce "
+                     "over a bound axis"))
 
     # -- sub-check 2: top_k under auto ---------------------------------------
     def _check_topk_in_auto(self, mod, resolver, call, body, findings):

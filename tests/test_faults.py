@@ -1282,6 +1282,99 @@ def test_elastic_ef_and_pending_survive_two_consecutive_resizes(tmp_path):
     np.testing.assert_array_equal(log_e, log_b)
 
 
+def test_elastic_wire_accounting_leaves_survive_two_resizes(tmp_path):
+    """ISSUE 16 chaos test: the wire-protocol tier's accounting leaves
+    (per-round ``fill``, union-density ``union``) ride the reducer state
+    through TWO elastic re-shards (2 -> 3 -> 2 workers).  The routing
+    rule under test: ``union`` re-seeds as broadcast participant 0 (a
+    smoothed statistic, psum-uniform within each dcn hop group),
+    ``fill`` is per-round counts specific to the OLD fleet's round
+    structure (re-seeded to zeros at the new extent) — both must
+    re-seat at every fleet size, never refuse the resize."""
+    from flink_ml_tpu.data.datacache import DataCacheReader
+    from flink_ml_tpu.iteration.checkpoint import load_pytree
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+    from flink_ml_tpu.parallel.grad_reduce import FILL_VEC_LEN
+
+    cache = _elastic_cache(tmp_path, "c_wire")
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=4, tol=0.0,
+                    grad_reduce=_elastic_gr())
+    kw = dict(num_features=8, config=cfg, cache_decoded=False,
+              steps_per_dispatch=2, checkpoint_every_steps=2)
+
+    def reader():
+        return DataCacheReader(cache, batch_rows=240)
+
+    coord = _elastic_coord(2)
+    plan = (FaultPlan(seed=8)
+            .inject(coord.SCOPE, at=2, kind="join")
+            .inject(coord.SCOPE, at=5, kind="preempt"))
+    report = RecoveryReport()
+    with plan:
+        resilient_fit(
+            sgd_fit_outofcore, logistic_loss,
+            lambda: plan.wrap_source(reader()),
+            checkpoint=CheckpointConfig(str(tmp_path / "ck_w"),
+                                        max_to_keep=99),
+            elastic=coord,
+            backoff=RetryPolicy(base_delay=0.0, sleep=lambda s: None),
+            report=report, **kw)
+    assert report.resizes == 2
+    assert [e.fleet_size for e in report.events] == [3, 2]
+
+    def find_wire_leaves(tree):
+        """The (fill, union) pair wherever the reducer state landed."""
+        if isinstance(tree, dict):
+            if "fill" in tree and "union" in tree:
+                return tree["fill"], tree["union"]
+            for v in tree.values():
+                hit = find_wire_leaves(v)
+                if hit is not None:
+                    return hit
+        elif isinstance(tree, (list, tuple)):
+            for v in tree:
+                hit = find_wire_leaves(v)
+                if hit is not None:
+                    return hit
+        return None
+
+    ck = tmp_path / "ck_w"
+    cuts = sorted(name for name in os.listdir(ck)
+                  if name.startswith("ckpt-")
+                  and not name.endswith((".corrupt", ".old", ".tmp")))
+    assert cuts
+    extents = set()
+    for name in cuts:
+        tree, _meta = load_pytree(str(ck / name))
+        hit = find_wire_leaves(tree)
+        assert hit is not None, f"{name} lost the wire accounting leaves"
+        fill, union = np.asarray(hit[0]), np.asarray(hit[1])
+        # fill: (participants, units, FILL_VEC_LEN); union: (p, units)
+        assert fill.shape[0] == union.shape[0]
+        assert fill.shape[-1] == FILL_VEC_LEN
+        assert np.isfinite(fill).all() and np.isfinite(union).all()
+        # union is psum'd over the dcn hop axis, hence identical across
+        # workers WITHIN each ICI column (participants stack in
+        # (dcn, data) order) — each column compresses a different
+        # gradient shard, so columns legitimately differ; the resize
+        # routing (broadcast participant 0) re-seeds the EMA from one
+        # column, which the next steps re-diverge
+        u3 = union.reshape(union.shape[0] // 2, 2, *union.shape[1:])
+        np.testing.assert_allclose(
+            u3, np.broadcast_to(u3[:1], u3.shape), atol=0)
+        extents.add(fill.shape[0])
+    # cuts exist at BOTH fleet extents (2 workers x 2 chips, 3 x 2):
+    # the leaves re-seated across grow AND shrink
+    assert extents == {4, 6}
+    # the last cut (post-shrink) measured real traffic again: the
+    # re-seeded fill repopulated after the second resize
+    tree, _meta = load_pytree(str(ck / cuts[-1]))
+    fill, union = find_wire_leaves(tree)
+    assert np.asarray(fill).any()
+    assert np.asarray(union).any()
+
+
 def test_elastic_worker_death_mid_chunk_degrades_to_crash_path(tmp_path):
     """A worker dies MID-chunk (crash at a source pull, not at a
     boundary): the supervisor revokes the victim's lease and recovery

@@ -40,6 +40,8 @@ from scripts.graftlint.core import (  # noqa: E402
 from scripts.graftlint.passes import ALL_PASSES  # noqa: E402
 from scripts.graftlint.passes.atomic_writes import AtomicWritesPass  # noqa: E402,E501
 from scripts.graftlint.passes.collectives import (  # noqa: E402
+    _AXIS_ARG_POS,
+    _COLLECTIVES,
     CollectiveConsistencyPass,
 )
 from scripts.graftlint.passes.donation import DonationSafetyPass  # noqa: E402,E501
@@ -527,6 +529,84 @@ def test_collectives_follow_cross_module_references(tmp_path):
     problems = CollectiveConsistencyPass().check_module(mod, project)
     assert len(problems) == 1 and "top_k" in problems[0].message
     assert "reduce.py" in problems[0].message       # names the hop
+
+
+def test_collectives_resolve_axis_through_round_loop_helpers(tmp_path):
+    """ISSUE 16 seeded fixture: the recursive-doubling wire protocol
+    moves its ``ppermute`` out of the shard_map body into round-loop
+    helpers whose perm lists are built from ``axis_size(axis)``.  A
+    typo'd LITERAL axis at the helper call site used to sail past
+    sub-check 1 — the collective itself only ever sees the parameter
+    name ``axis``, which is not a literal — and abort at lowering.  The
+    pass now computes which helper params flow into collective axis
+    arguments (transitively: ``body -> rd_round -> exchange ->
+    ppermute``) and checks the literals at the call site.  The
+    correctly-bound twin body must stay clean."""
+    problems = _check(CollectiveConsistencyPass(), tmp_path, """\
+        import jax
+        from jax import lax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(jax.devices(), ("data",))
+
+        def exchange(x, axis, perm):
+            return lax.ppermute(x, axis, perm)
+
+        def rd_round(x, r, axis):
+            p = lax.axis_size(axis)
+            half = p >> (r + 1)
+            perm = [(i, i ^ half) for i in range(p)]
+            return x + exchange(x, axis, perm)
+
+        def body(x):
+            for r in range(3):
+                x = rd_round(x, r, "dcn")     # typo: mesh binds "data"
+            return x
+
+        def body_ok(x):
+            for r in range(3):
+                x = rd_round(x, r, "data")
+            return x
+
+        def run(x):
+            return shard_map(body, mesh, in_specs=(P("data"),),
+                             out_specs=P("data"))(x)
+
+        def run_ok(x):
+            return shard_map(body_ok, mesh, in_specs=(P("data"),),
+                             out_specs=P("data"))(x)
+        """)
+    msgs = [f.message for f in problems]
+    assert len(problems) == 1, msgs
+    assert "axis 'dcn'" in msgs[0] and "['data']" in msgs[0]
+
+
+def test_collectives_pass_visits_wire_protocol_module():
+    """The recursive-doubling primitives live in parallel/collectives.py
+    — assert the pass's walk genuinely VISITS that module (a roots
+    listing that misses it guards nothing), that the new wrappers are
+    known collectives with their axis positions registered (their axis
+    rides AFTER the segment length, so the lax-default position 1 would
+    misread a perm list as an axis), and that the module is clean raw:
+    the one ``lax.cond`` in ``sparse_all_reduce_rd`` keeps equal branch
+    collective sets by construction (both doubling branches are pure
+    ppermute), so no new baseline entry was needed."""
+    assert {"sparse_all_reduce_rd", "fixed_point_all_reduce"} \
+        <= _COLLECTIVES
+    assert _AXIS_ARG_POS["sparse_all_reduce_rd"] == 3
+    assert _AXIS_ARG_POS["sparse_all_reduce"] == 3
+    project = Project(repo=REPO)
+    visited = {os.path.basename(m.path): m
+               for m in project.iter_modules(
+                   [os.path.join(REPO, "flink_ml_tpu", "parallel")])}
+    assert {"collectives.py", "grad_reduce.py"} <= set(visited)
+    coll_pass = CollectiveConsistencyPass()
+    assert coll_pass.check_module(visited["collectives.py"], project) == []
+    # grad_reduce's only raw finding stays the baselined rung switch —
+    # the new wire-protocol plumbing added nothing
+    raw = coll_pass.check_module(visited["grad_reduce.py"], project)
+    assert {f.symbol for f in raw} <= {"_reduce_bucketed"}
 
 
 def test_grad_reduce_adaptive_switch_is_baselined_not_silent():
