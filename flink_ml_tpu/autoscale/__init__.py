@@ -1,0 +1,40 @@
+"""Unified autoscaling control plane (ISSUE 17).
+
+One controller over four prior PRs' actuators: it consumes the
+graftscope metrics tree (PR 13), publishes a versioned
+:class:`PlacementMap` splitting the chip budget between the PR 14
+multi-tenant scheduler and the PR 15 elastic learner, and continuously
+rebalances — serving scales out as diurnal traffic ramps (a PR 12
+AOT cache-hit warm), the trough yields to training, and interactive
+load preempts it back (a lossless PR 15 boundary resize) — with
+hysteresis so noise never thrashes the fleet.
+
+Modules: :mod:`~.placement` (the versioned map + durable store),
+:mod:`~.signals` (typed frames over ``MetricsTree.snapshot()``),
+:mod:`~.policy` (deadband + min-dwell decision loop),
+:mod:`~.controller` (the actuation loop; every decision a tracer
+instant).
+"""
+
+from .controller import AutoscaleController
+from .placement import PlacementConflict, PlacementMap, PlacementStore
+from .policy import (DECISION_HOLD, DECISION_SCALE_SERVING,
+                     DECISION_YIELD_TO_TRAINING, AutoscalePolicy,
+                     Decision, PolicyConfig)
+from .signals import SignalFrame, SignalSource, TenantSignal
+
+__all__ = [
+    "AutoscaleController",
+    "AutoscalePolicy",
+    "Decision",
+    "DECISION_HOLD",
+    "DECISION_SCALE_SERVING",
+    "DECISION_YIELD_TO_TRAINING",
+    "PlacementConflict",
+    "PlacementMap",
+    "PlacementStore",
+    "PolicyConfig",
+    "SignalFrame",
+    "SignalSource",
+    "TenantSignal",
+]

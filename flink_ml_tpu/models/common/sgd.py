@@ -19,7 +19,8 @@ import inspect
 import itertools
 import time
 
-from dataclasses import dataclass
+from collections import OrderedDict
+from dataclasses import astuple, dataclass, is_dataclass
 from typing import Callable, Dict, Optional, Tuple
 
 import jax
@@ -1309,6 +1310,66 @@ def _seek_or_skip(reader, k: int):
     return it
 
 
+# ---------------------------------------------------------------------------
+# Step-program compile cache.  Crash->resume, elastic resize, and A/B
+# refits re-enter sgd_fit_outofcore many times per process with the same
+# (loss, config, mesh, layout); the update/chunk closures are pure
+# functions of those inputs, so re-jitting a fresh closure per call pays
+# the full XLA compile again for a program that cannot differ.  Keyed by
+# value (SGDConfig is mutable — hash its field tuple, recursing into the
+# frozen GradReduceConfig) plus the mesh's axis extents and device ids;
+# an unhashable key (exotic loss object, custom grad_reduce) just skips
+# the cache.  Bounded LRU so a long-lived trainer cycling many configs
+# does not retain every executable forever.
+_STEP_PROGRAM_CACHE: "OrderedDict" = OrderedDict()
+_STEP_PROGRAM_CACHE_CAP = 64
+
+
+def _step_program_key(kind: tuple, loss_fn, config: SGDConfig, mesh):
+    """Hashable identity of a compiled step program, or None to skip.
+
+    Only the config fields the update closures consume participate —
+    host-loop knobs (max_epochs, tol, seed, batch size) must NOT
+    fragment the key, or a refit at a different epoch budget would
+    recompile an identical program.
+    """
+    gr = config.grad_reduce
+    try:
+        key = (kind, loss_fn,
+               float(config.learning_rate), float(config.reg),
+               float(config.elastic_net), bool(config.fit_intercept),
+               str(config.ell_precision),
+               type(gr).__name__, astuple(gr) if is_dataclass(gr) else gr,
+               tuple(str(a) for a in mesh.axis_names),
+               tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+               tuple(int(d.id) for d in np.ravel(mesh.devices)))
+        hash(key)
+    except Exception:
+        return None
+    return key
+
+
+def _cached_step_program(key, build: Callable):
+    """Return the cached jitted callable for ``key``, building on miss.
+
+    Reusing the jit wrapper (not just the traced program) keeps XLA's
+    per-shape executable cache attached to it, so a cache hit skips both
+    the re-trace and the re-compile; donation semantics are per-call and
+    unaffected by reuse.
+    """
+    if key is None:
+        return build()
+    fn = _STEP_PROGRAM_CACHE.get(key)
+    if fn is None:
+        fn = build()
+        _STEP_PROGRAM_CACHE[key] = fn
+        if len(_STEP_PROGRAM_CACHE) > _STEP_PROGRAM_CACHE_CAP:
+            _STEP_PROGRAM_CACHE.popitem(last=False)
+    else:
+        _STEP_PROGRAM_CACHE.move_to_end(key)
+    return fn
+
+
 def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
                       num_features: int, config: SGDConfig, mesh=None,
                       features_key: str = "features",
@@ -1635,7 +1696,13 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
         update = (_mixed_update(loss_fn, config) if mixed
                   else (_sparse_update if sparse
                         else _linear_update)(loss_fn, config))
-    batch_step = jax.jit(update, donate_argnums=0)
+    # mixed and sparse both plan "xla-stream" but build different update
+    # closures, so the layout flags join the key alongside the impl name
+    layout_sig = (stream_impl, bool(mixed), bool(sparse), num_features)
+    step_key = _step_program_key(("outofcore-batch",) + layout_sig,
+                                 loss_fn, config, mesh)
+    batch_step = _cached_step_program(
+        step_key, lambda: jax.jit(update, donate_argnums=0))
 
     manager: Optional[CheckpointManager] = None
     if isinstance(checkpoint, CheckpointManager):
@@ -1687,21 +1754,24 @@ def sgd_fit_outofcore(loss_fn: LossFn, make_reader: Callable, *,
 
         sharding, chunk_depth = chunk_consumer_plan(mesh, specs, W,
                                                     prefetch_depth)
+        chunk_key = _step_program_key(
+            ("outofcore-chunk",) + layout_sig + (bool(step_probe),),
+            loss_fn, config, mesh)
         if step_probe:
             # the probe joins the donated carry (argnums 0-2): each
             # chunk's returned probe is fetched ONCE at the boundary and
             # a reset() probe (fresh buffers) feeds the next dispatch,
             # so donation never aliases a buffer the host still reads
-            chunk_step = jax.jit(
+            chunk_step = _cached_step_program(chunk_key, lambda: jax.jit(
                 lambda params, loss_sum, probe, chunk, mask:
                 masked_chunk_scan(update, params, loss_sum, chunk, mask,
                                   probe=probe),
-                donate_argnums=(0, 1, 2))
+                donate_argnums=(0, 1, 2)))
         else:
-            chunk_step = jax.jit(
+            chunk_step = _cached_step_program(chunk_key, lambda: jax.jit(
                 lambda params, loss_sum, chunk, mask: masked_chunk_scan(
                     update, params, loss_sum, chunk, mask),
-                donate_argnums=(0, 1))
+                donate_argnums=(0, 1)))
     else:
         W = 1
         sharding = tuple(NamedSharding(mesh, p) for p in specs)

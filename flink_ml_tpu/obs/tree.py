@@ -107,7 +107,8 @@ class MetricsTree:
 def default_tree(*, endpoint: Any = None, serving: Any = None,
                  scheduler: Any = None, recovery: Any = None,
                  stream_info: Any = None, iteration_result: Any = None,
-                 tracer: Any = None, elastic: Any = None) -> MetricsTree:
+                 tracer: Any = None, elastic: Any = None,
+                 autoscale: Any = None) -> MetricsTree:
     """A :class:`MetricsTree` pre-wired to every standard surface that
     exists in this process:
 
@@ -137,7 +138,12 @@ scheduler.SharedScheduler`'s subtree (class-labeled shed counters,
       fleet gauges (fleet size, membership epoch, join/leave/death/
       suppression counters, resizes) so an operator can correlate a
       loss-curve kink or a step-time shift with the membership
-      transition that caused it.
+      transition that caused it;
+    - ``autoscale`` — an
+      :class:`~flink_ml_tpu.autoscale.controller.AutoscaleController`'s
+      self-view (ticks, actuations, decision latency, the policy's
+      decision ledger, the live placement generation — ISSUE 17), so
+      the control plane is observable through the same tree it reads.
     """
     from ..kernels.registry import kernel_stats
 
@@ -164,6 +170,8 @@ scheduler.SharedScheduler`'s subtree (class-labeled shed counters,
             "dropped": tracer.dropped})
     if elastic is not None:
         tree.register("elastic", elastic)
+    if autoscale is not None:
+        tree.register("autoscale", autoscale)
     return tree
 
 

@@ -186,7 +186,14 @@ class ElasticCoordinator:
         self.counters: Dict[str, int] = {
             "joins": 0, "leaves": 0, "preemptions": 0, "deaths": 0,
             "expirations": 0, "suppressed": 0, "resizes": 0,
+            "controller_requests": 0,
         }
+        #: controller-initiated resize pending application at a chunk
+        #: boundary: (target_workers, at_boundary, reason)
+        self._pending_resize: Optional[Tuple[int, Optional[int], str]] = None
+        #: chunk boundaries seen so far — one per :meth:`poll` call, the
+        #: same index space FaultPlan schedules against
+        self._boundary_polls = 0
         n0 = initial_workers if initial_workers is not None else pool_max
         if not self.min_workers <= n0 <= self.max_workers:
             raise ValueError(
@@ -341,6 +348,62 @@ class ElasticCoordinator:
             return Mesh(dev_array, axis_names=(self.dcn_axis,
                                                self.data_axis))
 
+    # -- controller-initiated transitions ----------------------------------
+
+    def request_resize(self, target_workers: int, *,
+                       at_boundary: Optional[int] = None,
+                       reason: str = "controller") -> int:
+        """Ask the fleet to become ``target_workers`` at a chunk
+        boundary (ISSUE 17: the autoscale controller's training
+        actuator).  The request is NOT applied here — it is applied by
+        :meth:`poll`, walking the fleet toward the target through the
+        SAME :meth:`register`/:meth:`preempt` transitions the injected
+        fault seam uses, so the PR 15 chaos matrix (torn cut during
+        resize, death mid-chunk, bit-exact restore on the shrunken
+        fleet) covers controller preemptions for free.
+
+        ``at_boundary`` pins application to a specific boundary index
+        (the FaultPlan index space: poll invocations across the whole
+        run) — ``None`` means the next boundary.  The target is clamped
+        to ``[min_workers, max_workers]``; a later request replaces a
+        pending one (last-writer-wins: the controller's newest intent is
+        the only one that matters).  Returns the clamped target."""
+        target = max(self.min_workers,
+                     min(int(target_workers), self.max_workers))
+        with self._lock:
+            self._pending_resize = (target, at_boundary, str(reason))
+            self.counters["controller_requests"] += 1
+        from ..obs.trace import tracer
+
+        tracer.instant("resize_requested", cat="train",
+                       x_target=target, x_reason=str(reason))
+        return target
+
+    def _apply_pending_resize(self) -> None:
+        """Walk the fleet to a due pending target — called from
+        :meth:`poll` only, AFTER the fault seam (an injected transition
+        this boundary is part of the state the controller's request
+        converges from, not something it races)."""
+        with self._lock:
+            if self._pending_resize is None:
+                return
+            target, at_boundary, _reason = self._pending_resize
+            if at_boundary is not None \
+                    and self._boundary_polls <= at_boundary:
+                return
+            self._pending_resize = None
+        while True:
+            with self._lock:
+                n = len(self._leases)
+            if n < target:
+                if self.register() is None:
+                    return      # suppressed at the bound: stop walking
+            elif n > target:
+                if self.preempt() is None:
+                    return
+            else:
+                return
+
     # -- the chunk-boundary seam ------------------------------------------
 
     def poll(self, step: Optional[int] = None) -> bool:
@@ -362,12 +425,15 @@ class ElasticCoordinator:
             fault_point,
         )
 
+        with self._lock:
+            self._boundary_polls += 1
         try:
             fault_point(self.SCOPE)
         except InjectedPreemption:
             self.preempt()
         except InjectedJoin:
             self.register()
+        self._apply_pending_resize()
         self.expire()
         with self._lock:
             return self._epoch != self._built_epoch
@@ -413,6 +479,7 @@ class ElasticCoordinator:
         """Fleet-state snapshot for a :class:`~..obs.tree.MetricsTree`
         (``default_tree(elastic=...)``)."""
         with self._lock:
+            pending = self._pending_resize
             return {
                 "fleet_size": len(self._leases),
                 "membership_epoch": self._epoch,
@@ -420,6 +487,9 @@ class ElasticCoordinator:
                 "chips_per_worker": self.chips_per_worker,
                 "min_workers": self.min_workers,
                 "max_workers": self.max_workers,
+                "boundary_polls": self._boundary_polls,
+                "pending_resize_target": (pending[0] if pending is not None
+                                          else -1),
                 **{k: int(v) for k, v in self.counters.items()},
             }
 
@@ -433,5 +503,6 @@ class ElasticCoordinator:
                     "min_workers", "max_workers"):
             sub.gauge(key).set(snap[key])
         for key in ("joins", "leaves", "preemptions", "deaths",
-                    "expirations", "suppressed", "resizes"):
+                    "expirations", "suppressed", "resizes",
+                    "controller_requests"):
             sub.gauge(key).set(snap[key])

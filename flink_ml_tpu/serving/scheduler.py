@@ -126,6 +126,10 @@ class Tenant:
         self.serve_name = serve_name
         self.slo = slo
         self.weight = weight
+        #: the admission-time weight — ``apply_placement`` rescales
+        #: ``weight`` by the tenant's chip count RELATIVE to this, so
+        #: placements compose instead of compounding
+        self.base_weight = weight
         self.metrics = metrics
         self.pending: deque = deque()
         #: WFQ virtual-finish tag (rows served / weight, class-relative)
@@ -149,7 +153,8 @@ class SharedScheduler:
                  queue_capacity: int = 1024,
                  admit_fractions: Optional[Dict[str, float]] = None,
                  bulk_batch_rows: Optional[int] = None,
-                 group: Optional[MetricGroup] = None):
+                 group: Optional[MetricGroup] = None,
+                 busy_clock: Optional[Any] = None):
         if max_batch_rows <= 0:
             raise ValueError("max_batch_rows must be positive")
         if max_wait_ms < 0:
@@ -213,6 +218,31 @@ class SharedScheduler:
         #: class-labeled shed counters — the shed-order evidence
         self._shed = {slo: self.group.counter(f"shed_{slo}")
                       for slo in SLO_CLASSES}
+        #: per-SLO-class queue depth gauges (ISSUE 17: the autoscale
+        #: policy keys its pressure trigger on the INTERACTIVE depth,
+        #: which the aggregate gauge hides under a bulk flood)
+        self._class_depth = {slo: self.group.gauge(f"queue_depth_{slo}")
+                             for slo in SLO_CLASSES}
+        for gauge in self._class_depth.values():
+            gauge.set(0)
+        #: chip-idle accounting (ISSUE 17): busy seconds accumulate
+        #: around dispatch on ONE clock (``busy_clock``, injectable for
+        #: tests), and ``chip_idle_fraction`` is windowed between
+        #: snapshot() calls on that SAME clock — idle is
+        #: 1 - busy/wall with both deltas from one domain, never a
+        #: cross-clock ratio.  NaN until the first complete window
+        #: (absent, not faked — the obs export stance).
+        self._busy_clock = busy_clock or time.perf_counter
+        self._busy_s = 0.0
+        self._idle_window_start: Optional[float] = None
+        self._idle_window_busy = 0.0
+        self._idle_fraction = self.group.gauge("chip_idle_fraction")
+        self._idle_fraction.set(float("nan"))
+        #: the placement generation last applied via apply_placement —
+        #: -1 until the autoscale controller first moves this scheduler
+        self._placement_generation = self.group.gauge(
+            "placement_generation")
+        self._placement_generation.set(-1)
         self._tenant_group = self.group.add_group("tenants")
 
         self._tenants: Dict[str, Tenant] = {}
@@ -272,6 +302,10 @@ class SharedScheduler:
             metrics = ServingMetrics(
                 group=self._tenant_group.add_group(name),
                 min_publish_interval_s=0.02)
+            # the class label rides the tenant's own subtree so signal
+            # consumers (autoscale) can group tenants per SLO from one
+            # snapshot; a string gauge stays out of prometheus exports
+            metrics.group.gauge("slo").set(slo)
             if servable_of is not None:
                 if model is not None or example is not None:
                     raise ValueError(
@@ -540,6 +574,7 @@ class SharedScheduler:
                            cat="serving", request_id=request.request_id,
                            generation=deployed.generation,
                            tenant=tenant.name)
+        busy_t0 = self._busy_clock()
         try:
             with tracer.span("serve_batch", cat="serving",
                              generation=deployed.generation,
@@ -554,6 +589,11 @@ class SharedScheduler:
             for _, request in picked:
                 request.future.set_exception(exc)
             return
+        finally:
+            # device-busy accounting: even a failed dispatch occupied
+            # the chip — idle means NOTHING dispatched, not "nothing
+            # succeeded"
+            self._busy_s += self._busy_clock() - busy_t0
         offset = 0
         now = time.perf_counter()
         per_tenant: Dict[str, List] = {}
@@ -591,6 +631,31 @@ class SharedScheduler:
                 and depth < min(self.admit_limits.values())):
             self._health.set(HEALTH_SERVING)
 
+    # -- placement (ISSUE 17) ------------------------------------------------
+    def apply_placement(self, pmap: Any) -> Dict[str, float]:
+        """Adopt an autoscale :class:`~flink_ml_tpu.autoscale.placement.\
+PlacementMap`: every placed tenant's WFQ weight becomes
+        ``base_weight * chip_count`` — capacity share tracks the chip
+        share the controller granted — and unplaced tenants keep their
+        admission weight.  Pure bookkeeping on this (single-device)
+        scheduler: no queue is touched, no batch re-formed; in-flight
+        requests are unaffected.  Returns the applied name -> weight
+        map (the actuation receipt the controller logs)."""
+        with self._cond:
+            applied: Dict[str, float] = {}
+            for tenant in self._tenants.values():
+                chips = len(pmap.chips_for(tenant.name))
+                if chips > 0:
+                    tenant.weight = tenant.base_weight * chips
+                    applied[tenant.name] = tenant.weight
+                else:
+                    tenant.weight = tenant.base_weight
+            self._placement_generation.set(pmap.generation)
+        tracer.instant("placement_applied", cat="serving",
+                       generation=pmap.generation,
+                       x_tenants=str(len(applied)))
+        return applied
+
     # -- observability -------------------------------------------------------
     @property
     def health(self) -> str:
@@ -598,6 +663,26 @@ class SharedScheduler:
 
     def shed_counts(self) -> Dict[str, int]:
         return {slo: c.value for slo, c in self._shed.items()}
+
+    def _refresh_gauges(self) -> None:
+        """Export-time gauge refresh: per-class queue depths (summed
+        under the lock — the dispatch path never pays for them) and the
+        windowed chip-idle fraction, both deltas on ``_busy_clock``."""
+        with self._cond:
+            depths = {slo: 0 for slo in SLO_CLASSES}
+            for tenant in self._tenants.values():
+                depths[tenant.slo] += len(tenant.pending)
+            busy = self._busy_s
+        for slo, depth in depths.items():
+            self._class_depth[slo].set(depth)
+        now = self._busy_clock()
+        if self._idle_window_start is not None:
+            wall = now - self._idle_window_start
+            if wall > 0:
+                frac = 1.0 - (busy - self._idle_window_busy) / wall
+                self._idle_fraction.set(min(1.0, max(0.0, frac)))
+        self._idle_window_start = now
+        self._idle_window_busy = busy
 
     def snapshot(self) -> Dict[str, Any]:
         """The scheduler's full metric subtree (scheduler gauges +
@@ -611,4 +696,5 @@ class SharedScheduler:
             tenants = list(self._tenants.values())
         for tenant in tenants:
             tenant.metrics.publish(force=True)
+        self._refresh_gauges()
         return self.group.snapshot()

@@ -32,7 +32,11 @@ from .base import LintPass
 #: a trusted path.  The one sanctioned exception — the sampler's
 #: line-framed JSONL append, whose torn tail the reader truncates (the
 #: WAL-tail stance) — carries an inline suppression with its
-#: justification, which this root existing keeps EXERCISED.)
+#: justification, which this root existing keeps EXERCISED.
+#: ``flink_ml_tpu/autoscale/`` joined in ISSUE 17 — the placement map
+#: is the file a restarting control plane trusts to know who owns
+#: which chips; a torn placement would mis-route an entire fleet, so
+#: every publish must be tmp -> os.replace.)
 DURABLE_MODULES = (
     "flink_ml_tpu/utils/persist.py",
     "flink_ml_tpu/iteration/checkpoint.py",
@@ -41,6 +45,7 @@ DURABLE_MODULES = (
     "flink_ml_tpu/kernels/aot.py",
     "flink_ml_tpu/kernels/autotune.py",
     "flink_ml_tpu/obs",
+    "flink_ml_tpu/autoscale",
 )
 
 _WRITE_MODES = {"w", "wb", "w+", "wb+", "a", "ab"}

@@ -57,7 +57,13 @@ SCAN_CALLEES = {"scan", "masked_chunk_scan", "while_loop", "fori_loop"}
 #: helper on its dispatch path would stall every tenant's traffic at
 #: once, not one endpoint's, and the embedding-cache pool ops must stay
 #: async for the miss path to overlap with serving)
+#: (``autoscale/`` joined with ISSUE 17: the controller reads the same
+#: metrics tree the serving/training hot paths publish into — a host
+#: sync in a step-shaped helper here would fence the very dispatch
+#: streams the control plane exists to keep busy, turning every
+#: decision tick into a fleet-wide stall)
 SCAN_ROOTS = (
+    "flink_ml_tpu/autoscale",
     "flink_ml_tpu/iteration",
     "flink_ml_tpu/models",
     "flink_ml_tpu/obs",

@@ -1,0 +1,612 @@
+"""Autoscaling control plane tests (ISSUE 17): the versioned placement
+store (atomic tmp -> os.replace publish, generation CAS, restart
+reconciliation, capacity validation), typed signal frames over the
+metrics tree (per-class depths, windowed shed rates, NaN-neutral
+degradation), the hysteresis unit matrix (deadband holds under
+oscillating p99; a publish storm of 30 generations causes ZERO
+placement churn; min-dwell bounds decisions/minute), the
+injectable-clock regression (dwell timers + decision latency all on one
+fake clock), controller actuation into the scheduler + elastic
+coordinator, and the compressed 24h diurnal replay acceptance test —
+interactive p99 holds while learner staleness stays bounded, every
+decision a tracer instant."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.autoscale import (
+    DECISION_HOLD,
+    DECISION_SCALE_SERVING,
+    DECISION_YIELD_TO_TRAINING,
+    AutoscaleController,
+    AutoscalePolicy,
+    PlacementConflict,
+    PlacementMap,
+    PlacementStore,
+    PolicyConfig,
+    SignalFrame,
+    SignalSource,
+)
+from flink_ml_tpu.obs.tree import MetricsTree, default_tree, prometheus_text
+from flink_ml_tpu.parallel.elastic import ElasticCoordinator
+from flink_ml_tpu.serving import ModelRegistry, SharedScheduler
+from flink_ml_tpu.serving.scheduler import (
+    SLO_BULK,
+    SLO_CLASSES,
+    SLO_INTERACTIVE,
+)
+
+
+# -- fixtures ----------------------------------------------------------------
+
+class FakeClock:
+    """One injectable clock for sampler + policy + controller + store +
+    scheduler busy accounting — advancing it moves every timer
+    coherently (the clock-domain satellite)."""
+
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _frame(p99=float("nan"), idle=float("nan"), at=0.0, qd_inter=0.0,
+           shed_inter=0.0, staleness=float("nan")):
+    return SignalFrame(
+        at=at, tenants={}, interactive_p99_ms=p99,
+        queue_depth={"interactive": qd_inter, "standard": 0.0,
+                     "bulk": 0.0},
+        shed_rate={"interactive": shed_inter, "standard": 0.0,
+                   "bulk": 0.0},
+        chip_idle_fraction=idle, staleness_s=staleness,
+        learner_staleness_s=staleness, fleet_size=0, membership_epoch=0,
+        max_generation=float("nan"))
+
+
+def _config(**kw):
+    kw.setdefault("p99_target_ms", 50.0)
+    kw.setdefault("total_chips", 8)
+    kw.setdefault("chips_per_worker", 1)
+    kw.setdefault("min_dwell_s", 10.0)
+    kw.setdefault("min_serving_chips", 1)
+    return PolicyConfig(**kw)
+
+
+class _StubServable:
+    """Queue-mechanics stub (the test_scheduler idiom): echoes input,
+    always ready.  ``busy_s_per_row`` advances an injected clock inside
+    predict, so device-busy time — and therefore the scheduler's
+    chip_idle_fraction — is a deterministic function of served rows."""
+
+    busy_clock = None
+    busy_s_per_row = 0.0
+    ready = True
+    warmup_report = None
+
+    def __init__(self, model, example, **kwargs):
+        self.max_batch_rows = kwargs.get("max_batch_rows", 256)
+        self.output_cols = None
+
+    def warm_up(self):
+        return self
+
+    def check_schema(self, table):
+        pass
+
+    def bucket_for(self, rows):
+        return max(8, rows)
+
+    def predict(self, table):
+        if _StubServable.busy_clock is not None:
+            _StubServable.busy_clock.advance(
+                _StubServable.busy_s_per_row * table.num_rows)
+        return table
+
+
+@pytest.fixture
+def stub_busy():
+    yield
+    _StubServable.busy_clock = None
+    _StubServable.busy_s_per_row = 0.0
+
+
+def _stub_scheduler(**kwargs):
+    return SharedScheduler(ModelRegistry(servable_factory=_StubServable),
+                           **kwargs)
+
+
+def _feats(n=8, seed=1):
+    rng = np.random.default_rng(seed)
+    return Table({"features": rng.normal(size=(n, 4))})
+
+
+def _drain(scheduler):
+    """Inline pick->dispatch until empty (deterministic, no thread)."""
+    batches = 0
+    while True:
+        formed = scheduler._next_batch(timeout=0.0)
+        if formed is None:
+            return batches
+        scheduler._dispatch(*formed)
+        batches += 1
+
+
+# -- placement store ---------------------------------------------------------
+
+def test_placement_publish_bumps_generation_and_is_durable(tmp_path):
+    path = str(tmp_path / "placement.json")
+    store = PlacementStore(8, chips_per_worker=2, path=path,
+                           clock=FakeClock(5.0))
+    assert store.generation == 0
+    pmap = store.publish({"a": [0, 1], "b": [1, 2, 3]}, 2)
+    assert pmap.generation == 1
+    assert pmap.serving_chips() == (0, 1, 2, 3)
+    assert pmap.chips_for("a") == (0, 1)
+    assert pmap.published_at == 5.0
+    # durable through the commit protocol: real file, no tmp debris
+    assert os.path.exists(path)
+    assert not os.path.exists(path + ".tmp")
+    on_disk = PlacementMap.from_dict(json.loads(
+        open(path).read()))
+    assert on_disk == pmap
+    # reads are the live reference
+    assert store.current() is pmap
+
+
+def test_placement_validation_rejects_bad_maps():
+    store = PlacementStore(4, chips_per_worker=1)
+    with pytest.raises(ValueError, match="outside the pool"):
+        store.publish({"a": [0, 4]}, 0)
+    with pytest.raises(ValueError, match="repeats a chip"):
+        store.publish({"a": [1, 1]}, 0)
+    with pytest.raises(ValueError, match="overcommits"):
+        store.publish({"a": [0, 1, 2]}, 2)   # 3 serving + 2 learner > 4
+    with pytest.raises(ValueError, match="learner_workers"):
+        store.publish({}, -1)
+    # tenants MAY overlap each other (PR 14 shared-device posture)
+    pmap = store.publish({"a": [0, 1], "b": [0, 1]}, 2)
+    assert pmap.serving_chips() == (0, 1)
+
+
+def test_placement_conditional_publish_conflicts():
+    store = PlacementStore(4)
+    store.publish({"a": [0]}, 1)
+    with pytest.raises(PlacementConflict):
+        store.publish({"a": [0, 1]}, 1, expected_generation=0)
+    # un-conditional publish still wins
+    assert store.publish({"a": [0, 1]}, 1).generation == 2
+
+
+def test_placement_load_reconciles_newer_disk_map(tmp_path):
+    """The crash-between-write-and-swap window: disk ahead of memory is
+    adopted at restart; disk behind is ignored."""
+    path = str(tmp_path / "placement.json")
+    writer = PlacementStore(8, path=path)
+    writer.publish({"a": [0, 1]}, 3)
+    writer.publish({"a": [0, 1, 2]}, 2)
+    fresh = PlacementStore(8, path=path)
+    adopted = fresh.load()
+    assert adopted is not None and adopted.generation == 2
+    assert fresh.current().learner_workers == 2
+    assert fresh.load() is None          # nothing newer now
+    assert PlacementStore(8).load() is None   # no path configured
+
+
+# -- signals -----------------------------------------------------------------
+
+def _fake_tree(sched=None, elastic=None):
+    tree = MetricsTree()
+    if sched is not None:
+        tree.register("scheduler", sched)     # dict: captured by ref
+    if elastic is not None:
+        tree.register("elastic", elastic)
+    return tree
+
+
+def test_signals_frame_from_tree_with_windowed_shed_rates():
+    clock = FakeClock()
+    sched = {
+        "tenants.inter.slo": "interactive",
+        "tenants.inter.latency_p99_ms": 12.5,
+        "tenants.inter.queue_depth": 3,
+        "tenants.inter.shed": 0,
+        "tenants.inter.model_staleness_seconds": float("nan"),
+        "tenants.inter.model_generation": 4,
+        "tenants.bulk.slo": "bulk",
+        "tenants.bulk.latency_p99_ms": 80.0,
+        "tenants.bulk.shed": 10,
+        "tenants.bulk.model_staleness_seconds": 7.5,
+        "queue_depth_interactive": 3,
+        "queue_depth_standard": 0,
+        "queue_depth_bulk": 9,
+        "shed_interactive": 0,
+        "shed_standard": 0,
+        "shed_bulk": 10,
+        "chip_idle_fraction": 0.25,
+    }
+    source = SignalSource(_fake_tree(sched, {"fleet_size": 3,
+                                             "membership_epoch": 7}),
+                          clock=clock)
+    f1 = source.sample()
+    assert f1.interactive_p99_ms == 12.5     # bulk's 80ms is NOT the slo p99
+    assert f1.queue_depth["bulk"] == 9
+    assert f1.chip_idle_fraction == 0.25
+    assert f1.fleet_size == 3 and f1.membership_epoch == 7
+    assert f1.staleness_s == 7.5
+    assert f1.max_generation == 4
+    assert f1.tenants["inter"].slo == "interactive"
+    # first sample has no window: rates are 0, never garbage
+    assert f1.shed_rate["bulk"] == 0.0
+    # 20 more bulk sheds over 10 fake seconds -> 2/s, windowed
+    sched["shed_bulk"] = 30
+    sched["tenants.bulk.shed"] = 30
+    clock.advance(10.0)
+    f2 = source.sample()
+    assert f2.at == 10.0
+    assert f2.shed_rate["bulk"] == pytest.approx(2.0)
+    assert f2.tenants["bulk"].shed_rate_per_s == pytest.approx(2.0)
+    assert f2.shed_rate["interactive"] == 0.0
+
+
+def test_signals_missing_surfaces_degrade_to_neutral():
+    source = SignalSource(_fake_tree(), clock=FakeClock())
+    frame = source.sample()
+    assert frame.tenants == {}
+    assert math.isnan(frame.interactive_p99_ms)
+    assert math.isnan(frame.chip_idle_fraction)
+    assert math.isnan(frame.staleness_s)
+    assert frame.fleet_size == 0
+    assert all(frame.queue_depth[slo] == 0.0 for slo in SLO_CLASSES)
+
+
+# -- hysteresis unit matrix --------------------------------------------------
+
+def test_deadband_holds_under_oscillating_p99():
+    """p99 bouncing anywhere inside (low, high) watermarks — noisy
+    quantiles, GC hiccups — produces ZERO actuations."""
+    clock = FakeClock()
+    policy = AutoscalePolicy(_config(high_frac=0.9, low_frac=0.5),
+                             clock=clock)
+    rng = np.random.default_rng(0)
+    for i in range(200):
+        # oscillate across (25, 45) ms, strictly inside the deadband
+        p99 = 25.1 + 19.8 * rng.random()
+        d = policy.decide(_frame(p99=p99, idle=0.9, at=float(i)),
+                          learner_workers=2)
+        assert d.kind == DECISION_HOLD, (i, p99, d.reason)
+    assert policy.actuations == 0
+    assert policy.holds == 200
+
+
+def test_min_dwell_bounds_decisions_per_minute():
+    """Signals demand movement EVERY second; min_dwell_s=10 caps
+    actuations at ceil(60/10 + 1) over a minute — the decisions/minute
+    bound is structural, not probabilistic."""
+    policy = AutoscalePolicy(_config(min_dwell_s=10.0, total_chips=64),
+                             clock=FakeClock())
+    actuated = []
+    workers = 32
+    for second in range(60):
+        d = policy.decide(_frame(p99=200.0, at=float(second)),
+                          learner_workers=workers)
+        if d.actuates:
+            workers = d.learner_workers
+            actuated.append(second)
+    assert len(actuated) <= 7            # 60s / 10s dwell (+ the t=0 one)
+    assert actuated[:2] == [0, 10]       # dwell gates exactly
+    for a, b in zip(actuated, actuated[1:]):
+        assert b - a >= 10
+
+
+def test_publish_storm_of_30_generations_causes_zero_placement_churn():
+    """30 back-to-back model generations land between controller ticks
+    while every pressure signal sits in the deadband: the placement map
+    must not move — decisions are a function of load, never of publish
+    counters (the policy cannot even see the generation except as a
+    trace-correlation field)."""
+    clock = FakeClock()
+    sched = {
+        "tenants.svc.slo": "interactive",
+        "tenants.svc.latency_p99_ms": 30.0,      # mid-deadband
+        "tenants.svc.model_generation": 0,
+        "queue_depth_interactive": 0,
+        "chip_idle_fraction": 0.2,               # below idle_high too
+    }
+    store = PlacementStore(8)
+    store.publish({"svc": [0, 1, 2, 3]}, 4)
+    base_generation = store.generation
+    controller = AutoscaleController.build(
+        _fake_tree(sched), store=store, policy_config=_config(),
+        clock=clock)
+    for generation in range(1, 31):
+        sched["tenants.svc.model_generation"] = generation
+        clock.advance(1.0)
+        d = controller.tick()
+        assert d.kind == DECISION_HOLD
+    assert store.generation == base_generation   # ZERO churn
+    assert controller.actuations == 0
+    assert controller.policy.actuations == 0
+
+
+def test_policy_respects_floors_and_ceilings():
+    policy = AutoscalePolicy(
+        _config(min_learner_workers=1, min_serving_chips=4,
+                total_chips=8), clock=FakeClock())
+    # pressure, but the learner is at its floor: hold, say why
+    d = policy.decide(_frame(p99=200.0, at=0.0), learner_workers=1)
+    assert d.kind == DECISION_HOLD and "floor" in d.reason
+    # trough, but the learner is at its ceiling (serving floor): hold
+    d = policy.decide(_frame(p99=1.0, idle=0.95, at=100.0),
+                      learner_workers=4)
+    assert d.kind == DECISION_HOLD and "ceiling" in d.reason
+    # NaN everything: cold control plane holds
+    d = policy.decide(_frame(at=200.0), learner_workers=2)
+    assert d.kind == DECISION_HOLD
+    assert policy.actuations == 0
+
+
+def test_policy_config_validation():
+    with pytest.raises(ValueError, match="deadband"):
+        _config(high_frac=0.4, low_frac=0.5)
+    with pytest.raises(ValueError, match="p99_target_ms"):
+        _config(p99_target_ms=0.0)
+    with pytest.raises(ValueError, match="overcommit"):
+        _config(total_chips=4, min_serving_chips=3,
+                min_learner_workers=2)
+
+
+# -- clock injection ---------------------------------------------------------
+
+def test_controller_clock_injectable_end_to_end():
+    """The PR 5 CheckpointManager pattern, regression-tested: ONE fake
+    clock drives sampler stamps, policy dwell, and the decision-latency
+    gauge — wall time never leaks in.  Dwell expiry is visible purely
+    by advancing the fake clock."""
+    clock = FakeClock()
+    sched = {"tenants.a.slo": "interactive",
+             "tenants.a.latency_p99_ms": 500.0,
+             "queue_depth_interactive": 0,
+             "chip_idle_fraction": 0.0}
+    store = PlacementStore(8)
+    store.publish({"a": [0, 1, 2, 3]}, 4)
+    controller = AutoscaleController.build(
+        _fake_tree(sched), store=store,
+        policy_config=_config(min_dwell_s=10.0), clock=clock)
+    d1 = controller.tick()
+    assert d1.kind == DECISION_SCALE_SERVING
+    assert d1.at == 0.0                       # frame stamped by the fake
+    # latency measured on the SAME clock: no advance -> exactly zero
+    # (a wall-clock leak would read > 0 here)
+    assert controller.last_decision_latency_s == 0.0
+    clock.advance(5.0)
+    assert controller.tick().kind == DECISION_HOLD       # inside dwell
+    assert "min-dwell" in controller.policy.last_reason
+    clock.advance(6.0)                                   # t=11 > dwell
+    assert controller.tick().kind == DECISION_SCALE_SERVING
+    assert store.current().learner_workers == 2
+
+
+# -- controller actuation ----------------------------------------------------
+
+def test_controller_actuates_scheduler_and_elastic(stub_busy):
+    """An actuating decision publishes the next placement generation and
+    moves BOTH actuators: scheduler WFQ weights rescale to chip counts
+    (placement_generation gauge tracks), and the elastic coordinator
+    applies the resize at its next chunk boundary through the same
+    register/preempt path as injected churn.  Every decision is a
+    tracer instant."""
+    from flink_ml_tpu.obs import trace as trace_mod
+
+    clock = FakeClock()
+    scheduler = _stub_scheduler()
+    feats = _feats()
+    scheduler.add_tenant("inter", object(), feats.take(2),
+                         slo=SLO_INTERACTIVE, weight=2.0)
+    scheduler.add_tenant("bulk", object(), feats.take(2), slo=SLO_BULK)
+    coord = ElasticCoordinator(chips_per_worker=1, initial_workers=4,
+                               min_workers=1, clock=clock)
+    sched_signals = {"tenants.inter.slo": "interactive",
+                     "tenants.inter.latency_p99_ms": 500.0,
+                     "chip_idle_fraction": 0.0}
+    store = PlacementStore(8)
+    store.publish({"inter": [0, 1, 2, 3], "bulk": [0, 1, 2, 3]}, 4)
+    controller = AutoscaleController.build(
+        _fake_tree(sched_signals), store=store, scheduler=scheduler,
+        elastic=coord, policy_config=_config(), clock=clock)
+    trace_mod.tracer.enable()
+    try:
+        d = controller.tick()
+    finally:
+        instants = [s for s in trace_mod.tracer.spans()
+                    if s.name == "autoscale_decision"]
+        trace_mod.tracer.disable()
+        trace_mod.tracer.clear()
+    assert d.kind == DECISION_SCALE_SERVING
+    assert store.generation == 2
+    pmap = store.current()
+    assert pmap.learner_workers == 3
+    assert pmap.serving_chips() == (0, 1, 2, 3, 4)
+    # scheduler actuation: weight = base * chips, generation gauge set
+    assert scheduler.tenant("inter").weight == 2.0 * 5
+    assert scheduler.tenant("bulk").weight == 1.0 * 5
+    snap = scheduler.snapshot()
+    assert snap["placement_generation"] == 2
+    # elastic actuation: applied at the NEXT boundary, same seam
+    assert coord.fleet_size == 4
+    coord.poll()
+    assert coord.fleet_size == 3
+    assert coord.counters["preemptions"] == 1
+    assert coord.counters["controller_requests"] == 1
+    # the decision is visible as a tracer instant with its reason
+    assert len(instants) == 1
+    assert instants[0].ids["x_kind"] == DECISION_SCALE_SERVING
+    assert "p99" in instants[0].ids["x_reason"]
+
+
+def test_controller_conflict_skips_actuation():
+    """A racing placement writer between sample and publish: the tick
+    counts a conflict and does NOT actuate a stale edit."""
+    clock = FakeClock()
+    sched = {"tenants.a.slo": "interactive",
+             "tenants.a.latency_p99_ms": 500.0}
+    store = PlacementStore(8)
+    store.publish({"a": [0, 1, 2, 3]}, 4)
+
+    class RacingPolicy(AutoscalePolicy):
+        # the race lands AFTER the tick captured its base generation
+        # (sample + capture are done by the time decide runs)
+        def decide(self, frame, *, learner_workers):
+            store.publish({"a": [0, 1, 2, 3]}, 4)
+            return super().decide(frame, learner_workers=learner_workers)
+
+    controller = AutoscaleController(
+        store=store, policy=RacingPolicy(_config(), clock=clock),
+        signals=SignalSource(_fake_tree(sched), clock=clock),
+        clock=clock)
+    generation = store.generation
+    controller.tick()
+    assert controller.conflicts == 1
+    assert controller.actuations == 0
+    assert store.generation == generation + 1   # only the racer's write
+
+
+# -- obs round-trip ----------------------------------------------------------
+
+def test_scheduler_class_depth_and_idle_gauges_round_trip(stub_busy):
+    """The ISSUE 17 obs satellite: per-SLO-class queue depth gauges and
+    chip_idle_fraction survive snapshot -> prometheus round-trip; idle
+    is NaN (absent in prometheus) before the first window, then a real
+    windowed fraction on the injected busy clock."""
+    clock = FakeClock()
+    _StubServable.busy_clock = clock
+    _StubServable.busy_s_per_row = 0.1
+    scheduler = _stub_scheduler(max_batch_rows=8, max_wait_ms=0.0,
+                                busy_clock=clock)
+    feats = _feats()
+    scheduler.add_tenant("inter", object(), feats.take(2),
+                         slo=SLO_INTERACTIVE)
+    scheduler.add_tenant("bulk", object(), feats.take(2), slo=SLO_BULK)
+    snap = scheduler.snapshot()
+    assert math.isnan(snap["chip_idle_fraction"])   # no window yet
+    text = prometheus_text({"scheduler": snap})
+    assert "chip_idle_fraction" not in text          # NaN = absent
+    assert "queue_depth_interactive 0" in text
+    # queue 3 interactive + 1 bulk requests, sample while queued
+    for _ in range(3):
+        scheduler.submit("inter", feats.take(4))
+    scheduler.submit("bulk", feats.take(4))
+    snap = scheduler.snapshot()
+    assert snap["queue_depth_interactive"] == 3
+    assert snap["queue_depth_bulk"] == 1
+    assert snap["tenants.inter.slo"] == "interactive"
+    # serve 16 rows (1.6 busy s) inside a 10 s window -> idle 0.84
+    _drain(scheduler)
+    clock.advance(10.0 - 1.6)
+    snap = scheduler.snapshot()
+    assert snap["chip_idle_fraction"] == pytest.approx(0.84)
+    assert snap["queue_depth_interactive"] == 0
+    text = prometheus_text({"scheduler": snap})
+    assert "flink_ml_tpu_scheduler_chip_idle_fraction 0.84" in text
+    assert "flink_ml_tpu_scheduler_queue_depth_bulk 0" in text
+    # the signal layer reads the same names back out
+    frame = SignalSource(_fake_tree(scheduler.snapshot()),
+                         clock=FakeClock()).sample()
+    assert frame.chip_idle_fraction == pytest.approx(0.84)
+
+
+# -- the acceptance replay ---------------------------------------------------
+
+def test_compressed_diurnal_replay_holds_p99_and_bounds_staleness(
+        stub_busy):
+    """The ISSUE 17 acceptance scenario at CPU smoke scale: a compressed
+    24h diurnal day against a REAL SharedScheduler + ElasticCoordinator
+    + PlacementStore wired through one controller on one fake clock.
+    Peak traffic preempts the learner down to serving's benefit;
+    the trough hands chips back.  Asserts: interactive p99 holds inside
+    the PR 14 envelope with ZERO interactive sheds, the learner's
+    staleness stays bounded (it keeps capacity often enough to publish),
+    the coordinator's fleet converges to every published placement, and
+    EVERY tick is a tracer instant."""
+    from flink_ml_tpu.obs import trace as trace_mod
+
+    clock = FakeClock()
+    dt = 900.0                       # one tick per compressed 15 min
+    _StubServable.busy_clock = clock
+    _StubServable.busy_s_per_row = 0.9
+    scheduler = _stub_scheduler(max_batch_rows=64, max_wait_ms=0.0,
+                                busy_clock=clock)
+    feats = _feats(64)
+    scheduler.add_tenant("inter", object(), feats.take(2),
+                         slo=SLO_INTERACTIVE)
+    scheduler.add_tenant("bulk", object(), feats.take(2), slo=SLO_BULK)
+    coord = ElasticCoordinator(chips_per_worker=1, initial_workers=4,
+                               min_workers=1, clock=clock)
+    store = PlacementStore(8, chips_per_worker=1)
+    store.publish({"inter": [0, 1, 2, 3], "bulk": [0, 1, 2, 3]}, 4)
+    tree = default_tree(scheduler=scheduler, elastic=coord)
+    controller = AutoscaleController.build(
+        tree, store=store, scheduler=scheduler, elastic=coord,
+        clock=clock,
+        policy_config=_config(
+            p99_target_ms=250.0,     # the PR 14 interactive envelope
+            queue_high=24, idle_high=0.6, min_dwell_s=1800.0,
+            min_serving_chips=4, min_learner_workers=1))
+
+    learner_last_publish = 0.0
+    max_staleness = 0.0
+    kinds = set()
+    trace_mod.tracer.enable(capacity=4096)
+    try:
+        for tick in range(96):               # 24h x 4 ticks/hour
+            hour = (tick * dt / 3600.0) % 24.0
+            # diurnal interactive load: heavy 9h-21h, near-zero at night
+            peak = hour >= 9.0 and hour < 21.0
+            n_requests = 30 if peak else 1
+            for i in range(n_requests):
+                scheduler.submit("inter", feats.take(8))
+            if not peak:
+                scheduler.submit("bulk", feats.take(16))
+            decision = controller.tick()     # samples the queued state
+            kinds.add(decision.kind)
+            _drain(scheduler)
+            # chunk boundaries: pending resizes apply through the seam
+            coord.poll()
+            assert coord.fleet_size == store.current().learner_workers
+            # the learner "publishes" whenever it holds capacity
+            if coord.fleet_size >= 1:
+                learner_last_publish = clock.t
+            max_staleness = max(max_staleness,
+                                clock.t - learner_last_publish)
+            clock.advance(dt)
+    finally:
+        instants = [s for s in trace_mod.tracer.spans()
+                    if s.name == "autoscale_decision"]
+        trace_mod.tracer.disable()
+        trace_mod.tracer.clear()
+
+    # every decision visible as a tracer instant, reasons included
+    assert len(instants) == 96
+    assert all(s.ids["x_reason"] for s in instants)
+    # the controller MOVED the fleet both ways across the day
+    assert DECISION_SCALE_SERVING in kinds
+    assert DECISION_YIELD_TO_TRAINING in kinds
+    assert controller.actuations >= 2
+    # interactive p99 held the envelope: zero interactive sheds, real
+    # latency (inline drain) far inside 250ms
+    assert scheduler.shed_counts()[SLO_INTERACTIVE] == 0
+    p99 = scheduler.snapshot()["tenants.inter.latency_p99_ms"]
+    assert p99 < 250.0
+    # learner staleness bounded: never starved longer than 2 ticks
+    assert max_staleness <= 2 * dt
+    # placement generations advanced monotonically and durably
+    assert store.generation >= 1 + controller.actuations

@@ -352,3 +352,49 @@ def test_membership_flat_compressed_config_rejected(tmp_path):
             logistic_loss, lambda: iter([]), num_features=4, config=cfg,
             mesh=c.mesh(), membership=c,
             checkpoint=CheckpointConfig(str(tmp_path / "ck")))
+
+
+def test_request_resize_applies_at_boundary_through_the_churn_path():
+    """ISSUE 17: a controller resize request is deferred to its pinned
+    chunk boundary (the FaultPlan index space: poll invocations), then
+    applied through the SAME register/preempt transitions injected
+    churn uses — the audit log shows plain preempt/join kinds, and the
+    clamp + last-writer-wins semantics hold."""
+    c = ElasticCoordinator(chips_per_worker=1, initial_workers=3,
+                           min_workers=1, max_workers=5)
+    # clamp: target outside [min, max] lands on the bound
+    assert c.request_resize(99) == 5
+    # last-writer-wins: the newest intent replaces the pending one
+    assert c.request_resize(1, at_boundary=2) == 1
+    assert c.counters["controller_requests"] == 2
+    assert c.snapshot()["pending_resize_target"] == 1
+    assert not c.poll() and c.fleet_size == 3      # boundary 0: pending
+    assert not c.poll() and c.fleet_size == 3      # boundary 1: pending
+    assert c.poll() and c.fleet_size == 1          # boundary 2: applied
+    # the same path as injected churn: ordinary preempt transitions
+    assert [t[0] for t in c.transitions] == ["preempt", "preempt"]
+    assert c.counters["preemptions"] == 2
+    assert c.snapshot()["pending_resize_target"] == -1
+    # mesh absorbs the new fleet; the NEXT request grows through joins
+    c.mesh()
+    assert not c.poll()
+    c.request_resize(2)
+    assert c.poll() and c.fleet_size == 2          # next boundary, join
+    assert c.transitions[-1][0] == "join"
+    assert c.snapshot()["boundary_polls"] == 5
+
+
+def test_request_resize_composes_with_injected_churn():
+    """A seeded fault and a pending controller request landing on the
+    SAME boundary compose: the injected transition fires first (the
+    seam), then the request converges the fleet to its target — one
+    boundary, one consistent final extent."""
+    c = ElasticCoordinator(chips_per_worker=1, initial_workers=2,
+                           min_workers=1, max_workers=4)
+    plan = FaultPlan().inject(c.SCOPE, at=0, kind="join")
+    c.request_resize(4)
+    with plan:
+        assert c.poll()
+    # join fired (2 -> 3), then the request topped up to 4
+    assert c.fleet_size == 4
+    assert [t[0] for t in c.transitions] == ["join", "join"]

@@ -1092,6 +1092,77 @@ def test_elastic_resize_at_boundary_bitexact_vs_fixed_fleet(tmp_path):
     assert not np.array_equal(state_e.coefficients, state_a.coefficients)
 
 
+def test_controller_preemption_at_boundary_bitexact_on_shrunken_fleet(
+        tmp_path):
+    """ISSUE 17: a CONTROLLER-initiated preemption rides the exact same
+    chunk-boundary seam as injected churn — ``request_resize(1,
+    at_boundary=2)`` shrinks the fleet 2 -> 1 at the same boundary a
+    seeded ``"preempt"`` fault would, the transition lands in the audit
+    log as a plain ``preempt``, and the shrunken run restores BIT-EXACT
+    (params + loss log) vs a fixed fleet of the new size restoring the
+    same step-6 cut.  This is what makes autoscale preemption lossless
+    by construction: the PR 15 chaos matrix covers it for free."""
+    from flink_ml_tpu.data.datacache import DataCacheReader
+    from flink_ml_tpu.iteration.checkpoint import CheckpointManager
+    from flink_ml_tpu.models.common.losses import logistic_loss
+    from flink_ml_tpu.models.common.sgd import SGDConfig, sgd_fit_outofcore
+
+    cache = _elastic_cache(tmp_path, "c_ctrl")
+    cfg = SGDConfig(learning_rate=0.4, max_epochs=3, tol=0.0,
+                    grad_reduce=_elastic_gr())
+    kw = dict(num_features=8, config=cfg, cache_decoded=False,
+              steps_per_dispatch=2, checkpoint_every_steps=2)
+
+    def reader():
+        return DataCacheReader(cache, batch_rows=240)
+
+    # controller-driven run: the resize request is pinned to chunk
+    # boundary 2 (global step 6) — the FaultPlan index space — with NO
+    # FaultPlan active at all
+    coord = _elastic_coord(2)
+    coord.request_resize(1, at_boundary=2, reason="p99 over target")
+    report = RecoveryReport()
+    state_e, log_e = resilient_fit(
+        sgd_fit_outofcore, logistic_loss, reader,
+        checkpoint=CheckpointConfig(str(tmp_path / "ck_ce"),
+                                    max_to_keep=99),
+        elastic=coord,
+        backoff=RetryPolicy(base_delay=0.0, sleep=lambda s: None),
+        report=report, **kw)
+
+    assert report.resizes == 1 and report.restarts == 0
+    assert report.events[0].kind == "resize"
+    assert report.events[0].fleet_size == 1
+    assert report.events[0].restored_step == 6
+    assert coord.fleet_size == 1
+    # the SAME seam as injected churn: an ordinary preempt transition
+    # in the audit log, counted like any chaos-schedule preemption
+    assert [t[0] for t in coord.transitions] == ["preempt"]
+    assert coord.counters["preemptions"] == 1
+    assert coord.counters["controller_requests"] == 1
+
+    # fixed fleet 2 with cuts kept: donor of the step-6 cut
+    c2 = _elastic_coord(2)
+    sgd_fit_outofcore(
+        logistic_loss, reader, mesh=c2.mesh(), membership=c2,
+        checkpoint=CheckpointConfig(str(tmp_path / "ck_ca"),
+                                    max_to_keep=99), **kw)
+
+    # fixed fleet of the SHRUNKEN size restoring the same cut
+    _copy_cut(str(tmp_path / "ck_ca"), str(tmp_path / "ck_cb"), 6)
+    c1 = _elastic_coord(1)
+    state_b, log_b = sgd_fit_outofcore(
+        logistic_loss, reader, mesh=c1.mesh(), membership=c1,
+        checkpoint=CheckpointManager(CheckpointConfig(
+            str(tmp_path / "ck_cb"), max_to_keep=99)),
+        resume=True, **kw)
+
+    np.testing.assert_array_equal(state_e.coefficients,
+                                  state_b.coefficients)
+    assert state_e.intercept == state_b.intercept
+    np.testing.assert_array_equal(log_e, log_b)
+
+
 def test_elastic_kill_and_rejoin_matches_fixed_fleet_trajectory(tmp_path):
     """Chaos churn: a worker is killed at one boundary and a fresh one
     joins a few chunks later.  The churned run's final loss must stay

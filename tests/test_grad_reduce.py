@@ -15,6 +15,21 @@ from flink_ml_tpu.parallel.grad_reduce import GradReduceConfig
 from flink_ml_tpu.parallel.mesh import device_mesh
 
 
+def _abstract(tree):
+    """Hashable (structure, shapes, dtypes) signature of a pytree — what
+    the compiled program actually depends on, given fixed config/mesh."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return treedef, tuple(
+        (np.shape(l), np.result_type(l).name) for l in leaves)
+
+
+# GradReduceConfig is a frozen dataclass and the compiled reducer is a
+# pure function of (config, mesh, arg structure/shapes), so identical
+# keys reuse one executable instead of re-tracing a fresh closure per
+# call — results are bit-identical either way.
+_JIT_CACHE = {}
+
+
 def _run_reduce(grads_stack, config, axis_sizes, state=None):
     """Apply reduce_gradients once over a mesh of ``axis_sizes``;
     ``grads_stack`` leaves carry a leading participant dim covering every
@@ -26,15 +41,21 @@ def _run_reduce(grads_stack, config, axis_sizes, state=None):
         state = GR.init_state(config, grads_like, n_dev)
     dev_spec = P(tuple(axis_sizes.keys()))
 
-    def body(g, st):
-        g_l = jax.tree_util.tree_map(lambda a: a[0], g)
-        red, new_st = GR.reduce_gradients(g_l, GR.squeeze_state(st), config)
-        return (jax.tree_util.tree_map(lambda a: a[None], red),
-                GR.unsqueeze_state(new_st))
+    key = (config, tuple(sorted(axis_sizes.items())),
+           _abstract(grads_stack), _abstract(state))
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        def body(g, st):
+            g_l = jax.tree_util.tree_map(lambda a: a[0], g)
+            red, new_st = GR.reduce_gradients(
+                g_l, GR.squeeze_state(st), config)
+            return (jax.tree_util.tree_map(lambda a: a[None], red),
+                    GR.unsqueeze_state(new_st))
 
-    fn = shard_map_fn(body, mesh, in_specs=(dev_spec, dev_spec),
-                      out_specs=(dev_spec, dev_spec))
-    red, new_state = jax.jit(fn)(grads_stack, state)
+        fn = jax.jit(shard_map_fn(body, mesh, in_specs=(dev_spec, dev_spec),
+                                  out_specs=(dev_spec, dev_spec)))
+        _JIT_CACHE[key] = fn
+    red, new_state = fn(grads_stack, state)
     red = jax.tree_util.tree_map(np.asarray, red)
     # the reduced gradient must come back replicated: every participant
     # holds the identical sum

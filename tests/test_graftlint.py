@@ -1219,3 +1219,40 @@ def test_elastic_module_visited_by_lock_and_host_sync_passes():
     mod = project.module(os.path.join(REPO, rel))
     assert LockDisciplinePass().check_module(mod, project) == []
     assert HostSyncPass().check_module(mod, project) == []
+
+
+def test_autoscale_modules_visited_by_host_sync_and_atomic_writes():
+    """ISSUE 17: ``flink_ml_tpu/autoscale/`` joined both scanned
+    surfaces.  Assert host-sync's SCAN_ROOTS and atomic-writes'
+    DURABLE_MODULES carry the root, that the walks genuinely VISIT all
+    four control-plane modules (a root that matches nothing keeps a
+    rule from ever firing — the visits-the-modules stance), and that
+    every module is clean under host-sync, atomic-writes (the
+    placement publish is tmp -> os.replace), and lock-discipline (the
+    store writes its file OUTSIDE the lock with a generation re-check
+    on re-acquire)."""
+    from scripts.graftlint.passes.atomic_writes import DURABLE_MODULES
+    from scripts.graftlint.passes.host_sync import SCAN_ROOTS
+
+    assert "flink_ml_tpu/autoscale" in SCAN_ROOTS
+    assert "flink_ml_tpu/autoscale" in DURABLE_MODULES
+    modules = [os.path.join("flink_ml_tpu", "autoscale", f)
+               for f in ("placement.py", "signals.py", "policy.py",
+                         "controller.py")]
+    project = Project(repo=REPO)
+    sync_visited = {
+        os.path.relpath(m.path, REPO)
+        for m in project.iter_modules(
+            [os.path.join(REPO, r) for r in SCAN_ROOTS])}
+    durable_visited = {
+        os.path.relpath(m.path, REPO)
+        for m in project.iter_modules(
+            [os.path.join(REPO, r) for r in AtomicWritesPass.roots])}
+    for rel in modules:
+        assert rel in sync_visited, f"host-sync never visits {rel}"
+        assert rel in durable_visited, \
+            f"atomic-writes never visits {rel}"
+        mod = project.module(os.path.join(REPO, rel))
+        assert HostSyncPass().check_module(mod, project) == []
+        assert AtomicWritesPass().check_module(mod, project) == []
+        assert LockDisciplinePass().check_module(mod, project) == []

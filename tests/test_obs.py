@@ -140,6 +140,40 @@ def test_metrics_tree_merges_every_surface():
     json.dumps(snap)        # JSON-clean end to end (numpy normalized)
 
 
+def test_default_tree_registers_autoscale_provider():
+    """ISSUE 17: the autoscale controller's self-view hangs off the same
+    tree it reads — counters, the live placement, and the decision
+    latency (NaN before the first tick: absent in prometheus, the
+    never-faked stance) round-trip snapshot -> exposition."""
+    from flink_ml_tpu.autoscale import (AutoscaleController,
+                                        AutoscalePolicy, PlacementStore,
+                                        PolicyConfig, SignalSource)
+
+    store = PlacementStore(4)
+    store.publish({"svc": [0, 1]}, 2)
+    inner = MetricsTree()
+    controller = AutoscaleController(
+        store=store,
+        policy=AutoscalePolicy(PolicyConfig(p99_target_ms=50.0,
+                                            total_chips=4)),
+        signals=SignalSource(inner))
+    tree = default_tree(autoscale=controller)
+    snap = tree.snapshot()
+    assert snap["autoscale"]["ticks"] == 0
+    assert snap["autoscale"]["placement_generation"] == 1
+    assert snap["autoscale"]["placement_learner_workers"] == 2
+    assert math.isnan(snap["autoscale"]["decision_latency_s"])
+    text = prometheus_text(snap)
+    assert "flink_ml_tpu_autoscale_placement_generation 1" in text
+    assert "decision_latency_s" not in text      # NaN = absent
+    json.dumps(snap)
+    controller.tick()
+    snap = tree.snapshot()
+    assert snap["autoscale"]["ticks"] == 1
+    assert snap["autoscale"]["decision_latency_s"] >= 0.0
+    assert "decision_latency_s" in prometheus_text(snap)
+
+
 def test_metrics_tree_provider_kinds_and_none():
     tree = MetricsTree()
     tree.register("fn", lambda: {"a": 1})
