@@ -12,6 +12,9 @@ is the kernel's own business, an op's planning policy the model's):
 - ``models/clustering/kmeans.py`` — ``kmeans_assign`` (stage),
   ``kmeans_update_stats``, ``kmeans_workset_update``
 - ``models/recommendation/widedeep.py`` — ``widedeep_scores`` (stage)
+- ``ops/int8_serving.py``      — "int8" backends of ``linear_margins``,
+  ``kmeans_assign``, ``widedeep_scores`` (forced-lookup only; the
+  servable bind path quantizes the params they consume)
 
 This module is imported lazily by ``registry._ensure_catalog`` (first
 lookup), never at ``flink_ml_tpu.kernels`` import — that keeps the

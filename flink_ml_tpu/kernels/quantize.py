@@ -1,0 +1,205 @@
+"""Per-channel max-abs int8 calibration for the serving path (ISSUE 18).
+
+The serving-side counterpart of the wire-side quantizers (``parallel/
+collectives.py`` ``quantized_all_reduce`` / ``fixed_point_all_reduce``):
+the same ``scale = max|w| / 127`` contract, applied to *published model
+params* instead of gradient blocks.  Calibration is data-free — scales
+derive from the params alone, so they are captured wherever the params
+are bound to a servable (``_KernelServable._build_kernel`` /
+``CachedWideDeepServable._bind``).  Because ``rebind()`` re-runs those
+bind paths on every delta publish, each generation re-derives its scales
+from its own params — stale scales never serve (ARCHITECTURE.md "Int8
+serving").
+
+What never quantizes: biases and intercepts (``b``, ``wide_b``,
+``mlp[i]["b"]``), the categorical id ``offsets`` (exact int adds), and
+activations — int8 here is WEIGHT-ONLY storage compression.  The
+compute contract is "dequantize then run the f32 expression": codes are
+deterministic round-to-nearest at calibration time, dequantization is
+one exact ``int8 -> f32`` cast and one f32 multiply, so a generation's
+scores are bit-stable call-to-call (the hot-swap atomicity tests rely
+on this) while agreeing with f32 only to the accuracy envelope the
+parity matrix gates (rank/decision agreement, not bitwise).
+
+Quantization (host, numpy — publish time, off the serving path) and
+dequantization (jnp — traced into the serving kernels) are split so the
+dequant helpers can ride inside jitted programs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Q_MAX", "maxabs_scales", "quantize_channelwise", "dequantize",
+    "quantize_rows", "dequantize_rows", "quantize_stage_params",
+    "quantize_widedeep_rest", "dequantize_widedeep_rest",
+    "quantized_ops",
+]
+
+#: symmetric int8 code range — ±127 (−128 unused, matching the wire
+#: quantizers: a symmetric grid keeps dequantization a single multiply)
+Q_MAX = 127.0
+
+
+def _expand(scales: np.ndarray, ndim: int, axis: int):
+    shape = [1] * ndim
+    shape[axis] = -1
+    return scales.reshape(shape)
+
+
+def maxabs_scales(w: np.ndarray, channel_axis: Optional[int] = None
+                  ) -> np.ndarray:
+    """Per-channel (or per-tensor when ``channel_axis is None``) max-abs
+    scales.  All-zero channels get scale 1.0 — their codes are all zero
+    either way, and a zero scale would NaN the dequantized weights."""
+    w = np.asarray(w, np.float32)
+    if channel_axis is None:
+        m = float(np.max(np.abs(w))) if w.size else 0.0
+        return np.float32(m / Q_MAX if m > 0.0 else 1.0)
+    axis = channel_axis % w.ndim
+    reduce_axes = tuple(a for a in range(w.ndim) if a != axis)
+    m = np.max(np.abs(w), axis=reduce_axes) if w.size \
+        else np.zeros((w.shape[axis],), np.float32)
+    scales = (m / Q_MAX).astype(np.float32)
+    scales[scales == 0.0] = np.float32(1.0)
+    return scales
+
+
+def quantize_channelwise(w: np.ndarray,
+                         channel_axis: Optional[int] = None
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """``w -> (codes int8, scales f32)`` with deterministic
+    round-to-nearest-even (``np.rint``).  Stochastic rounding is the
+    right call on the gradient wire (unbiased accumulation); for
+    serving, determinism IS the contract — same params, same codes."""
+    w = np.asarray(w, np.float32)
+    scales = maxabs_scales(w, channel_axis)
+    denom = scales if channel_axis is None \
+        else _expand(scales, w.ndim, channel_axis % w.ndim)
+    codes = np.clip(np.rint(w / denom), -Q_MAX, Q_MAX).astype(np.int8)
+    return codes, scales
+
+
+def dequantize(codes, scales, channel_axis: Optional[int] = None):
+    """jnp dequantize — traced into serving kernels.  Exact cast + one
+    f32 multiply; broadcast the per-channel scales along
+    ``channel_axis``."""
+    c = jnp.asarray(codes).astype(jnp.float32)
+    if channel_axis is None:
+        return c * scales
+    axis = channel_axis % c.ndim
+    shape = [1] * c.ndim
+    shape[axis] = c.shape[axis]
+    return c * jnp.reshape(jnp.asarray(scales), shape)
+
+
+def quantize_rows(table: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-ROW calibration for gather-served tables (embeddings,
+    centroids): one scale per leading-axis row, so a gathered row
+    dequantizes from its own codes + its own scale — the layout the
+    ``EmbeddingRowCache`` int8 pools store block-wise."""
+    return quantize_channelwise(table, channel_axis=0)
+
+
+def dequantize_rows(row_codes, row_scales):
+    """Dequantize already-GATHERED rows: ``row_codes (..., row_dim)``
+    with one scale per row (``row_scales (...,)``).  This is the
+    gather-then-dequantize order — the full f32 table never
+    materializes, on the cache hit path or off it."""
+    return (jnp.asarray(row_codes).astype(jnp.float32)
+            * jnp.asarray(row_scales)[..., None])
+
+
+# ---------------------------------------------------------------------------
+# per-op calibration recipes
+# ---------------------------------------------------------------------------
+
+def _q_tensor(w, channel_axis=None) -> Dict[str, np.ndarray]:
+    codes, scales = quantize_channelwise(w, channel_axis)
+    return {"q": codes, "s": scales}
+
+
+def _q_linear(params: Dict[str, Any]) -> Dict[str, Any]:
+    # vector w: one per-tensor scale (the single output channel);
+    # multiclass (d, k): per-output-class scales on axis 1
+    w = np.asarray(params["w"], np.float32)
+    axis = None if w.ndim == 1 else 1
+    return {"w": _q_tensor(w, axis),
+            "b": np.asarray(params["b"], np.float32)}
+
+
+def _q_kmeans(params: Dict[str, Any]) -> Dict[str, Any]:
+    # centroids (k, d): per-centroid-row scales, so each centroid's
+    # distance error is bounded by its own magnitude
+    return {"centroids": _q_tensor(params["centroids"], 0)}
+
+
+def quantize_widedeep_rest(net: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize the NON-TABLE WideDeep leaves (``wide_dense`` /
+    ``mlp`` matrices; ``wide_b`` and biases pass through) — shared by
+    the full ``widedeep_scores`` recipe and the embedding-row cache's
+    int8 servable, whose tables live in the cache pools instead."""
+    return {
+        "wide_dense": _q_tensor(net["wide_dense"]),
+        "wide_b": np.asarray(net["wide_b"], np.float32),
+        # mlp matrices: per-output-channel (axis 1); biases stay f32
+        "mlp": [{"w": _q_tensor(layer["w"], 1),
+                 "b": np.asarray(layer["b"], np.float32)}
+                for layer in net["mlp"]],
+    }
+
+
+def dequantize_widedeep_rest(qrest: Dict[str, Any]) -> Dict[str, Any]:
+    """jnp inverse of :func:`quantize_widedeep_rest` — the param dict
+    ``forward_from_rows`` consumes, rebuilt in-program."""
+    return {
+        "wide_dense": dequantize(qrest["wide_dense"]["q"],
+                                 qrest["wide_dense"]["s"]),
+        "wide_b": qrest["wide_b"],
+        "mlp": [{"w": dequantize(layer["w"]["q"], layer["w"]["s"], 1),
+                 "b": layer["b"]} for layer in qrest["mlp"]],
+    }
+
+
+def _q_widedeep(params: Dict[str, Any]) -> Dict[str, Any]:
+    net = params["net"]
+    qnet = quantize_widedeep_rest(net)
+    # 1-d tables get one per-tensor scale (a per-row scale on scalar
+    # rows would cost MORE than the f32 it replaces); emb (V, E) goes
+    # per-row — gathered rows dequantize locally
+    qnet["wide_cat"] = _q_tensor(net["wide_cat"])
+    qnet["emb"] = _q_tensor(net["emb"], 0)
+    return {"net": qnet, "offsets": np.asarray(params["offsets"])}
+
+
+#: op label -> calibration recipe; the keys double as the authoritative
+#: list of serving ops with an "int8" registry backend
+_RECIPES = {
+    "linear_margins": _q_linear,
+    "kmeans_assign": _q_kmeans,
+    "widedeep_scores": _q_widedeep,
+}
+
+
+def quantized_ops() -> Tuple[str, ...]:
+    """Ops with a publish-time int8 calibration recipe."""
+    return tuple(sorted(_RECIPES))
+
+
+def quantize_stage_params(op: str, params: Dict[str, Any]
+                          ) -> Dict[str, Any]:
+    """Calibrate + quantize a stage kernel's f32 param pytree into the
+    pytree the op's "int8" registry backend expects.  KeyError for ops
+    without a recipe — the servable surfaces that as "precision not
+    supported" at bind time, not as a crash mid-serve."""
+    try:
+        recipe = _RECIPES[op]
+    except KeyError:
+        raise KeyError(
+            f"no int8 calibration recipe for op {op!r} (have "
+            f"{quantized_ops()}); serve this model at f32") from None
+    return recipe(params)

@@ -343,6 +343,12 @@ class DeltaPublisher:
         new_params = unflatten_params(self._template, new_flat)
         new_model = model_with_params(live.servable.model, new_params)
         if getattr(live.servable, "rebind_safe", False):
+            # per-generation re-calibration rides this call (ISSUE 18):
+            # an int8 servable's rebind re-runs its bind path, which
+            # re-derives quantization scales from new_model's params
+            # BEFORE the conditional swap below — in-flight requests
+            # finish on the old generation's codes+scales, and stale
+            # scales never serve the new params
             servable = live.servable.rebind(new_model)
             deployed = self._registry.publish_servable(
                 self._name, servable,

@@ -8,6 +8,7 @@ from .emb_grad_pallas import (  # noqa: F401
     fold_runs_fused,
     routed_table_grad_gather_fused,
 )
+from . import int8_serving  # noqa: F401  (registers the "int8" backends)
 from .ell_scatter import (  # noqa: F401
     EllLayout,
     ell_layout,

@@ -27,6 +27,21 @@ device-resident and streams cold blocks in on demand:
   full-table forward uses, so served scores are bit-exact with
   ``model.transform`` (asserted in ``tests/test_scheduler.py``).
 
+**Int8 row pools** (ISSUE 18): ``precision="int8"`` stores matrix-row
+tables as int8 CODES plus one f32 per-row scale, quantized ONCE from the
+host table at construction (publish-time calibration — ``rebind``'s
+fresh cache re-calibrates each generation).  The codes pool plus the
+scales pool cost ~(1 + 4/row_dim)/4 of the f32 pool at the same
+``capacity_blocks`` — so at a FIXED device byte budget an int8 cache
+holds ~2x the resident rows (the models-per-chip multiplier
+``bench_int8`` measures).  A lookup gathers codes and scales and
+dequantizes the gathered rows in-program (one exact cast + one f32
+multiply; the f32 table never materializes); the oversized-batch bypass
+dequantizes the SAME codes host-side, so cached and bypassed batches
+return identical bits.  Scalar-row (1-d) tables — WideDeep's
+``wide_cat`` — stay f32: codes + a per-row scale would cost more than
+the f32 they replace.
+
 **Single-consumer contract**: ``lookup`` mutates the slot map and the
 pools without a lock — exactly one thread may call it (the scheduler's
 serve loop / an endpoint's serve thread; warm-up of a NEW servable
@@ -55,6 +70,7 @@ __all__ = ["EmbeddingRowCache", "CachedWideDeepServable"]
 
 _POOL_SET: list = []
 _POOL_GATHER: list = []
+_POOL_GATHER_DEQ: list = []
 
 
 def _pool_setter():
@@ -78,6 +94,20 @@ def _pool_gather():
     return _POOL_GATHER[0]
 
 
+def _pool_gather_deq():
+    """The int8-pool gather: codes and per-row scales gather together
+    and the GATHERED rows dequantize in the same program — the f32
+    table (or block) never materializes on device."""
+    if not _POOL_GATHER_DEQ:
+        import jax.numpy as jnp
+
+        _POOL_GATHER_DEQ.append(jax.jit(
+            lambda pool, spool, slots, local:
+            pool[slots, local].astype(jnp.float32)
+            * spool[slots, local][..., None]))
+    return _POOL_GATHER_DEQ[0]
+
+
 class EmbeddingRowCache:
     """LRU of device-resident row blocks over host-resident tables
     (module doc).  ``tables`` maps name -> host array sharing one
@@ -85,13 +115,16 @@ class EmbeddingRowCache:
     "emb": (V, E)}``."""
 
     def __init__(self, tables: Dict[str, Any], *, block_rows: int = 512,
-                 capacity_blocks: int = 64):
+                 capacity_blocks: int = 64, precision: str = "f32"):
         if not tables:
             raise ValueError("tables must not be empty")
         if block_rows <= 0:
             raise ValueError("block_rows must be positive")
         if capacity_blocks <= 0:
             raise ValueError("capacity_blocks must be positive")
+        if precision not in ("f32", "int8"):
+            raise ValueError(f"unknown cache precision {precision!r}")
+        self.precision = precision
         self._host = {name: np.asarray(t) for name, t in tables.items()}
         sizes = {name: t.shape[0] for name, t in self._host.items()}
         if len(set(sizes.values())) != 1:
@@ -100,6 +133,18 @@ class EmbeddingRowCache:
         self.vocab = next(iter(sizes.values()))
         if self.vocab == 0:
             raise ValueError("tables must carry at least one row")
+        # int8: matrix-row tables become codes + per-row scales, ONCE,
+        # from this generation's host table (publish-time calibration;
+        # module doc).  Scalar-row tables stay f32.
+        self._host_scales: Dict[str, np.ndarray] = {}
+        if precision == "int8":
+            from ..kernels.quantize import quantize_rows
+
+            for name, t in self._host.items():
+                if t.ndim >= 2:
+                    codes, scales = quantize_rows(t)
+                    self._host[name] = codes
+                    self._host_scales[name] = scales
         self.block_rows = block_rows
         self.n_blocks = -(-self.vocab // block_rows)
         #: a cache bigger than the table is just the table — cap it so
@@ -110,6 +155,10 @@ class EmbeddingRowCache:
                 (self.capacity_blocks, block_rows) + t.shape[1:],
                 t.dtype))
             for name, t in self._host.items()}
+        self._scale_pools = {
+            name: jax.device_put(np.zeros(
+                (self.capacity_blocks, block_rows), np.float32))
+            for name in self._host_scales}
         self._slot_of: Dict[int, int] = {}
         self._lru: "OrderedDict[int, int]" = OrderedDict()
         self._free = list(range(self.capacity_blocks - 1, -1, -1))
@@ -123,8 +172,7 @@ class EmbeddingRowCache:
         self._fault_s = 0.0
 
     # -- core ----------------------------------------------------------------
-    def _host_block(self, name: str, block: int) -> np.ndarray:
-        table = self._host[name]
+    def _pad_block(self, table: np.ndarray, block: int) -> np.ndarray:
         lo = block * self.block_rows
         chunk = table[lo:lo + self.block_rows]
         if chunk.shape[0] == self.block_rows:
@@ -132,6 +180,9 @@ class EmbeddingRowCache:
         pad = np.zeros((self.block_rows - chunk.shape[0],)
                        + table.shape[1:], table.dtype)
         return np.concatenate([chunk, pad], axis=0)
+
+    def _host_block(self, name: str, block: int) -> np.ndarray:
+        return self._pad_block(self._host[name], block)
 
     def _admit(self, block: int, pinned) -> int:
         """Fault one block in (single-consumer; see module doc).
@@ -155,6 +206,10 @@ class EmbeddingRowCache:
         for name in self._pools:
             self._pools[name] = setter(self._pools[name], slot_idx,
                                        self._host_block(name, block))
+        for name in self._scale_pools:
+            self._scale_pools[name] = setter(
+                self._scale_pools[name], slot_idx,
+                self._pad_block(self._host_scales[name], block))
         self._fault_s += time.perf_counter() - t0
         self.block_faults += 1
         self._slot_of[block] = slot
@@ -186,8 +241,15 @@ class EmbeddingRowCache:
             # for the traffic, not that results degraded.
             self.bypasses += 1
             self.misses += int(ids.size)
-            return {name: jax.device_put(table[ids])
-                    for name, table in self._host.items()}
+            # int8 tables dequantize host-side from the SAME codes the
+            # pools hold — one f32 cast + one f32 multiply, elementwise,
+            # so bypassed batches are bitwise the cached batches
+            return {
+                name: jax.device_put(
+                    table[ids].astype(np.float32)
+                    * self._host_scales[name][ids][..., None]
+                    if name in self._host_scales else table[ids])
+                for name, table in self._host.items()}
         pinned = {int(b) for b in unique}
         slots = np.empty((unique.shape[0],), np.int32)
         for i, block in enumerate(unique):
@@ -203,8 +265,13 @@ class EmbeddingRowCache:
         slot_ids = slots[inverse].reshape(ids.shape)
         local = local.astype(np.int32)
         gather = _pool_gather()
-        return {name: gather(pool, slot_ids, local)
-                for name, pool in self._pools.items()}
+        gather_deq = _pool_gather_deq() if self._scale_pools else None
+        return {
+            name: gather_deq(pool, self._scale_pools[name], slot_ids,
+                             local)
+            if name in self._scale_pools else gather(pool, slot_ids,
+                                                     local)
+            for name, pool in self._pools.items()}
 
     # -- observability -------------------------------------------------------
     @property
@@ -218,8 +285,11 @@ class EmbeddingRowCache:
 
     @property
     def pool_bytes(self) -> int:
+        import itertools
+
         return sum(int(np.prod(p.shape)) * p.dtype.itemsize
-                   for p in self._pools.values())
+                   for p in itertools.chain(self._pools.values(),
+                                            self._scale_pools.values()))
 
     def reset_counters(self) -> None:
         """Zero the hit/miss ledger (bench legs separate warm-up from
@@ -245,6 +315,7 @@ class EmbeddingRowCache:
             "n_blocks": self.n_blocks,
             "block_rows": self.block_rows,
             "pool_bytes": self.pool_bytes,
+            "precision": self.precision,
         }
 
     def publish(self, group) -> None:
@@ -278,6 +349,21 @@ def _cached_scores(rest, dense, wide_rows, emb_rows):
                                             emb_rows))
 
 
+@jax.jit
+def _cached_scores_int8(qrest, dense, wide_rows, emb_rows):
+    """The int8 twin of ``_cached_scores``: the gathered rows arrive
+    already dequantized (the cache pools' gather-then-dequantize), the
+    dense-tower matrices dequantize here, and the expression after the
+    rebuild is the SAME ``forward_from_rows`` — so a generation's
+    scores are bit-stable call-to-call while tracking f32 within the
+    parity matrix's accuracy envelope."""
+    from ..kernels.quantize import dequantize_widedeep_rest
+    from ..models.recommendation.widedeep import forward_from_rows
+
+    return jax.nn.sigmoid(forward_from_rows(
+        dequantize_widedeep_rest(qrest), dense, wide_rows, emb_rows))
+
+
 class CachedWideDeepServable(ServableModel):
     """WideDeep serving through the embedding-row cache: only hot table
     blocks are device-resident; scores are bit-exact with
@@ -286,6 +372,7 @@ class CachedWideDeepServable(ServableModel):
     old generation must never serve the new one."""
 
     rebind_safe = True
+    supported_precisions = ("f32", "int8")
 
     def __init__(self, model, example: Table, *,
                  cache_block_rows: int = 512,
@@ -299,12 +386,25 @@ class CachedWideDeepServable(ServableModel):
         model._require_model()
         params = model._params
         self._vocab_sizes = model._vocab_sizes
+        # int8 calibration capture point for the cached path: the cache
+        # quantizes THIS generation's tables and the dense tower
+        # quantizes here — rebind() re-binds the clone, so every delta
+        # publish re-derives scales before the swap (stale scales never
+        # serve)
         self.cache = EmbeddingRowCache(
             {"wide_cat": params["wide_cat"], "emb": params["emb"]},
             block_rows=self._cache_block_rows,
-            capacity_blocks=self._cache_capacity_blocks)
-        self._rest = jax.device_put({
-            k: params[k] for k in ("wide_dense", "wide_b", "mlp")})
+            capacity_blocks=self._cache_capacity_blocks,
+            precision=self.precision)
+        if self.precision == "int8":
+            from ..kernels.quantize import quantize_widedeep_rest
+
+            self._rest = jax.device_put(quantize_widedeep_rest(params))
+            self._scores = _cached_scores_int8
+        else:
+            self._rest = jax.device_put({
+                k: params[k] for k in ("wide_dense", "wide_b", "mlp")})
+            self._scores = _cached_scores
 
     def rebind(self, model) -> "ServableModel":
         clone = super().rebind(model)
@@ -325,8 +425,8 @@ class CachedWideDeepServable(ServableModel):
             (dense, gids), min_bucket=self.min_bucket)
         rows = self.cache.lookup(gids_p)
         scores = np.asarray(
-            _cached_scores(self._rest, dense_p, rows["wide_cat"],
-                           rows["emb"]), np.float64)[:n]
+            self._scores(self._rest, dense_p, rows["wide_cat"],
+                         rows["emb"]), np.float64)[:n]
         out = table.with_column(model.get_raw_prediction_col(), scores)
         return out.with_column(model.get_prediction_col(),
                                (scores > 0.5).astype(np.int64))

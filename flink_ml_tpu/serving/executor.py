@@ -69,10 +69,26 @@ class ServableModel:
     the families the serving layer optimizes.
     """
 
+    #: precisions this executor family can serve at.  "int8" means the
+    #: bind path quantizes the published params (per-channel max-abs,
+    #: ``kernels/quantize.py``) and scores through the op's "int8"
+    #: registry backend; only the registry-dispatched families support
+    #: it — the generic ``model.transform`` adapter and the fused
+    #: pipeline plan have no quantized param seam, so they refuse at
+    #: construction rather than silently serving f32.
+    supported_precisions = ("f32",)
+
     def __init__(self, model, example: Table, *,
                  max_batch_rows: int = 256,
                  min_bucket: int = DEFAULT_MIN_BUCKET,
-                 output_cols: Optional[Sequence[str]] = None):
+                 output_cols: Optional[Sequence[str]] = None,
+                 precision: str = "f32"):
+        if precision not in self.supported_precisions:
+            raise TypeError(
+                f"{type(self).__name__} cannot serve at precision "
+                f"{precision!r} (supports {self.supported_precisions}); "
+                "int8 covers the registry-dispatched families only")
+        self.precision = precision
         if not hasattr(model, "transform"):
             raise TypeError(
                 f"{type(model).__name__} has no transform(); only fitted "
@@ -177,7 +193,8 @@ class ServableModel:
         from ..kernels.registry import kernel_stats
 
         fault_point("serving.warm_up")
-        report: dict = {"wall_s": None, "buckets": {}}
+        report: dict = {"wall_s": None, "precision": self.precision,
+                        "buckets": {}}
         t_start = _time.perf_counter()
         for bucket in self.buckets:
             compiles0, aot0, hits0 = kernel_stats.thread_counts()
@@ -194,7 +211,8 @@ class ServableModel:
             else:
                 source = "untracked"
             report["buckets"][bucket] = {"source": source,
-                                         "ms": round(ms, 3)}
+                                         "ms": round(ms, 3),
+                                         "precision": self.precision}
         report["wall_s"] = round(_time.perf_counter() - t_start, 4)
         sources = [b["source"] for b in report["buckets"].values()]
         report["compiled"] = sources.count("compile")
@@ -228,6 +246,7 @@ class _KernelServable(ServableModel):
 
     rebind_safe = True
     op_label: Optional[str] = None
+    supported_precisions = ("f32", "int8")
 
     def __init__(self, model, example: Table, **kwargs: Any):
         super().__init__(model, example, **kwargs)
@@ -239,6 +258,32 @@ class _KernelServable(ServableModel):
         # an unfitted model) and must surface at construction, not
         # silently degrade every request to the generic transform path
         kernel = self.model.transform_kernel(self.example.schema())
+        if kernel is None and self.precision == "int8":
+            # no chain plan for this config (e.g. sparse linear layouts)
+            # means no quantized path either; silently serving f32 under
+            # an int8 contract would lie to the capacity planner
+            raise TypeError(
+                f"{type(self.model).__name__} has no chain kernel for "
+                "this example schema — precision='int8' requires the "
+                "registry-dispatched plan; serve this config at f32")
+        if kernel is not None and self.precision == "int8":
+            # THE calibration capture point: quantize this generation's
+            # params and swap the plan's fn for the op's "int8" registry
+            # backend.  rebind() re-runs this bind on the clone, so a
+            # delta publish re-derives scales from the NEW params before
+            # the swap — stale scales never serve (ARCHITECTURE.md
+            # "Int8 serving").  Same (fn, static) plan identity across
+            # generations => rebind stays zero-new-lowerings.
+            import dataclasses
+
+            from ..kernels.quantize import quantize_stage_params
+            from ..kernels.registry import lookup
+
+            entry = lookup(self.op_label, backend="int8")
+            kernel = dataclasses.replace(
+                kernel, fn=entry.fn,
+                params=quantize_stage_params(self.op_label,
+                                             kernel.params))
         self._kernel = kernel
         self._kernel_params = (jax.device_put(kernel.params)
                                if kernel is not None else None)
@@ -348,7 +393,15 @@ def make_servable(model, example: Table, *, emb_cache: bool = False,
     ``emb_cache=True`` (WideDeep only) serves through the
     device-resident embedding-row cache (``serving/embcache.py``,
     ISSUE 14): only the hot table blocks live in HBM;
-    ``cache_block_rows`` / ``cache_capacity_blocks`` size it."""
+    ``cache_block_rows`` / ``cache_capacity_blocks`` size it.
+
+    ``precision="int8"`` (the registry-dispatched families + the cached
+    WideDeep path) quantizes the published params at bind time
+    (per-channel max-abs, ``kernels/quantize.py``) and scores through
+    the op's "int8" registry backend — roughly 4x smaller resident
+    params (2x for the row cache's codes+scales pools) at an accuracy
+    envelope the parity matrix gates.  Families without a quantized
+    seam raise TypeError rather than silently serving f32."""
     from ..api.pipeline import PipelineModel
     from ..models.clustering.kmeans import KMeansModel
     from ..models.common.linear import LinearModelBase

@@ -140,6 +140,10 @@ class Tenant:
         #: for shared-servable tenants: nothing was deployed) — the
         #: "admission is compilation-free" receipt
         self.admission_report: Optional[dict] = None
+        #: the precision this tenant's servable scores at ("f32" /
+        #: "int8") — shared-servable tenants inherit the sharing
+        #: tenant's; mirrored as a per-tenant string gauge
+        self.precision = "f32"
 
 
 class SharedScheduler:
@@ -225,6 +229,13 @@ class SharedScheduler:
                              for slo in SLO_CLASSES}
         for gauge in self._class_depth.values():
             gauge.set(0)
+        #: tenants serving quantized (ISSUE 18): the capacity planner's
+        #: models-per-chip arithmetic needs to know how many tenants
+        #: ride the int8 footprint; the per-tenant ``precision`` string
+        #: gauge says WHICH (graftscope snapshots show generation +
+        #: precision together)
+        self._int8_tenants = self.group.gauge("int8_tenants")
+        self._int8_tenants.set(0)
         #: chip-idle accounting (ISSUE 17): busy seconds accumulate
         #: around dispatch on ONE clock (``busy_clock``, injectable for
         #: tests), and ``chip_idle_fraction`` is windowed between
@@ -317,6 +328,7 @@ class SharedScheduler:
                                    "an admitted tenant")
                 serve_name = sharing.serve_name
                 report = None
+                precision = sharing.precision
             else:
                 if model is None:
                     raise ValueError("admitting a tenant needs a model "
@@ -328,8 +340,16 @@ class SharedScheduler:
                     name, model, example, metrics=metrics,
                     **servable_kwargs)
                 report = getattr(deployed.servable, "warmup_report", None)
+                precision = getattr(deployed.servable, "precision",
+                                    "f32")
+            # the precision label rides the tenant subtree like the SLO
+            # class: graftscope snapshots show which generation serves
+            # at which precision (a string gauge stays out of
+            # prometheus exports, the slo-gauge stance)
+            metrics.group.gauge("precision").set(precision)
             tenant = Tenant(name, serve_name, slo, weight, metrics)
             tenant.admission_report = report
+            tenant.precision = precision
             with self._cond:
                 self._tenants[name] = tenant
         finally:
@@ -670,11 +690,14 @@ PlacementMap`: every placed tenant's WFQ weight becomes
         windowed chip-idle fraction, both deltas on ``_busy_clock``."""
         with self._cond:
             depths = {slo: 0 for slo in SLO_CLASSES}
+            int8_tenants = 0
             for tenant in self._tenants.values():
                 depths[tenant.slo] += len(tenant.pending)
+                int8_tenants += tenant.precision == "int8"
             busy = self._busy_s
         for slo, depth in depths.items():
             self._class_depth[slo].set(depth)
+        self._int8_tenants.set(int8_tenants)
         now = self._busy_clock()
         if self._idle_window_start is not None:
             wall = now - self._idle_window_start

@@ -62,9 +62,16 @@ SCAN_CALLEES = {"scan", "masked_chunk_scan", "while_loop", "fori_loop"}
 #: sync in a step-shaped helper here would fence the very dispatch
 #: streams the control plane exists to keep busy, turning every
 #: decision tick into a fleet-wide stall)
+#: (``kernels/`` joined with ISSUE 18: the quantize module's dequant
+#: helpers trace into every int8 serving program and the registry's
+#: dispatch wrapper fronts every kernel consumer — a host fetch in a
+#: step-shaped helper here would fence training AND serving dispatch
+#: streams at once; calibration is host-side numpy by design, but it
+#: runs at publish/bind time, never inside a step body)
 SCAN_ROOTS = (
     "flink_ml_tpu/autoscale",
     "flink_ml_tpu/iteration",
+    "flink_ml_tpu/kernels",
     "flink_ml_tpu/models",
     "flink_ml_tpu/obs",
     "flink_ml_tpu/online",

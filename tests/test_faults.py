@@ -1673,3 +1673,120 @@ def test_elastic_exact_mode_resize_bitexact(tmp_path):
                                   state_b.coefficients)
     assert state_e.intercept == state_b.intercept
     np.testing.assert_array_equal(log_e, log_b)
+
+
+# -- int8 serving chaos (ISSUE 18) -------------------------------------------
+# the quantized path's two failure stories: a delta publish must
+# re-derive scales for the new generation (stale scales never serve,
+# in-flight requests finish on the old ones), and a corrupt quantized
+# AOT entry must quarantine + recompile to the exact same codes.
+
+def _int8_endpoint():
+    from flink_ml_tpu.models.classification.logisticregression import (
+        LogisticRegression)
+    from flink_ml_tpu.serving import serve_model
+
+    boot_window = next(_ctl_windows(0, 1))
+    boot = LogisticRegression().set_max_iter(1).fit(boot_window)
+    return serve_model(boot, boot_window.drop("label").take(2),
+                       max_batch_rows=32, max_wait_ms=0.5,
+                       precision="int8")
+
+
+def test_delta_publish_to_int8_tenant_recalibrates_and_swaps_atomically():
+    """A delta publish to an int8 tenant re-runs per-channel max-abs
+    calibration on the NEW generation's params (rebind re-derives the
+    scales, so stale scales never serve) and swaps via the registry
+    CAS: the old servable object keeps answering bit-exact on the old
+    codes+scales — the in-flight story — while each generation is
+    bit-stable across repeat predicts."""
+    from flink_ml_tpu.online import DeltaEncoder, params_of_model
+
+    endpoint = _int8_endpoint()
+    try:
+        feats = next(_ctl_windows(5, 6)).drop("label")
+        live0 = endpoint.registry.current("default")
+        old_servable = live0.servable
+        assert old_servable.precision == "int8"
+        scales0 = np.asarray(old_servable._kernel.params["w"]["s"])
+        old_a = np.asarray(endpoint.predict(feats)["rawPrediction"])
+        old_b = np.asarray(endpoint.predict(feats)["rawPrediction"])
+        np.testing.assert_array_equal(old_a, old_b)  # bit-stable gen 0
+
+        pub = endpoint.delta_publisher()
+        enc = DeltaEncoder()
+        p = params_of_model(old_servable.model)
+        p2 = {"w": (p["w"] * np.float32(1.5)).astype(np.float32),
+              "b": p["b"]}
+        pub.apply(enc.encode(1, p2, pub.stats))
+        enc.ack()
+
+        live1 = endpoint.registry.current("default")
+        assert live1.generation > live0.generation
+        assert live1.servable.precision == "int8"
+        scales1 = np.asarray(live1.servable._kernel.params["w"]["s"])
+        # re-calibration really happened: the new generation's scales
+        # came from the NEW params, not the stale gen-0 calibration
+        assert scales1.tobytes() != scales0.tobytes()
+        from flink_ml_tpu.kernels.quantize import quantize_channelwise
+        exp_q, exp_s = quantize_channelwise(p2["w"])
+        np.testing.assert_array_equal(
+            np.asarray(live1.servable._kernel.params["w"]["q"]), exp_q)
+        np.testing.assert_array_equal(scales1, exp_s)
+
+        new_a = np.asarray(endpoint.predict(feats)["rawPrediction"])
+        new_b = np.asarray(endpoint.predict(feats)["rawPrediction"])
+        np.testing.assert_array_equal(new_a, new_b)  # bit-stable gen 1
+        assert new_a.tobytes() != old_a.tobytes()
+        # the pre-swap servable still serves the OLD generation's bits:
+        # an in-flight request that grabbed it finishes on the old
+        # scales, never a half-swapped mix
+        inflight = np.asarray(
+            old_servable.predict(feats)["rawPrediction"])
+        np.testing.assert_array_equal(inflight, old_a)
+    finally:
+        endpoint.close()
+
+
+def test_corrupt_int8_aot_entry_quarantines_and_recompiles_same_codes(
+        tmp_path):
+    """Flip a byte in a persisted int8 executable, restart the cache
+    (fresh ``ExecutableCache`` over the same root): warm-up quarantines
+    the entry, recompiles transparently, and — because calibration is
+    deterministic host numpy — the rebuilt program serves the exact
+    same bits as the pre-corruption reference."""
+    from flink_ml_tpu.kernels import aot
+    from flink_ml_tpu.kernels.registry import kernel_stats
+    from flink_ml_tpu.models.classification.logisticregression import (
+        LogisticRegression)
+    from flink_ml_tpu.serving import make_servable
+
+    window = next(_ctl_windows(0, 1))
+    model = LogisticRegression().set_max_iter(1).fit(window)
+    feats = window.drop("label").take(8)
+    root = str(tmp_path / "aotcache")
+    aot.set_cache(aot.ExecutableCache(root))
+    try:
+        sv = make_servable(model, feats.take(2), max_batch_rows=8,
+                           min_bucket=8, precision="int8").warm_up()
+        ref = np.asarray(sv.predict(feats)["rawPrediction"])
+        exec_root = os.path.join(root, "exec")
+        entries = [os.path.join(exec_root, n)
+                   for n in sorted(os.listdir(exec_root))
+                   if ".corrupt" not in n and ".tmp." not in n]
+        assert entries, "int8 warm-up persisted no AOT entries"
+        for entry in entries:
+            corrupt_file(os.path.join(entry, "executable.bin"),
+                         mode="flip")
+        # restarted process: fresh cache object, same directory
+        aot.set_cache(aot.ExecutableCache(root))
+        before = kernel_stats.snapshot()["aot"]
+        sv2 = make_servable(model, feats.take(2), max_batch_rows=8,
+                            min_bucket=8, precision="int8").warm_up()
+        out = np.asarray(sv2.predict(feats)["rawPrediction"])
+        after = kernel_stats.snapshot()["aot"]
+        np.testing.assert_array_equal(out, ref)  # same codes, same bits
+        assert after["quarantined"] >= before["quarantined"] + 1
+        assert any(".corrupt" in n for n in os.listdir(exec_root))
+    finally:
+        aot.set_cache(None)

@@ -1256,3 +1256,28 @@ def test_autoscale_modules_visited_by_host_sync_and_atomic_writes():
         assert HostSyncPass().check_module(mod, project) == []
         assert AtomicWritesPass().check_module(mod, project) == []
         assert LockDisciplinePass().check_module(mod, project) == []
+
+
+def test_kernels_modules_visited_by_host_sync():
+    """ISSUE 18: ``flink_ml_tpu/kernels/`` joined the host-sync scan —
+    the quantize module's dequant helpers trace into every int8 serving
+    program, so a host fetch in a step-shaped helper there would fence
+    every consumer's dispatch stream.  Assert SCAN_ROOTS carries the
+    root, the walk genuinely VISITS the kernel modules (quantize
+    included — a root that matches nothing keeps the rule from ever
+    firing), and every one is clean: calibration's host numpy lives at
+    publish/bind time, never inside a step body."""
+    from scripts.graftlint.passes.host_sync import SCAN_ROOTS
+
+    assert "flink_ml_tpu/kernels" in SCAN_ROOTS
+    modules = [os.path.join("flink_ml_tpu", "kernels", f)
+               for f in ("quantize.py", "registry.py", "aot.py")]
+    project = Project(repo=REPO)
+    visited = {
+        os.path.relpath(m.path, REPO)
+        for m in project.iter_modules(
+            [os.path.join(REPO, r) for r in SCAN_ROOTS])}
+    for rel in modules:
+        assert rel in visited, f"host-sync never visits {rel}"
+        mod = project.module(os.path.join(REPO, rel))
+        assert HostSyncPass().check_module(mod, project) == []
