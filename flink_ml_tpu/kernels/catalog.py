@@ -15,13 +15,17 @@ is the kernel's own business, an op's planning policy the model's):
 - ``ops/int8_serving.py``      — "int8" backends of ``linear_margins``,
   ``kmeans_assign``, ``widedeep_scores`` (forced-lookup only; the
   servable bind path quantizes the params they consume)
+- ``retrieval/ivf.py`` / ``ops/retrieve_pallas.py`` — ``retrieve``
+  (stage convention; the IVF / IVF-PQ fused scan+top-k, first
+  non-model op family)
 
 This module is imported lazily by ``registry._ensure_catalog`` (first
 lookup), never at ``flink_ml_tpu.kernels`` import — that keeps the
 registry itself dependency-free and cycle-safe.
 """
 
-from .. import ops  # noqa: F401  (ell + kmeans + emb_grad kernels)
+from .. import ops  # noqa: F401  (ell + kmeans + emb_grad + retrieve)
 from ..models.clustering import kmeans  # noqa: F401
 from ..models.common import gbt, linear  # noqa: F401
 from ..models.recommendation import widedeep  # noqa: F401
+from ..retrieval import ivf  # noqa: F401  (the "xla" retrieve backend)

@@ -95,10 +95,20 @@ def params_of_model(model: Any) -> Any:
 
         return jax.tree_util.tree_map(
             lambda a: np.asarray(a), model._params)
+    from ..retrieval.ivf import IVFIndex
+
+    if isinstance(model, IVFIndex):
+        # the index's params dict IS the canonical pytree (posting-list
+        # row blocks + centroids + codebooks); posting-list edits touch
+        # few rows, so the sparse delta codec pays off exactly as it
+        # does for embedding tables
+        return {name: np.asarray(arr)
+                for name, arr in model.params.items()}
     raise TypeError(
         f"{type(model).__name__} has no params_of_model adapter; "
         "delta publishing covers the specialized servable families "
-        "(linear / KMeans / WideDeep) — use the full deploy path")
+        "(linear / KMeans / WideDeep) and IVFIndex — use the full "
+        "deploy path")
 
 
 def model_with_params(model: Any, params: Any) -> Any:
@@ -127,6 +137,13 @@ def model_with_params(model: Any, params: Any) -> Any:
         clone._params = _map_like(model._params,
                                   lambda a: jnp.asarray(a))(params)
         return clone
+    from ..retrieval.ivf import IVFIndex
+
+    if isinstance(model, IVFIndex):
+        # host bookkeeping (the id->vector store) stays with the
+        # producer's authoritative index; the serve-side clone only
+        # needs the device params
+        return model.rebound(params)
     raise TypeError(
         f"{type(model).__name__} has no model_with_params adapter")
 

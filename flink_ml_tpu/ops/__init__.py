@@ -15,6 +15,7 @@ from .ell_scatter import (  # noqa: F401
     ell_layout_device,
     ell_scatter_apply,
 )
+from . import retrieve_pallas  # noqa: F401  (the "pallas" retrieve backend)
 from .kmeans_pallas import (  # noqa: F401
     kmeans_assign_reduce,
     kmeans_update_stats,

@@ -1,0 +1,293 @@
+"""Fused Pallas ``retrieve`` backend: coarse probe -> DMA posting lists
+-> masked scan -> top-k merge, one VMEM-resident program per query.
+
+The XLA lowering (``retrieval/ivf.py``) gathers the probed posting-list
+blocks into a ``(b, nprobe, block)`` candidate tensor; XLA:TPU keeps the
+distance tiles fused but the gathered vector blocks themselves still
+round-trip HBM once per operand of the scan.  This kernel streams each
+probed block HBM->VMEM with an explicit async copy instead: the posting
+arrays stay in ``pltpu.ANY`` (HBM) and only the ``nprobe`` blocks a
+query actually probes ever move, directly into a reused VMEM scratch
+buffer — candidate distances and the running top-k never exist outside
+VMEM.
+
+Parity contract: per-row outputs are BITWISE-equal to the XLA backend in
+interpret mode (asserted by the ``tests/test_kernels.py`` matrix).  The
+kernel guarantees this by construction —
+
+- distance expressions are THE shared helpers of ``retrieval/ivf.py``
+  (``coarse_distances`` / ``flat_distances`` / ``pq_lut`` /
+  ``adc_distances``), never re-derived forms;
+- probes are consumed in ascending (distance, list-index) order — the
+  exact order ``lax.top_k`` emits them, reproduced with the
+  where/min/iota first-index selection of the KMeans Pallas kernels (a
+  true argmin would lower to a slow Mosaic index loop);
+- the running top-k merge breaks distance ties by candidate POSITION
+  (k kept slots first, then the block in row order), which provably
+  equals ``lax.top_k``'s lowest-flat-index tie rule because kept slots
+  always originate from earlier flat positions than the block being
+  merged.  Consumed slots are neutralised in both coordinates (distance
+  -> +inf AND position -> out-of-range) so an all-+inf tail can never
+  re-select them.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..kernels.registry import register_kernel, tpu_only
+from ..retrieval.ivf import (adc_distances, coarse_distances,
+                             decode_codebooks, flat_distances, pq_lut,
+                             runtime_one)
+
+__all__ = ["retrieve_stage_pallas", "fused_supported"]
+
+_VMEM_BUDGET = 12 * 1024 * 1024  # headroom below the ~16 MB/core VMEM
+
+
+def _tile_bytes(dim: int, m: int, ksub: int, nlist: int, block: int,
+                k: int) -> int:
+    """Per-step VMEM model: resident centroids + the DMA'd posting block
+    (+ decoded PQ books and LUT) + the merge tiles.  The merge chain is
+    modelled as ~4 live (1, k + block) tiles (candidates, positions, the
+    compare masks) — unrolled steps reuse the same buffers."""
+    resident = nlist * dim * 4 + nlist * 4          # centroids + coarse row
+    if m:
+        resident += block * m + block * 4           # codes buf + ids buf
+        resident += m * ksub * (dim // m) * 8       # cb int8 + decoded f32
+        resident += m * ksub * 4 + m * block * 4    # LUT + gathered entries
+    else:
+        resident += block * dim * 4 + block * 4
+    merge = 4 * (k + block) * 4
+    return resident + merge
+
+
+def fused_supported(sig: tuple) -> bool:
+    """supports() predicate for the fused kernel: a well-formed
+    ``retrieve`` signature whose working set fits the VMEM budget.
+    Shape-permissive beyond that — a forced ``lookup(backend="pallas")``
+    still honours this predicate, so it must accept every schema the
+    kernel can actually run (the parity matrix exercises it in interpret
+    mode on every host)."""
+    if len(sig) != 7:
+        return False
+    nprobe, k, dim, m, ksub, nlist, block = sig
+    if block % 8 or not 1 <= nprobe <= nlist or k < 1 or dim < 1:
+        return False
+    if m and (dim % m or not 2 <= ksub <= 127):
+        return False
+    return _tile_bytes(dim, m, ksub, nlist, block, k) <= _VMEM_BUDGET
+
+
+def _select_first_min(scores, iota, out_of_range):
+    """Smallest index attaining the row minimum — the KMeans Pallas
+    where/min/iota idiom (first-index argmin without an argmin loop)."""
+    mins = jnp.min(scores, axis=1, keepdims=True)
+    return jnp.min(jnp.where(scores <= mins, iota, out_of_range))
+
+
+def _merge_topk(best_d, best_i, dist, ids_row, k: int):
+    """Merge one probed block into the running top-k.  Tie rule: smallest
+    candidate position (kept slots 0..k-1, block slots k..), which equals
+    ``lax.top_k``'s lowest-flat-index rule — see the module docstring."""
+    total = k + dist.shape[1]
+    cand_d = jnp.concatenate([best_d, dist], axis=1)
+    cand_i = jnp.concatenate([best_i, ids_row], axis=1)
+    pos = jax.lax.broadcasted_iota(jnp.int32, (1, total), 1)
+    out_d, out_i = [], []
+    for _ in range(k):
+        dmin = jnp.min(cand_d, axis=1, keepdims=True)
+        tied = cand_d <= dmin
+        pmin = jnp.min(jnp.where(tied, pos, total), axis=1, keepdims=True)
+        sel = pos == pmin                      # exactly one slot
+        out_d.append(jnp.sum(jnp.where(sel, cand_d, 0.0), axis=1,
+                             keepdims=True))
+        out_i.append(jnp.sum(jnp.where(sel, cand_i, 0), axis=1,
+                             keepdims=True))
+        cand_d = jnp.where(sel, jnp.inf, cand_d)
+        pos = jnp.where(sel, total, pos)       # never re-selectable
+    return (jnp.concatenate(out_d, axis=1),
+            jnp.concatenate(out_i, axis=1).astype(jnp.int32))
+
+
+def _flat_kernel(nprobe: int, k: int, block: int, nlist: int):
+    def kern(q_ref, cent_ref, ids_hbm, vecs_hbm, nn_ref, nd_ref,
+             vec_buf, ids_buf, sem_v, sem_i):
+        q = q_ref[:]                                     # (1, d)
+        coarse = coarse_distances(q, cent_ref[:])        # (1, nlist)
+        iota_l = jax.lax.broadcasted_iota(jnp.int32, (1, nlist), 1)
+        best_d = jnp.full((1, k), jnp.inf, jnp.float32)
+        best_i = jnp.full((1, k), -1, jnp.int32)
+        for _ in range(nprobe):
+            probe = _select_first_min(coarse, iota_l, nlist)
+            coarse = jnp.where(iota_l == probe, jnp.inf, coarse)
+            cp_v = pltpu.make_async_copy(
+                vecs_hbm.at[pl.ds(probe * block, block), :], vec_buf,
+                sem_v)
+            cp_i = pltpu.make_async_copy(
+                ids_hbm.at[pl.ds(probe, 1), :], ids_buf, sem_i)
+            cp_v.start()
+            cp_i.start()
+            cp_v.wait()
+            cp_i.wait()
+            dist = flat_distances(q, vec_buf[:][None])   # (1, block)
+            ids_row = ids_buf[:]                         # (1, block)
+            dist = jnp.where(ids_row >= 0, dist, jnp.inf)
+            best_d, best_i = _merge_topk(best_d, best_i, dist, ids_row, k)
+        nn_ref[:] = best_i
+        nd_ref[:] = best_d
+
+    return kern
+
+
+def _pq_kernel(nprobe: int, k: int, block: int, nlist: int, m: int):
+    def kern(q_ref, cent_ref, cbq_ref, cbs_ref, ids_hbm, codes_hbm,
+             nn_ref, nd_ref, code_buf, ids_buf, sem_c, sem_i):
+        q = q_ref[:]                                     # (1, d)
+        one = runtime_one(cbs_ref[0, 0])
+        # mirror of the XLA stage: runtime-1.0 pins the decode rounding
+        books = decode_codebooks(cbq_ref[:], cbs_ref[:]) * one
+        coarse = coarse_distances(q, cent_ref[:])
+        iota_l = jax.lax.broadcasted_iota(jnp.int32, (1, nlist), 1)
+        best_d = jnp.full((1, k), jnp.inf, jnp.float32)
+        best_i = jnp.full((1, k), -1, jnp.int32)
+        for _ in range(nprobe):
+            probe = _select_first_min(coarse, iota_l, nlist)
+            coarse = jnp.where(iota_l == probe, jnp.inf, coarse)
+            cp_c = pltpu.make_async_copy(
+                codes_hbm.at[pl.ds(probe * block, block), :], code_buf,
+                sem_c)
+            cp_i = pltpu.make_async_copy(
+                ids_hbm.at[pl.ds(probe, 1), :], ids_buf, sem_i)
+            cp_c.start()
+            cp_i.start()
+            cp_c.wait()
+            cp_i.wait()
+            cent = jax.lax.dynamic_slice(
+                cent_ref[:], (probe, 0), (1, q.shape[1]))
+            resid = q - cent                             # (1, d)
+            lut = pq_lut(resid.reshape(1, m, -1), books, one)
+            dist = adc_distances(lut, code_buf[:][None])  # (1, block)
+            ids_row = ids_buf[:]
+            dist = jnp.where(ids_row >= 0, dist, jnp.inf)
+            best_d, best_i = _merge_topk(best_d, best_i, dist, ids_row, k)
+        nn_ref[:] = best_i
+        nd_ref[:] = best_d
+
+    return kern
+
+
+@functools.partial(
+    jax.jit, static_argnames=("nprobe", "k", "nlist", "block", "interpret"))
+def retrieve_flat_fused(q, centroids, ids, vecs, *, nprobe: int, k: int,
+                        nlist: int, block: int, interpret: bool = False):
+    """Fused flat-f32 search: ``(q (b, d), centroids (nlist, d), ids
+    (nlist, block) i32, vecs (nlist*block, d)) -> (neighbors (b, k) i32,
+    distances (b, k) f32)``."""
+    b, d = q.shape
+    return pl.pallas_call(
+        _flat_kernel(nprobe, k, block, nlist),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, d), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((nlist, d), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, k), jnp.int32),
+            jax.ShapeDtypeStruct((b, k), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block, d), jnp.float32),
+            pltpu.VMEM((1, block), jnp.int32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(q, centroids, ids, vecs)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("nprobe", "k", "nlist", "block", "m", "interpret"))
+def retrieve_pq_fused(q, centroids, ids, codes, cb_q, cb_s, *, nprobe: int,
+                      k: int, nlist: int, block: int, m: int,
+                      interpret: bool = False):
+    """Fused IVF-PQ search: int8 code blocks DMA'd per probe, LUT built
+    in VMEM from the decoded per-subspace codebooks."""
+    b, d = q.shape
+    ksub = cb_q.shape[1]
+    return pl.pallas_call(
+        _pq_kernel(nprobe, k, block, nlist, m),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, d), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((nlist, d), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, ksub, d // m), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, ksub), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, k), jnp.int32),
+            jax.ShapeDtypeStruct((b, k), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block, m), jnp.int8),
+            pltpu.VMEM((1, block), jnp.int32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(q, centroids, cb_q, cb_s, ids, codes)
+
+
+def retrieve_stage_pallas(static, params, cols, *, interpret: bool = False):
+    """Stage-convention entry: same (static, params, cols) contract and
+    staging outputs as the XLA stage in ``retrieval/ivf.py``."""
+    (qcol, ncol, dcol, nprobe, k, nlist, block, m, _ksub) = static
+    q = cols[qcol]
+    if m:
+        nbrs, dists = retrieve_pq_fused(
+            q, params["centroids"], params["ids"], params["codes"],
+            params["cb_q"], params["cb_s"], nprobe=nprobe, k=k,
+            nlist=nlist, block=block, m=m, interpret=interpret)
+    else:
+        nbrs, dists = retrieve_flat_fused(
+            q, params["centroids"], params["ids"], params["vecs"],
+            nprobe=nprobe, k=k, nlist=nlist, block=block,
+            interpret=interpret)
+    return {ncol: nbrs, dcol: dists}
+
+
+def _register() -> None:
+    register_kernel("retrieve", "pallas", retrieve_stage_pallas,
+                    priority=10, supports=fused_supported,
+                    available=tpu_only, convention="stage")
+
+
+_register()

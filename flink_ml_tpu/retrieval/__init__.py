@@ -1,0 +1,15 @@
+"""Vector retrieval: IVF / IVF-PQ index build, search planning, and the
+``retrieve`` kernel family — the repo's first non-model servable."""
+
+from .ivf import IVFIndex, PQConfig, SearchPlan, retrieve_sig
+from .metrics import RecallProbe, exact_neighbors, recall_at_k
+
+__all__ = [
+    "IVFIndex",
+    "PQConfig",
+    "RecallProbe",
+    "SearchPlan",
+    "exact_neighbors",
+    "recall_at_k",
+    "retrieve_sig",
+]

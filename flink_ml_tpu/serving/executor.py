@@ -343,6 +343,18 @@ class _WideDeepServable(_KernelServable):
     op_label = "widedeep_scores"
 
 
+class _RetrieveServable(_KernelServable):
+    """IVFIndex — the first NON-model servable: the fused IVF / IVF-PQ
+    scan+top-k plan serves through exactly the kernel seams the model
+    families do (same plan identity as the index's own ``transform``, so
+    warmed buckets are compile-cache hits; rebind swaps posting-list
+    params with zero new lowerings).  No "int8" registry backend — PQ
+    codes ARE the compressed representation, carried by the f32 plan."""
+
+    op_label = "retrieve"
+    supported_precisions = ("f32",)
+
+
 class _PipelineServable(ServableModel):
     """PipelineModel: the whole chain (preprocess + score) compiles into
     fused segments (``api/chain.py``) at deploy time — a fully-chainable
@@ -406,6 +418,7 @@ def make_servable(model, example: Table, *, emb_cache: bool = False,
     from ..models.clustering.kmeans import KMeansModel
     from ..models.common.linear import LinearModelBase
     from ..models.recommendation.widedeep import WideDeepModel
+    from ..retrieval.ivf import IVFIndex
 
     if isinstance(model, PipelineModel):
         cls: type = _PipelineServable
@@ -413,6 +426,8 @@ def make_servable(model, example: Table, *, emb_cache: bool = False,
         cls = _LinearServable
     elif isinstance(model, KMeansModel):
         cls = _KMeansServable
+    elif isinstance(model, IVFIndex):
+        cls = _RetrieveServable
     elif isinstance(model, WideDeepModel):
         if emb_cache:
             from .embcache import CachedWideDeepServable

@@ -118,6 +118,11 @@ class ServingMetrics:
         self._fill = self.group.gauge("batch_fill_ratio")
         self._p50 = self.group.gauge("latency_p50_ms")
         self._p99 = self.group.gauge("latency_p99_ms")
+        #: retrieval quality (ISSUE 19): sampled-query recall@k against
+        #: an exact scan (``retrieval/metrics.py::RecallProbe``); NaN =
+        #: no probe has published — absent in exports, never a fake 1.0
+        self._recall_probe = self.group.gauge("recall_probe")
+        self._recall_probe.set(float("nan"))
         self._rate = self.group.gauge("requests_per_sec")
         self._generation = self.group.gauge("model_generation")
         self.latency = LatencyTracker(latency_window)
@@ -202,6 +207,15 @@ class ServingMetrics:
     @property
     def staleness_seconds(self) -> float:
         return self._staleness.value
+
+    def on_recall_probe(self, value: float) -> None:
+        """A retrieval recall probe published its running mean (see
+        ``retrieval/metrics.py::RecallProbe.publish``)."""
+        self._recall_probe.set(float(value))
+
+    @property
+    def recall_probe(self) -> float:
+        return self._recall_probe.value
 
     def on_submit(self, queue_depth: int) -> None:
         self._queue_depth.set(queue_depth)

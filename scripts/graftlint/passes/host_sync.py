@@ -68,6 +68,12 @@ SCAN_CALLEES = {"scan", "masked_chunk_scan", "while_loop", "fori_loop"}
 #: step-shaped helper here would fence training AND serving dispatch
 #: streams at once; calibration is host-side numpy by design, but it
 #: runs at publish/bind time, never inside a step body)
+#: (``retrieval/`` joined with ISSUE 19: the fused retrieve stage traces
+#: into every index tenant's serving program through the shared plan
+#: jit — a host sync in a step-shaped helper there would fence the
+#: multiplexed serve loop exactly like one in ``serving/`` would; index
+#: BUILD is host-side numpy by design, but it runs at build/re-anchor
+#: time, never inside the dispatched search)
 SCAN_ROOTS = (
     "flink_ml_tpu/autoscale",
     "flink_ml_tpu/iteration",
@@ -77,6 +83,7 @@ SCAN_ROOTS = (
     "flink_ml_tpu/online",
     "flink_ml_tpu/ops",
     "flink_ml_tpu/parallel",
+    "flink_ml_tpu/retrieval",
     "flink_ml_tpu/serving",
 )
 

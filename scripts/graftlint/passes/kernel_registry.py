@@ -18,8 +18,11 @@ and PR 10 removed by hand:
   forks dispatch policy per call site instead of resolving it once in
   the registry's availability/supports predicates.
 
-Scope-fixed to ``flink_ml_tpu/models`` — ``ops/`` is where pallas_call
-belongs, and the registry itself obviously names backends.
+Scope-fixed to ``flink_ml_tpu/models`` plus (ISSUE 19)
+``flink_ml_tpu/retrieval`` — the index layer looks ``retrieve`` up
+exactly like a model family looks up its op, so the same two bypass
+idioms apply; ``ops/`` is where pallas_call belongs, and the registry
+itself obviously names backends.
 """
 
 from __future__ import annotations
@@ -40,7 +43,7 @@ class KernelRegistryPass(LintPass):
     describes = ("models/ must dispatch kernels through the kernel "
                  "registry (no direct pallas_call, no use_pallas-style "
                  "backend branching)")
-    roots = ("flink_ml_tpu/models",)
+    roots = ("flink_ml_tpu/models", "flink_ml_tpu/retrieval")
     scope_fixed = True
     hint = ("register the implementation in kernels/registry.py (op, "
             "backend, supports, available) and resolve it with "

@@ -1790,3 +1790,107 @@ def test_corrupt_int8_aot_entry_quarantines_and_recompiles_same_codes(
         assert any(".corrupt" in n for n in os.listdir(exec_root))
     finally:
         aot.set_cache(None)
+
+
+# -- retrieval chaos (ISSUE 19) ----------------------------------------------
+
+def _retrieve_endpoint():
+    from flink_ml_tpu.retrieval import IVFIndex
+    from flink_ml_tpu.serving import serve_model
+
+    rng = np.random.default_rng(190)
+    X = rng.normal(size=(240, 16)).astype(np.float32)
+    idx = IVFIndex.build(X, nlist=8, k=5, nprobe=8, seed=1,
+                         drift_threshold=None)
+    q = Table({"query": rng.normal(size=(8, 16)).astype(np.float32)})
+    endpoint = serve_model(idx, q.take(2), max_batch_rows=32,
+                           max_wait_ms=0.5)
+    return endpoint, idx, q
+
+
+def test_crash_mid_index_delta_publish_heals_idempotently():
+    """ISSUE 19 chaos half one: a crash injected INSIDE the index delta
+    publish (before the registry swap) leaves the old generation
+    serving bit-stable, and the replayed publish of the SAME cut lands
+    idempotently — the digest-verified codec never acknowledged the
+    crashed cut, so re-encoding step 1 reproduces it exactly — after
+    which the new generation serves the inserted vectors.  Each
+    generation's neighbor sets are bit-stable across repeat predicts."""
+    from flink_ml_tpu.online import DeltaEncoder
+
+    endpoint, idx, q = _retrieve_endpoint()
+    try:
+        old_a = np.asarray(endpoint.predict(q)["neighbors"])
+        old_b = np.asarray(endpoint.predict(q)["neighbors"])
+        np.testing.assert_array_equal(old_a, old_b)   # bit-stable gen 1
+        gen0 = endpoint.registry.current("default").generation
+
+        mode, nxt = idx.updated(inserts=np.asarray(q["query"]))
+        assert mode == "delta"
+        pub = endpoint.delta_publisher()
+        enc = DeltaEncoder()
+        plan = FaultPlan().inject("serving.publish", at=0, kind="crash")
+        with plan, pytest.raises(InjectedCrash):
+            pub.apply(enc.encode(1, nxt.params, pub.stats))
+        # crash BEFORE the swap: the old generation keeps serving the
+        # old lists, and the cut stays unacknowledged
+        assert endpoint.registry.current("default").generation == gen0
+        np.testing.assert_array_equal(
+            np.asarray(endpoint.predict(q)["neighbors"]), old_a)
+
+        # the supervised replay: re-encode the same step, republish
+        res = pub.apply(enc.encode(1, nxt.params, pub.stats))
+        enc.ack()
+        assert res.generation == gen0 + 1
+        new_a = np.asarray(endpoint.predict(q)["neighbors"])
+        new_b = np.asarray(endpoint.predict(q)["neighbors"])
+        np.testing.assert_array_equal(new_a, new_b)   # bit-stable gen 2
+        # the queries themselves were inserted: each is now its own NN
+        np.testing.assert_array_equal(new_a[:, 0], np.arange(240, 248))
+    finally:
+        endpoint.close()
+
+
+def test_corrupt_retrieve_aot_entry_quarantines_and_recompiles_same_neighbors(
+        tmp_path):
+    """ISSUE 19 chaos half two: flip a byte in a persisted retrieve-plan
+    executable, restart the cache (fresh ``ExecutableCache`` over the
+    same root): warm-up quarantines the entry, recompiles
+    transparently, and — the search plan being a pure function of the
+    index params — the rebuilt program serves the exact same neighbor
+    sets as the pre-corruption reference."""
+    from flink_ml_tpu.kernels import aot
+    from flink_ml_tpu.kernels.registry import kernel_stats
+    from flink_ml_tpu.retrieval import IVFIndex
+    from flink_ml_tpu.serving import make_servable
+
+    rng = np.random.default_rng(191)
+    X = rng.normal(size=(200, 16)).astype(np.float32)
+    idx = IVFIndex.build(X, nlist=8, k=5, nprobe=4, seed=2)
+    q = Table({"query": rng.normal(size=(8, 16)).astype(np.float32)})
+    root = str(tmp_path / "aotcache")
+    aot.set_cache(aot.ExecutableCache(root))
+    try:
+        sv = make_servable(idx, q.take(2), max_batch_rows=8,
+                           min_bucket=8).warm_up()
+        ref = np.asarray(sv.predict(q)["neighbors"])
+        exec_root = os.path.join(root, "exec")
+        entries = [os.path.join(exec_root, n)
+                   for n in sorted(os.listdir(exec_root))
+                   if ".corrupt" not in n and ".tmp." not in n]
+        assert entries, "retrieve warm-up persisted no AOT entries"
+        for entry in entries:
+            corrupt_file(os.path.join(entry, "executable.bin"),
+                         mode="flip")
+        # restarted process: fresh cache object, same directory
+        aot.set_cache(aot.ExecutableCache(root))
+        before = kernel_stats.snapshot()["aot"]
+        sv2 = make_servable(idx, q.take(2), max_batch_rows=8,
+                            min_bucket=8).warm_up()
+        out = np.asarray(sv2.predict(q)["neighbors"])
+        after = kernel_stats.snapshot()["aot"]
+        np.testing.assert_array_equal(out, ref)
+        assert after["quarantined"] >= before["quarantined"] + 1
+        assert any(".corrupt" in n for n in os.listdir(exec_root))
+    finally:
+        aot.set_cache(None)

@@ -1024,15 +1024,28 @@ def test_kernel_registry_accepts_registry_lookup(tmp_path):
     assert problems == []
 
 
-def test_kernel_registry_scope_is_models_tree():
+def test_kernel_registry_scope_is_models_and_retrieval_trees():
     """scope_fixed: pointing graftlint at flink_ml_tpu must not run the
-    models-layer rule over ops/ (where pallas_call lives by design)."""
+    dispatch-layer rule over ops/ (where pallas_call lives by design).
+    ISSUE 19 grew the scope to ``retrieval/`` — the index layer looks
+    ``retrieve`` up exactly like the model families look up their ops,
+    so the bypass idioms are flagged there too, and the pass must
+    genuinely VISIT the new modules (a root listing that misses them
+    guards nothing)."""
     from scripts.graftlint.passes.kernel_registry import KernelRegistryPass
 
     p = KernelRegistryPass()
-    assert p.scope_fixed and p.roots == ("flink_ml_tpu/models",)
+    assert p.scope_fixed
+    assert p.roots == ("flink_ml_tpu/models", "flink_ml_tpu/retrieval")
     project = Project(repo=REPO)
     assert p.run(project, ["flink_ml_tpu"]) == []
+    visited = {
+        os.path.relpath(m.path, REPO)
+        for m in project.iter_modules(
+            [os.path.join(REPO, r) for r in p.roots])}
+    for name in ("ivf.py", "metrics.py"):
+        rel = os.path.join("flink_ml_tpu", "retrieval", name)
+        assert rel in visited, f"kernel-registry never visits {rel}"
 
 
 # ---------------------------------------------------------------------------
@@ -1272,6 +1285,32 @@ def test_kernels_modules_visited_by_host_sync():
     assert "flink_ml_tpu/kernels" in SCAN_ROOTS
     modules = [os.path.join("flink_ml_tpu", "kernels", f)
                for f in ("quantize.py", "registry.py", "aot.py")]
+    project = Project(repo=REPO)
+    visited = {
+        os.path.relpath(m.path, REPO)
+        for m in project.iter_modules(
+            [os.path.join(REPO, r) for r in SCAN_ROOTS])}
+    for rel in modules:
+        assert rel in visited, f"host-sync never visits {rel}"
+        mod = project.module(os.path.join(REPO, rel))
+        assert HostSyncPass().check_module(mod, project) == []
+
+
+def test_retrieval_modules_visited_by_host_sync():
+    """ISSUE 19: ``flink_ml_tpu/retrieval/`` joined the host-sync scan —
+    the fused retrieve stage traces into every index tenant's serving
+    program through the shared plan jit, so a host sync in a
+    step-shaped helper there would stall the multiplexed serve loop
+    exactly like one in ``serving/`` would.  Assert SCAN_ROOTS carries
+    the root, the walk genuinely VISITS the retrieval modules (a root
+    that matches nothing keeps the rule from ever firing), and every
+    one is clean: index build/re-anchor is host numpy by design, but it
+    runs at build time, never inside the dispatched search."""
+    from scripts.graftlint.passes.host_sync import SCAN_ROOTS
+
+    assert "flink_ml_tpu/retrieval" in SCAN_ROOTS
+    modules = [os.path.join("flink_ml_tpu", "retrieval", f)
+               for f in ("ivf.py", "metrics.py")]
     project = Project(repo=REPO)
     visited = {
         os.path.relpath(m.path, REPO)

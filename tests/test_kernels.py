@@ -102,7 +102,7 @@ def test_catalog_registers_every_documented_op():
     ops = kreg.ops()
     for op in ("ell_margin", "ell_scatter_apply", "gbt_level_histograms",
                "kmeans_assign", "kmeans_update_stats",
-               "kmeans_workset_update", "linear_margins",
+               "kmeans_workset_update", "linear_margins", "retrieve",
                "routed_table_grad", "widedeep_scores"):
         assert op in ops, f"catalog lost op {op}"
     # every op has the automatic non-TPU fallback registered
@@ -504,6 +504,146 @@ def _parity_widedeep_scores(backends):
             f"widedeep_scores[{b}] score rank correlation {corr}"
 
 
+# -- retrieve harnesses (ISSUE 19) ------------------------------------------
+# The fused scan+top-k stage promises BITWISE agreement between backends:
+# both run under jit (eager XLA makes different fma-contraction choices
+# than the plan jit does, so the harness compares like-for-like), and the
+# shared pq_lut/decode helpers carry a runtime-1.0 rounding pin so
+# fusion-cluster shape cannot reorder the float graph.  Parity alone is
+# NOT enough for a nearest-neighbor kernel — two backends can agree
+# bit-for-bit on a wrong answer — so every retrieve backend must ALSO
+# clear two quality gates of its own: exact agreement with a float64
+# brute-force oracle at nprobe == nlist, and the recall envelope
+# (recall@10 >= 0.95 at the reference nprobe while provably scanning
+# <= 25% of the corpus).  The coverage gate below makes a backend missing
+# EITHER harness fail this file by construction.
+
+import functools
+
+RECALL_ENVELOPE = 0.95      # recall@10 floor at the reference nprobe
+SCAN_BUDGET = 0.25          # ... while scanning at most this corpus slice
+
+
+@functools.lru_cache(maxsize=None)
+def _retrieve_fixture(kind):
+    """(index, queries) fixtures per shape class, built once per run."""
+    from flink_ml_tpu.retrieval import IVFIndex, PQConfig
+
+    rng = np.random.default_rng(19)
+    if kind == "flat-small":        # continuous data: full-probe oracle
+        X = rng.normal(size=(600, 32)).astype(np.float32)
+        idx = IVFIndex.build(X, nlist=8, k=10, nprobe=4, seed=1)
+        q = rng.normal(size=(16, 32)).astype(np.float32)
+    elif kind == "pq-small":
+        X = rng.normal(size=(600, 32)).astype(np.float32)
+        idx = IVFIndex.build(X, nlist=8, k=10, nprobe=4, seed=1,
+                             pq=PQConfig(m=8, ksub=16))
+        q = rng.normal(size=(16, 32)).astype(np.float32)
+    elif kind == "clustered":       # separated modes: the recall op point
+        centers = rng.normal(size=(64, 16)).astype(np.float32) * 10.0
+        assign = rng.integers(0, 64, size=2048)
+        X = (centers[assign]
+             + rng.normal(size=(2048, 16)) * 0.5).astype(np.float32)
+        idx = IVFIndex.build(X, nlist=64, k=10, nprobe=8, seed=2)
+        pick = rng.choice(2048, size=32, replace=False)
+        q = (X[pick] + rng.normal(size=(32, 16)) * 0.05).astype(np.float32)
+    else:
+        raise AssertionError(kind)
+    return idx, q
+
+
+def _retrieve_backend_run(index, queries, backend, *, nprobe=None):
+    """Run ONE backend's retrieve stage the way production runs it: under
+    jit (interpret mode for the TPU backend on CPU hosts)."""
+    from flink_ml_tpu.retrieval.ivf import _DIST_STAGE, _NN_STAGE
+
+    idx = index if nprobe is None else index.with_options(nprobe=nprobe)
+    entry = lookup("retrieve", sig=idx.sig(), backend=backend)
+    static = idx._static()
+    params = {k: jnp.asarray(v) for k, v in idx.params.items()}
+    cols = {idx.query_col: jnp.asarray(queries)}
+    if backend == "pallas":
+        out = entry.fn(static, params, cols, interpret=True)
+    else:
+        out = jax.jit(lambda p, c: entry.fn(static, p, c))(params, cols)
+    return np.asarray(out[_NN_STAGE]), np.asarray(out[_DIST_STAGE])
+
+
+def _parity_retrieve(backends):
+    for kind in ("flat-small", "pq-small"):
+        idx, q = _retrieve_fixture(kind)
+        outs = {b: _retrieve_backend_run(idx, q, b) for b in backends}
+        nn_ref, d_ref = outs.pop("xla")
+        for b, (nn, d) in outs.items():
+            np.testing.assert_array_equal(
+                nn, nn_ref, err_msg=f"{kind}[{b}] neighbor ids")
+            # the fused contract: candidate distances never re-round
+            # differently per backend — BITWISE, not approx
+            np.testing.assert_array_equal(
+                d.view(np.uint32), d_ref.view(np.uint32),
+                err_msg=f"{kind}[{b}] distance bits")
+
+
+def _retrieve_oracle(backend):
+    """Brute-force oracle: at nprobe == nlist the index scans everything,
+    so the neighbor sets must EQUAL the float64 exact scan's (continuous
+    data — ties have measure zero)."""
+    from flink_ml_tpu.retrieval import exact_neighbors
+
+    idx, q = _retrieve_fixture("flat-small")
+    ids, X = idx.stored_vectors()
+    expect = exact_neighbors(q, X, ids, idx.k)
+    nn, dist = _retrieve_backend_run(idx, q, backend, nprobe=idx.nlist)
+    np.testing.assert_array_equal(nn, expect, err_msg=f"oracle[{backend}]")
+    assert np.all(np.diff(dist, axis=1) >= 0), "distances not ascending"
+
+
+def _retrieve_recall(backend):
+    """Recall envelope: recall@10 >= 0.95 at the index's reference nprobe
+    while the probed lists hold <= 25% of the corpus (asserted from the
+    real posting-list counts, not assumed)."""
+    from flink_ml_tpu.retrieval import exact_neighbors, recall_at_k
+
+    idx, q = _retrieve_fixture("clustered")
+    frac = idx.scan_fraction(q)
+    assert frac <= SCAN_BUDGET, f"scan fraction {frac} over budget"
+    ids, X = idx.stored_vectors()
+    expect = exact_neighbors(q, X, ids, idx.k)
+    nn, _ = _retrieve_backend_run(idx, q, backend)
+    rec = recall_at_k(nn, expect)
+    assert rec >= RECALL_ENVELOPE, (
+        f"recall[{backend}] {rec} at nprobe={idx.nprobe} "
+        f"(scan fraction {frac})")
+
+
+#: both quality gates, keyed for the parametrized matrix below
+_RETRIEVE_QUALITY = {"oracle": _retrieve_oracle, "recall": _retrieve_recall}
+
+#: every registered retrieve backend must be listed here — the harnesses
+#: above run per backend, so listing IS coverage
+_RETRIEVE_BACKENDS = ("pallas", "xla")
+
+
+def test_every_retrieve_backend_has_quality_harnesses():
+    """ISSUE 19 coverage gate: a retrieve backend registered without BOTH
+    the brute-force-oracle harness and the recall-envelope harness fails
+    by construction."""
+    regd = set(kreg.backends("retrieve"))
+    missing = regd - set(_RETRIEVE_BACKENDS)
+    assert not missing, (
+        f"retrieve backend(s) {sorted(missing)} registered without "
+        "oracle+recall quality harnesses — add them to "
+        "_RETRIEVE_BACKENDS and make both gates pass")
+    stale = set(_RETRIEVE_BACKENDS) - regd
+    assert not stale, f"_RETRIEVE_BACKENDS lists unregistered {sorted(stale)}"
+
+
+@pytest.mark.parametrize("backend", _RETRIEVE_BACKENDS)
+@pytest.mark.parametrize("gate", sorted(_RETRIEVE_QUALITY))
+def test_retrieve_quality_gates(gate, backend):
+    _RETRIEVE_QUALITY[gate](backend)
+
+
 _PARITY = {
     "ell_margin": _parity_ell_margin,
     "ell_scatter_apply": _parity_ell_scatter_apply,
@@ -512,6 +652,7 @@ _PARITY = {
     "kmeans_update_stats": _parity_kmeans_update_stats,
     "kmeans_workset_update": _parity_kmeans_workset_update,
     "linear_margins": _parity_linear_margins,
+    "retrieve": _parity_retrieve,
     "routed_table_grad": _parity_routed_table_grad,
     "widedeep_scores": _parity_widedeep_scores,
 }
