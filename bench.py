@@ -4423,6 +4423,196 @@ def bench_retrieval(results: dict) -> None:
         q["publish_error"] = repr(exc)[:200]
 
 
+def bench_failover(results: dict) -> None:
+    """Serving fleet failover leg (failover_metric_version 1, ISSUE 20):
+    kill one chip of a 4-chip fleet at a dispatch boundary under a live
+    closed-loop client sweep, twice — once with the victim tenant
+    placed on a single chip (full move + re-admission) and once 2-way
+    replicated (a survivor keeps serving; the failover window is one
+    dispatch, no re-warm).
+
+    - **Recovery wall**: the FailoverReport's detection -> recovered
+      span (requeue + CAS re-placement on the shared generation stream
+      + re-admission), per variant.
+    - **Interactive p99 before/during/after** the kill — the brownout
+      ladder sheds bulk at admission while the fleet is short, so the
+      protected class's tail should move little across the fault.
+    - **Drops**: every client request across the kill must be answered
+      — ``failover_dropped_requests`` MUST be 0 (the requeue keeps
+      futures intact; retried answers are bit-identical, asserted in
+      tests/test_faults.py).
+    - **Replication A/B**: replicated recovery wall / unreplicated —
+      what the params-only HBM copy buys.
+
+    Measured fields are null, never faked, when a sub-leg fails."""
+    import threading
+
+    from flink_ml_tpu import Table
+    from flink_ml_tpu.autoscale.placement import PlacementStore
+    from flink_ml_tpu.models.classification.logisticregression import (
+        LogisticRegressionModel)
+    from flink_ml_tpu.robustness import FaultPlan
+    from flink_ml_tpu.serving import (DISPATCH_SCOPE, FailoverDriver,
+                                      ServingOverloadedError,
+                                      SharedScheduler)
+
+    smoke = _smoke()
+    n_clients = 16 if smoke else 64
+    per_phase = 25 if smoke else 100
+    d = 32
+
+    fo: dict = {
+        "failover_metric_version": 1,
+        "config": f"LR d={d}, victim tenant + 1 bulk tenant on a 4-chip "
+                  f"placement, {n_clients} closed-loop interactive "
+                  f"clients x {per_phase} reqs per phase "
+                  "(before/during/after), chip_down injected at a "
+                  "dispatch boundary early in 'during'; A/B: victim "
+                  "solo-placed vs 2-way replicated",
+        "unreplicated": None,
+        "replicated": None,
+        "p99_before_ms": None,
+        "p99_during_ms": None,
+        "p99_after_ms": None,
+    }
+    results["notes"]["failover"] = fo
+    # headline fields: pre-nulled at leg entry, never faked
+    results.setdefault("failover_recovery_s", None)
+    results.setdefault("failover_dropped_requests", None)
+    results.setdefault("failover_replicated_recovery_ratio", None)
+
+    rng = np.random.default_rng(23)
+    model = LogisticRegressionModel()
+    model.set_model_data(Table({
+        "coefficients": rng.normal(size=(1, d)),
+        "intercept": np.array([0.1])}))
+    feats = Table({"features": rng.normal(size=(1024, d))
+                   .astype(np.float32)})
+
+    def run_variant(replicas):
+        """One full kill-and-recover pass; returns the variant record
+        (recovery wall, phase p99s, drops, failover audit fields)."""
+        sched = SharedScheduler(max_batch_rows=64, max_wait_ms=0.5,
+                                queue_capacity=1 << 13)
+        try:
+            sched.add_tenant("inter", model, feats.take(2),
+                             slo="interactive")
+            sched.add_tenant("bulk0", model, feats.take(2), slo="bulk")
+            store = PlacementStore(4)
+            # victim tenant on chip 3 — the newest lease, the
+            # deterministic LIFO victim of the injected death
+            store.publish({"inter": [3], "bulk0": [0]}, 0)
+            driver = FailoverDriver(sched, store, chips=[0, 1, 2, 3])
+            if replicas > 1:
+                driver.ensure_replicas("inter", replicas)
+            sched.start()
+
+            drops: list = []
+            bulk_sheds = [0]
+
+            def sweep(samples):
+                lock = threading.Lock()
+
+                def client(worker):
+                    crng = np.random.default_rng(300 + worker)
+                    mine = []
+                    try:
+                        for i in range(per_phase):
+                            start = int(crng.integers(0, 1000))
+                            rows = int(crng.integers(1, 5))
+                            req = feats.slice(start, start + rows)
+                            t0 = time.perf_counter()
+                            sched.predict("inter", req, timeout=120)
+                            mine.append(time.perf_counter() - t0)
+                            if i % 4 == 0:
+                                # background bulk traffic: sheds are
+                                # EXPECTED once the brownout raises —
+                                # that is the ladder working, not a drop
+                                try:
+                                    sched.submit(
+                                        "bulk0", feats.take(8))
+                                except ServingOverloadedError:
+                                    with lock:
+                                        bulk_sheds[0] += 1
+                            time.sleep(0.001)
+                    except Exception as exc:   # noqa: BLE001
+                        with lock:
+                            drops.append(repr(exc)[:200])
+                    with lock:
+                        samples.extend(mine)
+
+                threads = [threading.Thread(target=client, args=(w,))
+                           for w in range(n_clients)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(300)
+
+            def p99_ms(samples):
+                return (round(1e3 * float(np.quantile(
+                    np.asarray(samples), 0.99)), 3)
+                    if samples else None)
+
+            warm: list = []
+            sweep(warm)                       # every path compiled+warm
+            before: list = []
+            sweep(before)
+            during: list = []
+            plan = FaultPlan(seed=20).inject(DISPATCH_SCOPE, at=5,
+                                             kind="chip_down")
+            with plan:
+                sweep(during)
+            after: list = []
+            sweep(after)
+
+            if len(driver.reports) != 1:
+                raise RuntimeError(
+                    f"expected exactly one failover, saw "
+                    f"{len(driver.reports)} (fires={plan.fires})")
+            rep = driver.reports[0]
+            return {
+                "recovery_s": round(rep.wall_s, 6),
+                "requeued": rep.requeued,
+                "moved": list(rep.moved),
+                "kept_replica": list(rep.replicated),
+                "conflicts": rep.conflicts,
+                "placement_generation": rep.generation,
+                "brownout_level": driver.brownout_level,
+                "bulk_sheds": bulk_sheds[0],
+                "drops": len(drops),
+                "deadline_sheds": sched._deadline_shed.value,
+                "p99_before_ms": p99_ms(before),
+                "p99_during_ms": p99_ms(during),
+                "p99_after_ms": p99_ms(after),
+            }
+        finally:
+            sched.close()
+
+    total_drops = None
+    try:
+        solo = run_variant(replicas=1)
+        fo["unreplicated"] = solo
+        fo["p99_before_ms"] = solo["p99_before_ms"]
+        fo["p99_during_ms"] = solo["p99_during_ms"]
+        fo["p99_after_ms"] = solo["p99_after_ms"]
+        results["failover_recovery_s"] = solo["recovery_s"]
+        total_drops = solo["drops"]
+    except Exception as exc:   # noqa: BLE001 — nulled, never faked
+        fo["unreplicated_error"] = repr(exc)[:200]
+    try:
+        repl = run_variant(replicas=2)
+        fo["replicated"] = repl
+        if total_drops is not None:
+            total_drops += repl["drops"]
+        if fo["unreplicated"] is not None \
+                and solo["recovery_s"] > 0:
+            results["failover_replicated_recovery_ratio"] = round(
+                repl["recovery_s"] / solo["recovery_s"], 3)
+    except Exception as exc:   # noqa: BLE001 — nulled, never faked
+        fo["replicated_error"] = repr(exc)[:200]
+    results["failover_dropped_requests"] = total_drops
+
+
 def main() -> None:
     tpu_ok = _probe_tpu_backend()
     if not tpu_ok:
@@ -4463,7 +4653,7 @@ def main() -> None:
                 bench_comm, bench_wal, bench_recovery, bench_online,
                 bench_kernels, bench_coldstart, bench_obs,
                 bench_multitenant, bench_int8, bench_retrieval,
-                bench_elastic, bench_autoscale):
+                bench_failover, bench_elastic, bench_autoscale):
         try:
             leg(results)
         except Exception as exc:   # noqa: BLE001

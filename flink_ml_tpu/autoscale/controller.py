@@ -63,6 +63,7 @@ class AutoscaleController:
     def __init__(self, *, store: PlacementStore, policy: AutoscalePolicy,
                  signals: SignalSource,
                  scheduler: Any = None, elastic: Any = None,
+                 health: Any = None,
                  clock: Callable[[], float] = time.monotonic,
                  interval_s: float = 1.0):
         if interval_s <= 0:
@@ -72,6 +73,14 @@ class AutoscaleController:
         self.signals = signals
         self.scheduler = scheduler
         self.elastic = elastic
+        #: the serving fleet's lease table (ISSUE 20): when wired, the
+        #: layout skips declared-dead chips, so a controller tick racing
+        #: a failover converges onto the SAME survivor set — the two
+        #: writers already share one placement generation stream (CAS);
+        #: sharing the health view means the retry loser re-derives an
+        #: edit the winner would also have made, never a re-placement
+        #: back onto a dead chip
+        self.health = health
         self.clock = clock
         self.interval_s = interval_s
         self.ticks = 0
@@ -87,7 +96,7 @@ class AutoscaleController:
     @classmethod
     def build(cls, tree: Any, *, store: PlacementStore,
               policy_config: Any, scheduler: Any = None,
-              elastic: Any = None,
+              elastic: Any = None, health: Any = None,
               clock: Callable[[], float] = time.monotonic,
               learner_tenant: Optional[str] = None,
               interval_s: float = 1.0) -> "AutoscaleController":
@@ -98,8 +107,8 @@ class AutoscaleController:
                                learner_tenant=learner_tenant)
         policy = AutoscalePolicy(policy_config, clock=clock)
         return cls(store=store, policy=policy, signals=signals,
-                   scheduler=scheduler, elastic=elastic, clock=clock,
-                   interval_s=interval_s)
+                   scheduler=scheduler, elastic=elastic, health=health,
+                   clock=clock, interval_s=interval_s)
 
     # -- placement synthesis -------------------------------------------------
     def _tenant_names(self) -> List[str]:
@@ -112,8 +121,15 @@ class AutoscaleController:
         every servable spans the whole serving slice (chips
         ``[0, serving_chips)`` — the learner owns the top of the pool),
         which is exactly the PR 14 shared-device posture; the WFQ layer,
-        not the chip boundary, arbitrates between servables."""
+        not the chip boundary, arbitrates between servables.  With a
+        fleet-health view wired (ISSUE 20), declared-dead chips drop
+        out of the slice — a tick landing mid-failover lays out onto
+        the survivors, never back onto the corpse."""
         chips = list(range(serving_chips))
+        if self.health is not None:
+            down = set(self.health.down())
+            live = [c for c in chips if c not in down]
+            chips = live or chips
         return {name: chips for name in self._tenant_names()}
 
     # -- actuation -----------------------------------------------------------

@@ -155,8 +155,16 @@ class AutoscalePolicy:
         return None
 
     def _trough(self, frame: SignalFrame) -> Optional[str]:
-        """The yield-to-training trigger, or None."""
+        """The yield-to-training trigger, or None.  An active brownout
+        (ISSUE 20) vetoes the yield outright: the fleet is
+        capacity-short after a chip loss and whole classes are being
+        shed at admission — handing chips to the learner now would
+        fight the failover driver's recovery (idle fraction can look
+        deceptively high mid-failover because browned-out classes stop
+        arriving)."""
         cfg = self.config
+        if getattr(frame, "brownout_level", 0) > 0:
+            return None
         p99 = frame.interactive_p99_ms
         p99_low = (not math.isfinite(p99)
                    or p99 <= cfg.low_frac * cfg.p99_target_ms)

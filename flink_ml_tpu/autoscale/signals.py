@@ -89,6 +89,11 @@ class SignalFrame:
     #: correlation ONLY; the policy never keys a decision on it (the
     #: publish-storm immunity contract, tested)
     max_generation: float
+    #: the scheduler's brownout ladder rung (ISSUE 20): nonzero while a
+    #: failover has the fleet capacity-short and classes are being shed
+    #: at admission — the policy holds capacity-yielding moves while it
+    #: is up (shrinking serving mid-failover would fight the driver)
+    brownout_level: int = 0
 
 
 class SignalSource:
@@ -186,7 +191,8 @@ class SignalSource:
             fleet_size=int(_num(elastic.get("fleet_size"), 0.0)),
             membership_epoch=int(_num(elastic.get("membership_epoch"),
                                       0.0)),
-            max_generation=max_generation)
+            max_generation=max_generation,
+            brownout_level=int(_num(sched.get("brownout_level"), 0.0)))
         self._prev_at = now
         self.samples += 1
         return frame

@@ -108,7 +108,8 @@ def default_tree(*, endpoint: Any = None, serving: Any = None,
                  scheduler: Any = None, recovery: Any = None,
                  stream_info: Any = None, iteration_result: Any = None,
                  tracer: Any = None, elastic: Any = None,
-                 autoscale: Any = None) -> MetricsTree:
+                 autoscale: Any = None,
+                 failover: Any = None) -> MetricsTree:
     """A :class:`MetricsTree` pre-wired to every standard surface that
     exists in this process:
 
@@ -143,7 +144,13 @@ scheduler.SharedScheduler`'s subtree (class-labeled shed counters,
       :class:`~flink_ml_tpu.autoscale.controller.AutoscaleController`'s
       self-view (ticks, actuations, decision latency, the policy's
       decision ledger, the live placement generation — ISSUE 17), so
-      the control plane is observable through the same tree it reads.
+      the control plane is observable through the same tree it reads;
+    - ``failover`` — a
+      :class:`~flink_ml_tpu.serving.failover.FailoverDriver`'s fleet
+      view (chips live/down, brownout level, failover/requeue/conflict
+      counters, last failover wall — ISSUE 20), so a p99 excursion in
+      the same snapshot is attributable to the chip loss that caused
+      it.
     """
     from ..kernels.registry import kernel_stats
 
@@ -172,6 +179,8 @@ scheduler.SharedScheduler`'s subtree (class-labeled shed counters,
         tree.register("elastic", elastic)
     if autoscale is not None:
         tree.register("autoscale", autoscale)
+    if failover is not None:
+        tree.register("failover", failover)
     return tree
 
 

@@ -27,6 +27,8 @@ first-class, *injectable*, tested input to the whole stack:
 
 from .faults import (
     FaultPlan,
+    InjectedChipDown,
+    InjectedChipFlap,
     InjectedCrash,
     InjectedDiskFullError,
     InjectedJoin,
@@ -50,7 +52,8 @@ from .retry import RetryPolicy, default_classify, retry_call
 from .supervisor import RecoveryEvent, RecoveryReport, resilient_fit
 
 __all__ = [
-    "FaultPlan", "InjectedCrash", "InjectedDiskFullError",
+    "FaultPlan", "InjectedChipDown", "InjectedChipFlap",
+    "InjectedCrash", "InjectedDiskFullError",
     "InjectedJoin", "InjectedPreemption",
     "InjectedTransientError", "corrupt_file", "fault_point",
     "COMMIT_MARKER", "MANIFEST_NAME", "CorruptStateError", "commit_dir",

@@ -41,11 +41,20 @@ Fault kinds:
   (:meth:`FaultPlan.inject_random` works unchanged), and — because
   :meth:`FaultPlan.fire` runs BEFORE the wrapped operation —
   ``wrap_source``-style wrappers stay lossless across a resize: a
-  membership fault never consumes an item.
+  membership fault never consumes an item;
+- ``"chip_down"`` / ``"chip_flap"`` — **fleet** faults (serving
+  failover, ISSUE 20): raise :class:`InjectedChipDown` /
+  :class:`InjectedChipFlap` at the scheduler's DISPATCH boundary
+  (``serving.dispatch`` — fired before ``predict`` runs, so the
+  picked micro-batch is requeued intact and the schedule stays
+  lossless/replayable).  The attached
+  :class:`~flink_ml_tpu.serving.failover.FailoverDriver` translates
+  the raise into a deterministic chip-death (``chip_flap`` adds a
+  scheduled recovery) exactly like the membership pair above.
 
-Control faults (transient/crash/enospc and the membership pair) are
-valid at every scope; data faults only where a file path reaches the
-injection point.
+Control faults (transient/crash/enospc, the membership pair, and the
+fleet pair) are valid at every scope; data faults only where a file
+path reaches the injection point.
 """
 
 from __future__ import annotations
@@ -57,8 +66,8 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 __all__ = [
-    "FaultPlan", "InjectedCrash", "InjectedDiskFullError",
-    "InjectedJoin", "InjectedPreemption",
+    "FaultPlan", "InjectedChipDown", "InjectedChipFlap", "InjectedCrash",
+    "InjectedDiskFullError", "InjectedJoin", "InjectedPreemption",
     "InjectedTransientError", "corrupt_file", "fault_point", "active_plan",
 ]
 
@@ -96,7 +105,25 @@ class InjectedJoin(RuntimeError):
     translated by the coordinator's ``poll`` into a join transition."""
 
 
-_CONTROL_KINDS = ("transient", "crash", "enospc", "preempt", "join")
+class InjectedChipDown(RuntimeError):
+    """A fleet fault: one serving chip died.  Raised at the DISPATCH
+    boundary BEFORE the micro-batch's predict runs (nothing is served,
+    nothing is lost — the scheduler requeues the picked requests with
+    their futures intact) and translated by the attached failover
+    driver into a deterministic chip-death transition; NOT retryable at
+    the call site and never swallowed by a retry loop."""
+
+
+class InjectedChipFlap(RuntimeError):
+    """The flapping dual of :class:`InjectedChipDown`: the chip dies and
+    comes back shortly after (a deterministic number of health polls
+    later).  Same raise-before-dispatch lossless contract; the failover
+    driver's hysteresis is what keeps the flap from thrashing
+    placements."""
+
+
+_CONTROL_KINDS = ("transient", "crash", "enospc", "preempt", "join",
+                  "chip_down", "chip_flap")
 _DATA_KINDS = ("torn", "flip")
 
 
@@ -250,6 +277,12 @@ class FaultPlan:
             if spec.kind == "join":
                 raise InjectedJoin(
                     f"injected join at {scope}[{idx}]")
+            if spec.kind == "chip_down":
+                raise InjectedChipDown(
+                    f"injected chip death at {scope}[{idx}]")
+            if spec.kind == "chip_flap":
+                raise InjectedChipFlap(
+                    f"injected chip flap at {scope}[{idx}]")
             if path is None:
                 raise ValueError(
                     f"data fault {spec.kind!r} scheduled at {scope}[{idx}] "

@@ -11,10 +11,20 @@ Classification contract (:func:`default_classify`):
 
 | class | examples | retried? |
 |---|---|---|
+| deadline exceeded | :class:`DeadlineExceededError`, any exc with ``deadline_exceeded = True`` | **no** |
 | marked transient | :class:`~.faults.InjectedTransientError`, any exc with ``transient = True`` | yes |
 | connection/timeout | ``ConnectionError``, ``TimeoutError`` | yes |
 | transient errnos | ``EAGAIN``/``EINTR``/``EIO``/``EBUSY``/``ETIMEDOUT``/``ECONNRESET`` | yes |
 | everything else | ``ENOSPC``, corrupt state, ``ValueError``, crashes | no |
+
+Deadline-exceeded outranks the timeout rule on purpose (ISSUE 20): a
+hedged or requeued serving request that is already past its SLO
+deadline must SHED — the answer is worthless to the caller now, and a
+retry would burn survivor capacity exactly when a failover has made
+capacity scarce.  :class:`DeadlineExceededError` subclasses
+``TimeoutError`` so generic timeout handlers still catch it, but the
+``deadline_exceeded`` marker is checked FIRST so no retry loop ever
+resurrects it.
 
 The backoff schedule is pure arithmetic over the attempt index
 (``base * multiplier**i`` capped at ``max_delay`` — no RNG, no wall
@@ -30,8 +40,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, List
 
-__all__ = ["RetryPolicy", "RetryingIterator", "StreamRetryUnsupported",
-           "default_classify", "retry_call", "TRANSIENT_ERRNOS"]
+__all__ = ["DeadlineExceededError", "RetryPolicy", "RetryingIterator",
+           "StreamRetryUnsupported", "default_classify", "retry_call",
+           "TRANSIENT_ERRNOS"]
 
 #: errno values worth one more try: the OS said "later", not "never".
 TRANSIENT_ERRNOS = frozenset({
@@ -40,8 +51,23 @@ TRANSIENT_ERRNOS = frozenset({
 })
 
 
+class DeadlineExceededError(TimeoutError):
+    """A request blew past its SLO deadline (hedged/requeued serving
+    traffic after a failover is the canonical producer).  Fatal, not
+    retryable: the ``deadline_exceeded`` marker is classified BEFORE
+    the generic-``TimeoutError``-is-retryable rule, because retrying an
+    already-worthless answer burns survivor capacity exactly when a
+    chip loss has made it scarce — the request must shed instead."""
+
+    deadline_exceeded = True
+
+
 def default_classify(exc: BaseException) -> bool:
     """True = retryable.  See the module-doc table."""
+    if getattr(exc, "deadline_exceeded", False):
+        # checked before everything: DeadlineExceededError IS a
+        # TimeoutError, and the marker must outrank that retryable rule
+        return False
     if getattr(exc, "transient", False):
         return True
     if isinstance(exc, (ConnectionError, TimeoutError)):

@@ -29,7 +29,15 @@ requests while bounding tail latency:
   subtrees and ``tenant``-keyed trace spans,
 - :mod:`.embcache` — device-resident LRU embedding-row blocks for
   WideDeep's long-tail vocab: only the zipfian-hot blocks live in HBM,
-  scores stay bit-exact with offline ``transform``.
+  scores stay bit-exact with offline ``transform``,
+- :mod:`.failover` — serving fleet failover (ISSUE 20): a chip-lease
+  health table (the PR 15 idiom over serving chips), seeded
+  ``chip_down``/``chip_flap`` injection at the dispatch boundary with
+  lossless requeue (zero dropped requests, bit-identical retried
+  answers), CAS re-placement of a dead chip's tenants onto survivors
+  through the shared placement generation stream, an SLO-aware
+  brownout ladder with hysteresis, and optional N-way replication for
+  high-SLO tenants (params-only cost; failover window = one dispatch).
 
 Quick start::
 
@@ -56,11 +64,14 @@ from .batcher import MicroBatcher, ServingOverloadedError, ServingRequest
 from .embcache import CachedWideDeepServable, EmbeddingRowCache
 from .endpoint import ServingEndpoint, serve_model
 from .executor import ServableModel, make_servable
+from .failover import (CHIP_SCOPE, FailoverDriver, FailoverReport,
+                       FleetHealth)
 from .metrics import (HEALTH_DEGRADED, HEALTH_SERVING, LatencyTracker,
                       ServingMetrics)
 from .registry import DeployedModel, ModelRegistry
-from .scheduler import (SLO_BULK, SLO_CLASSES, SLO_INTERACTIVE,
-                        SLO_STANDARD, SharedScheduler, Tenant)
+from .scheduler import (DISPATCH_SCOPE, SLO_BULK, SLO_CLASSES,
+                        SLO_INTERACTIVE, SLO_STANDARD, SharedScheduler,
+                        Tenant)
 
 __all__ = [
     "MicroBatcher", "ServingOverloadedError", "ServingRequest",
@@ -72,4 +83,6 @@ __all__ = [
     "SharedScheduler", "Tenant",
     "SLO_INTERACTIVE", "SLO_STANDARD", "SLO_BULK", "SLO_CLASSES",
     "EmbeddingRowCache", "CachedWideDeepServable",
+    "CHIP_SCOPE", "DISPATCH_SCOPE",
+    "FleetHealth", "FailoverDriver", "FailoverReport",
 ]

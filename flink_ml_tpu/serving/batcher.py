@@ -181,6 +181,21 @@ class MicroBatcher:
                 self._cond.wait(remaining)
             return batch
 
+    def requeue(self, batch: List[ServingRequest]) -> int:
+        """Put a formed-but-undispatched batch BACK at the FRONT of the
+        queue (reversed, restoring the original order), futures and
+        request ids untouched — the chip-fault path (ISSUE 20): the
+        retried dispatch answers the same futures bit-identically, so
+        a chip death at the dispatch boundary drops ZERO requests.
+        Deliberately bypasses the capacity check: these requests were
+        already admitted once, and bouncing them now WOULD be a drop."""
+        with self._cond:
+            for request in reversed(batch):
+                self._pending.appendleft(request)
+            if batch:
+                self._cond.notify_all()
+        return len(batch)
+
     # -- lifecycle ----------------------------------------------------------
     @property
     def queue_depth(self) -> int:

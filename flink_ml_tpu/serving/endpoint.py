@@ -29,10 +29,13 @@ from typing import Any, List, Optional
 
 from ..data.table import Table
 from ..obs.trace import tracer
+from ..robustness.faults import (InjectedChipDown, InjectedChipFlap,
+                                 fault_point)
 from .batcher import (MicroBatcher, ServingOverloadedError,
                       ServingRequest, concat_request_tables)
 from .metrics import ServingMetrics
 from .registry import ModelRegistry
+from .scheduler import DISPATCH_SCOPE
 
 
 __all__ = ["ServingEndpoint", "serve_model"]
@@ -165,6 +168,18 @@ class ServingEndpoint:
                 return
 
     def _process(self, batch: List[ServingRequest]) -> None:
+        # the chip-fault seam (ISSUE 20): same dispatch-boundary
+        # contract as the shared scheduler — an injected chip fault
+        # fires BEFORE the predict, the batch goes back to the queue
+        # head with futures intact, the retried dispatch answers them
+        # bit-identically.  The single-endpoint topology has no
+        # failover driver; losslessness alone is the contract here.
+        try:
+            fault_point(DISPATCH_SCOPE)
+        except (InjectedChipDown, InjectedChipFlap):
+            self._batcher.requeue(batch)
+            self.metrics.on_requeue(len(batch))
+            return
         # ONE capture per batch: the hot-swap atomicity point.  Every
         # request below runs on this (immutable, fully warmed) version
         # even if a deploy publishes mid-predict.

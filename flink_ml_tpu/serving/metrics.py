@@ -94,6 +94,11 @@ class ServingMetrics:
         self.requests = self.group.counter("requests")
         self.batches = self.group.counter("batches")
         self.shed = self.group.counter("shed")
+        #: requests returned to the queue head after a chip fault at
+        #: the dispatch boundary (ISSUE 20) — futures intact, answered
+        #: by the retried dispatch; a nonzero count with zero drops is
+        #: the failover losslessness receipt
+        self.requeued = self.group.counter("requeued")
         #: failed hot-swaps healed by rolling back to the live generation
         self.rollbacks = self.group.counter("rollbacks")
         #: continuous-learning publish accounting (ISSUE 7): how the live
@@ -137,6 +142,11 @@ class ServingMetrics:
         #: folds, hot-swap generations) is visible per endpoint snapshot
         self._kernel_group = self.group.add_group("kernels")
         self._kernel_published = -1
+
+    def on_requeue(self, n: int = 1) -> None:
+        """``n`` of this tenant's in-flight requests went back to the
+        queue head after a chip fault (see ``requeued`` counter doc)."""
+        self.requeued.inc(n)
 
     def on_shed(self, queue_depth: int,
                 generation: Optional[int] = None) -> None:

@@ -73,6 +73,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..data.table import Table
 from ..obs.trace import tracer
+from ..robustness.faults import (InjectedChipDown, InjectedChipFlap,
+                                 fault_point)
+from ..robustness.retry import DeadlineExceededError
 from ..utils.metrics import MetricGroup
 from .batcher import (ServingOverloadedError, ServingRequest,
                       concat_request_tables)
@@ -84,6 +87,7 @@ log = logging.getLogger("flink_ml_tpu.serving")
 
 
 __all__ = [
+    "DISPATCH_SCOPE",
     "SLO_BULK",
     "SLO_CLASSES",
     "SLO_INTERACTIVE",
@@ -91,6 +95,14 @@ __all__ = [
     "SharedScheduler",
     "Tenant",
 ]
+
+#: the dispatch-boundary fault seam (ISSUE 20): fired at the TOP of
+#: ``_dispatch``, BEFORE the batch's predict runs — an injected
+#: ``chip_down``/``chip_flap`` there loses nothing (the picked requests
+#: requeue at the front of their tenants' queues with futures intact)
+#: and each dispatch is one deterministic invocation index, so seeded
+#: schedules replay exactly.
+DISPATCH_SCOPE = "serving.dispatch"
 
 
 #: SLO classes in strict priority order (dispatch AND shed order: the
@@ -157,6 +169,7 @@ class SharedScheduler:
                  queue_capacity: int = 1024,
                  admit_fractions: Optional[Dict[str, float]] = None,
                  bulk_batch_rows: Optional[int] = None,
+                 request_deadline_ms: Optional[float] = None,
                  group: Optional[MetricGroup] = None,
                  busy_clock: Optional[Any] = None):
         if max_batch_rows <= 0:
@@ -165,6 +178,9 @@ class SharedScheduler:
             raise ValueError("max_wait_ms must be >= 0")
         if queue_capacity <= 0:
             raise ValueError("queue_capacity must be positive")
+        if request_deadline_ms is not None and request_deadline_ms <= 0:
+            raise ValueError("request_deadline_ms must be positive "
+                             "(or None to disable the deadline check)")
         self.registry = registry or ModelRegistry()
         self.max_batch_rows = max_batch_rows
         self.max_wait_s = max_wait_ms / 1e3
@@ -211,6 +227,15 @@ class SharedScheduler:
         self.batch_rows = {SLO_INTERACTIVE: max_batch_rows,
                            SLO_STANDARD: max_batch_rows,
                            SLO_BULK: bulk_batch_rows}
+        #: SLO deadline in seconds (ISSUE 20): a REQUEUED request (a
+        #: chip died under its dispatch) already past this deadline
+        #: sheds with :class:`DeadlineExceededError` instead of burning
+        #: survivor capacity on an answer its caller stopped waiting
+        #: for.  None = never expire (the default; first-dispatch
+        #: requests are never deadline-checked — only the requeue path
+        #: can make a request old enough to matter).
+        self.request_deadline_s = (None if request_deadline_ms is None
+                                   else request_deadline_ms / 1e3)
 
         self.group = group or MetricGroup("scheduler")
         self._batches = self.group.counter("batches")
@@ -222,6 +247,23 @@ class SharedScheduler:
         #: class-labeled shed counters — the shed-order evidence
         self._shed = {slo: self.group.counter(f"shed_{slo}")
                       for slo in SLO_CLASSES}
+        #: brownout (ISSUE 20): level L sheds the bottom L SLO classes
+        #: at ADMISSION while failover has the fleet capacity-short —
+        #: bulk first, interactive protected by construction (the
+        #: ladder tops out below the highest class).  Plain int read by
+        #: the lock-free submit path, written by ``set_brownout``.
+        self._brownout = 0
+        self._brownout_gauge = self.group.gauge("brownout_level")
+        self._brownout_gauge.set(0)
+        #: requests put BACK at the head of their queues after an
+        #: injected chip fault at the dispatch boundary (futures intact
+        #: — the zero-drop evidence), and requests shed at requeue for
+        #: blowing their SLO deadline
+        self._requeued = self.group.counter("requeued_requests")
+        self._deadline_shed = self.group.counter("deadline_shed")
+        #: the attached failover driver (None until a FailoverDriver
+        #: binds itself) — the dispatch seam hands it chip faults
+        self._failover: Optional[Any] = None
         #: per-SLO-class queue depth gauges (ISSUE 17: the autoscale
         #: policy keys its pressure trigger on the INTERACTIVE depth,
         #: which the aggregate gauge hides under a bulk flood)
@@ -429,6 +471,14 @@ class SharedScheduler:
                 f"request has {rows} rows > the {tenant.slo!r} class's "
                 f"batch cap {self.batch_rows[tenant.slo]}; split it "
                 "client-side")
+        # brownout gate (ISSUE 20): while failover has the fleet
+        # capacity-short, level L refuses the bottom L classes outright
+        # — lock-free like the overload fast path, and accounted as a
+        # shed (it IS one, just triggered by capacity instead of depth)
+        brownout = self._brownout
+        if (brownout > 0 and self._class_rank(tenant.slo)
+                >= len(SLO_CLASSES) - brownout):
+            raise self._brownout_error(tenant, brownout)
         limit = self.admit_limits[tenant.slo]
         if self._depth >= limit:          # lock-free fast path
             raise self._shed_error(tenant, self._depth, limit)
@@ -468,6 +518,23 @@ class SharedScheduler:
             f"{tenant.slo!r} threshold of capacity "
             f"{self.queue_capacity}); request shed — queue full for this "
             "class; retry with backoff or lower the offered load")
+
+    def _brownout_error(self, tenant: Tenant,
+                        level: int) -> ServingOverloadedError:
+        """Account a brownout refusal exactly like an overload shed
+        (class counter, tenant metrics, DEGRADED, tracer) — the cause
+        differs (capacity short, not queue full), the contract does
+        not."""
+        self._shed[tenant.slo].inc()
+        generation = self.registry.live_generation(tenant.serve_name)
+        tenant.metrics.on_shed(len(tenant.pending), generation=generation)
+        self._health.set(HEALTH_DEGRADED)
+        tracer.instant("shed", cat="serving", tenant=tenant.name,
+                       generation=generation, x_brownout=str(level))
+        return ServingOverloadedError(
+            f"brownout level {level}: class {tenant.slo!r} is shed while "
+            "the serving fleet is capacity-short after a chip loss; "
+            "retry after the fleet recovers")
 
     # -- the scheduler loop --------------------------------------------------
     def _serve_loop(self) -> None:
@@ -572,8 +639,69 @@ class SharedScheduler:
         return serve_name, picked
 
     # -- dispatch ------------------------------------------------------------
+    def _requeue(self,
+                 picked: List[Tuple[Tenant, ServingRequest]]) -> int:
+        """Put a formed-but-undispatched batch BACK: each request
+        returns to the FRONT of its tenant's queue (reversed, so the
+        original order is restored), the WFQ tags and depth roll back,
+        and the futures stay untouched — the retried dispatch answers
+        them bit-identically, so a chip death drops ZERO requests.  A
+        requeued request already past its SLO deadline sheds with
+        :class:`DeadlineExceededError` instead (futures failed OUTSIDE
+        the lock).  Returns the number requeued."""
+        deadline_s = self.request_deadline_s
+        now = time.perf_counter() if deadline_s is not None else 0.0
+        expired: List[Tuple[Tenant, ServingRequest]] = []
+        requeued: Dict[str, int] = {}
+        with self._cond:
+            for tenant, request in reversed(picked):
+                # roll the WFQ advance back first — it happened in
+                # _drain_into for every picked request, served or not
+                tenant.vft -= request.rows / tenant.weight
+                if (deadline_s is not None
+                        and now - request.submitted_at > deadline_s):
+                    expired.append((tenant, request))
+                    continue
+                tenant.pending.appendleft(request)
+                self._depth += 1
+                requeued[tenant.name] = requeued.get(tenant.name, 0) + 1
+            if requeued:
+                self._cond.notify_all()
+        n = sum(requeued.values())
+        if n:
+            self._requeued.inc(n)
+        for name, count in requeued.items():
+            self._tenants[name].metrics.on_requeue(count)
+        for tenant, request in expired:
+            self._deadline_shed.inc()
+            self._shed[tenant.slo].inc()
+            generation = self.registry.live_generation(tenant.serve_name)
+            tenant.metrics.on_shed(len(tenant.pending),
+                                   generation=generation)
+            tracer.instant("deadline_shed", cat="serving",
+                           tenant=tenant.name, generation=generation,
+                           request_id=request.request_id)
+            request.future.set_exception(DeadlineExceededError(
+                f"request for tenant {tenant.name!r} requeued after a "
+                f"chip fault is already {now - request.submitted_at:.3f}s"
+                f" old > the {deadline_s:.3f}s SLO deadline; shed "
+                "instead of burning survivor capacity"))
+        return n
+
     def _dispatch(self, serve_name: str,
                   picked: List[Tuple[Tenant, ServingRequest]]) -> None:
+        # the chip-fault seam (ISSUE 20): fired BEFORE anything else —
+        # an injected chip_down/chip_flap here requeues the batch with
+        # futures intact (lossless by construction) and hands the fault
+        # to the attached FailoverDriver, which re-places and retries
+        try:
+            fault_point(DISPATCH_SCOPE)
+        except (InjectedChipDown, InjectedChipFlap) as exc:
+            requeued = self._requeue(picked)
+            driver = self._failover
+            if driver is not None:
+                driver.on_chip_fault(exc, requeued=requeued)
+            return
         # ONE registry capture per batch — the hot-swap atomicity point
         # (every request in the batch runs on one fully-warmed version).
         # Any failure before the futures resolve is delivered TO them:
@@ -646,9 +774,13 @@ class SharedScheduler:
         depth = self._depth
         self._queue_depth.set(depth)
         # heal: once the queue recedes below EVERY class threshold,
-        # nothing is being shed anymore — degradation is over
+        # nothing is being shed anymore — degradation is over.  An
+        # active brownout blocks the heal: admission is still refusing
+        # whole classes, so the scheduler IS degraded however shallow
+        # the queue looks
         if (self._health.value != HEALTH_SERVING
-                and depth < min(self.admit_limits.values())):
+                and depth < min(self.admit_limits.values())
+                and self._brownout == 0):
             self._health.set(HEALTH_SERVING)
 
     # -- placement (ISSUE 17) ------------------------------------------------
@@ -675,6 +807,33 @@ PlacementMap`: every placed tenant's WFQ weight becomes
                        generation=pmap.generation,
                        x_tenants=str(len(applied)))
         return applied
+
+    # -- failover (ISSUE 20) -------------------------------------------------
+    def attach_failover(self, driver: Any) -> None:
+        """Bind the :class:`~flink_ml_tpu.serving.failover.\
+FailoverDriver`: the dispatch seam hands it injected chip faults
+        (after requeueing the batch) and it drives ``set_brownout``."""
+        self._failover = driver
+
+    def set_brownout(self, level: int) -> int:
+        """Set the brownout ladder rung: level L sheds the bottom L SLO
+        classes at admission (0 = none).  Clamped so the highest class
+        can NEVER be browned out — interactive protection is by
+        construction, not configuration.  Lowering to 0 re-checks the
+        heal condition (brownout blocks it while active)."""
+        level = max(0, min(int(level), len(SLO_CLASSES) - 1))
+        self._brownout = level
+        self._brownout_gauge.set(level)
+        if level > 0:
+            self._health.set(HEALTH_DEGRADED)
+        elif (self._health.value != HEALTH_SERVING
+                and self._depth < min(self.admit_limits.values())):
+            self._health.set(HEALTH_SERVING)
+        return level
+
+    @property
+    def brownout_level(self) -> int:
+        return self._brownout
 
     # -- observability -------------------------------------------------------
     @property

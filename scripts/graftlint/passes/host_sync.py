@@ -74,6 +74,14 @@ SCAN_CALLEES = {"scan", "masked_chunk_scan", "while_loop", "fori_loop"}
 #: multiplexed serve loop exactly like one in ``serving/`` would; index
 #: BUILD is host-side numpy by design, but it runs at build/re-anchor
 #: time, never inside the dispatched search)
+#: (``serving/failover.py`` rides the existing ``serving/`` root with
+#: ISSUE 20: the failover driver's requeue + re-placement runs INLINE
+#: on the scheduler's one serve loop when a dispatch-boundary fault
+#: fires — a host sync in a step-shaped helper there would stall every
+#: tenant's traffic during the exact window the failover exists to keep
+#: short, and the lease table's poll shares the loop's cadence; the
+#: visits self-test in tests/test_graftlint.py pins the module into
+#: both this pass's and lock-discipline's walks)
 SCAN_ROOTS = (
     "flink_ml_tpu/autoscale",
     "flink_ml_tpu/iteration",

@@ -1,0 +1,465 @@
+"""Serving fleet failover tests (ISSUE 20): the chip-lease health table
+(expiry on the injected clock, zombie-heartbeat suppression, seeded
+chip_down/chip_flap translation with LIFO victims and deterministic flap
+recovery), the FailoverDriver end to end (dispatch-boundary fault ->
+lossless requeue -> CAS re-placement -> re-admission generation bump),
+the SLO-aware brownout ladder with hysteresis, deadline-aware requeue
+(DeadlineExceededError is fatal-not-retryable), N-way replication (a
+replicated tenant's failover window is one dispatch, no re-warm), the
+restore-after-hysteresis flap-thrash bound, and the metrics-tree
+surface."""
+
+import time
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.autoscale.placement import PlacementStore
+from flink_ml_tpu.obs.tree import default_tree
+from flink_ml_tpu.robustness.faults import (FaultPlan, InjectedChipDown,
+                                            InjectedChipFlap)
+from flink_ml_tpu.robustness.retry import (DeadlineExceededError,
+                                           RetryPolicy, default_classify)
+from flink_ml_tpu.serving import (
+    CHIP_SCOPE,
+    DISPATCH_SCOPE,
+    SLO_BULK,
+    SLO_CLASSES,
+    SLO_INTERACTIVE,
+    SLO_STANDARD,
+    FailoverDriver,
+    FleetHealth,
+    ModelRegistry,
+    ServingOverloadedError,
+    SharedScheduler,
+)
+from flink_ml_tpu.serving.metrics import HEALTH_SERVING
+
+
+# -- fixtures (the test_scheduler stubs, kept local) -------------------------
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class _StubServable:
+    """Echo servable: queue/placement mechanics without model fits."""
+
+    ready = True
+    warmup_report = None
+
+    def __init__(self, model, example, **kwargs):
+        self.model = model
+        self.example = example
+        self.max_batch_rows = kwargs.get("max_batch_rows", 256)
+        self.min_bucket = kwargs.get("min_bucket", 8)
+        self.output_cols = None
+
+    def warm_up(self):
+        return self
+
+    def check_schema(self, table):
+        pass
+
+    def bucket_for(self, rows):
+        return max(8, rows)
+
+    def predict(self, table):
+        return table
+
+
+def _stub_scheduler(**kwargs):
+    return SharedScheduler(ModelRegistry(servable_factory=_StubServable),
+                           **kwargs)
+
+
+def _feats(n=256, seed=1):
+    rng = np.random.default_rng(seed)
+    return Table({"features": rng.normal(size=(n, 8))})
+
+
+def _drain(scheduler, max_batches=10_000):
+    batches = 0
+    while batches < max_batches:
+        formed = scheduler._next_batch(timeout=0.0)
+        if formed is None:
+            return batches
+        scheduler._dispatch(*formed)
+        batches += 1
+    raise AssertionError("drain did not converge")
+
+
+def _fleet(chips, placements, tenants, *, clock=None, **driver_kw):
+    """A scheduler + placement store + driver wired like production:
+    tenants admitted, initial placement published, driver attached."""
+    clock = clock or FakeClock()
+    s = _stub_scheduler(max_batch_rows=8, max_wait_ms=0.0,
+                        queue_capacity=4096)
+    feats = _feats()
+    for name, slo in tenants:
+        s.add_tenant(name, object(), feats.take(2), slo=slo)
+    store = PlacementStore(max(chips) + 1)
+    store.publish(placements, 0)
+    driver = FailoverDriver(s, store, chips=chips, clock=clock,
+                            **driver_kw)
+    return s, store, driver, clock
+
+
+# -- FleetHealth: the chip lease table ---------------------------------------
+
+def test_lease_expiry_detects_silent_death_on_injected_clock():
+    """Chips that miss heartbeats past lease_timeout_s are reaped
+    deterministically; a heartbeat keeps its chip alive."""
+    clock = FakeClock()
+    h = FleetHealth([0, 1, 2], lease_timeout_s=5.0, clock=clock)
+    clock.advance(3.0)
+    assert h.heartbeat(0)
+    clock.advance(3.0)              # t=6: chips 1,2 lapsed at 5; 0 at 8
+    assert h.expire() == [1, 2]
+    assert h.live() == [0]
+    assert h.down() == [1, 2]
+    snap = h.snapshot()
+    assert snap["expiries"] == 2 and snap["deaths"] == 2
+    assert h.epoch == 2
+    assert [k for k, _, _ in h.transitions] == ["expired", "expired"]
+
+
+def test_heartbeat_from_declared_dead_chip_is_suppressed():
+    """A zombie cannot out-race the reaper: its heartbeat is counted,
+    not honored — it must come back through recover()."""
+    h = FleetHealth([0, 1], clock=FakeClock())
+    assert h.fail(1)
+    assert not h.heartbeat(1)
+    assert h.down() == [1]
+    assert h.snapshot()["suppressed"] == 1
+    assert not h.fail(1)            # already down: no double-death
+    assert h.recover(1)
+    assert h.live() == [0, 1]
+    assert h.snapshot()["recoveries"] == 1
+
+
+def test_poll_translates_seeded_chip_down_to_lifo_victim():
+    """A seeded chip_down fires at a deterministic poll index and kills
+    the newest lease; the whole transition log replays bit-identically
+    under the same plan."""
+    def run():
+        h = FleetHealth([0, 1, 2], clock=FakeClock())
+        with FaultPlan(seed=3).inject(CHIP_SCOPE, at=1, kind="chip_down"):
+            events = [h.poll() for _ in range(3)]
+        return h, events
+
+    h, events = run()
+    assert events == [[], [("down", 2)], []]
+    assert h.down() == [2]
+    assert h.transitions == [("down", 2, 1)]
+    h2, events2 = run()
+    assert events2 == events and h2.transitions == h.transitions
+
+
+def test_chip_flap_recovers_after_scheduled_polls():
+    """chip_flap schedules its own recovery a deterministic number of
+    polls later — the flap model needs no wall clock at all."""
+    h = FleetHealth([0, 1], clock=FakeClock(), flap_recovery_polls=2)
+    with FaultPlan().inject(CHIP_SCOPE, at=0, kind="chip_flap"):
+        assert h.poll() == [("down", 1)]
+    assert h.down() == [1]
+    assert h.poll() == [("up", 1)]
+    assert h.live() == [0, 1]
+    snap = h.snapshot()
+    assert snap["flaps"] == 1 and snap["recoveries"] == 1
+    assert [k for k, _, _ in h.transitions] == ["flap_down", "up"]
+
+
+def test_fleet_health_validates_construction():
+    with pytest.raises(ValueError):
+        FleetHealth([])
+    with pytest.raises(ValueError):
+        FleetHealth([0], lease_timeout_s=0.0)
+    with pytest.raises(ValueError):
+        FleetHealth([0], flap_recovery_polls=0)
+
+
+# -- the failover itself -----------------------------------------------------
+
+def test_dispatch_chip_fault_is_lossless_and_replaces_tenants():
+    """The core contract: an injected chip death at the dispatch
+    boundary requeues the picked batch (futures intact -> every request
+    answered), evicts the dead chip through one CAS publish on the
+    shared generation stream, moves its sole-placement tenant to the
+    least-loaded survivor with a registry generation bump (the
+    re-anchor signal), and raises the brownout."""
+    s, store, driver, _ = _fleet(
+        [0, 1, 2, 3],
+        {"inter": [0, 3], "std": [3], "bulk": [1]},
+        [("inter", SLO_INTERACTIVE), ("std", SLO_STANDARD),
+         ("bulk", SLO_BULK)])
+    gen0 = store.generation
+    std_gen = s.registry.current("std").generation
+    inter_gen = s.registry.current("inter").generation
+    feats = _feats()
+    futures = []
+    for i in range(4):
+        futures.append(s.submit("inter", feats.slice(4 * i, 4 * i + 4)))
+        futures.append(s.submit("std", feats.slice(32 + 4 * i,
+                                                   36 + 4 * i)))
+    with FaultPlan().inject(DISPATCH_SCOPE, at=0, kind="chip_down"):
+        _drain(s)
+
+    # zero drops: every future resolved with its own rows echoed back
+    for fut in futures:
+        assert fut.result(timeout=0).num_rows == 4
+    assert len(driver.reports) == 1
+    rep = driver.reports[0]
+    assert rep.dead_chips == (3,)       # LIFO victim: newest lease
+    assert rep.cause == "dispatch"
+    assert rep.requeued > 0
+    assert rep.conflicts == 0
+    assert set(rep.replicated) == {"inter"}
+    assert set(rep.moved) == {"std"}
+
+    pmap = store.current()
+    assert pmap.generation == gen0 + 1 == rep.generation
+    assert set(pmap.chips_for("inter")) == {0}      # survivors kept
+    assert 3 not in pmap.chips_for("std")
+    assert len(pmap.chips_for("std")) == 1
+    # re-admission stamped a fresh generation for the MOVED tenant only
+    assert s.registry.current("std").generation == std_gen + 1
+    assert s.registry.current("inter").generation == inter_gen
+    # 1/4 of the fleet down -> brownout level 1: bulk shed at admission,
+    # standard and interactive still admitted
+    assert driver.brownout_level == 1 and s.brownout_level == 1
+    with pytest.raises(ServingOverloadedError, match="brownout"):
+        s.submit("bulk", feats.take(4))
+    fut = s.submit("inter", feats.take(4))
+    _drain(s)
+    assert fut.result(timeout=0).num_rows == 4
+
+
+def test_brownout_ladder_raises_immediately_lowers_with_hysteresis():
+    """Level tracks the capacity deficit: raising is immediate on the
+    tick that sees the loss, lowering dwells hysteresis_s of stable
+    fleet — and the top class NEVER sheds at any rung."""
+    s, store, driver, clock = _fleet(
+        [0, 1, 2, 3], {"inter": [0], "std": [1], "bulk": [2]},
+        [("inter", SLO_INTERACTIVE), ("std", SLO_STANDARD),
+         ("bulk", SLO_BULK)],
+        hysteresis_s=30.0)
+    feats = _feats()
+    assert driver.brownout_level == 0
+    fut = s.submit("bulk", feats.take(4))
+    _drain(s)
+    assert fut.result(timeout=0).num_rows == 4
+
+    driver.health.fail(3)
+    driver.tick()                       # deficit 1/4 -> level 1
+    assert driver.brownout_level == 1
+    with pytest.raises(ServingOverloadedError):
+        s.submit("bulk", feats.take(4))
+    fut = s.submit("std", feats.take(4))
+    _drain(s)
+    assert fut.result(timeout=0).num_rows == 4
+
+    driver.health.fail(2)
+    driver.tick()                       # deficit 1/2 -> level 2
+    assert driver.brownout_level == 2
+    with pytest.raises(ServingOverloadedError):
+        s.submit("std", feats.take(4))
+    fut = s.submit("inter", feats.take(4))   # interactive: protected
+    _drain(s)
+    assert fut.result(timeout=0).num_rows == 4
+
+    driver.health.recover(2)
+    driver.health.recover(3)
+    driver.tick()                       # target 0: starts the dwell
+    assert driver.brownout_level == 2   # ... but holds through it
+    clock.advance(30.0)
+    driver.tick()
+    assert driver.brownout_level == 0 and s.brownout_level == 0
+    assert s.health == HEALTH_SERVING   # brownout end releases the heal
+
+
+def test_set_brownout_clamps_to_protect_the_top_class():
+    s = _stub_scheduler()
+    assert s.set_brownout(99) == len(SLO_CLASSES) - 1
+    assert s.set_brownout(-5) == 0
+    assert s.brownout_level == 0
+
+
+def test_driver_validates_brownout_rungs():
+    s = _stub_scheduler()
+    store = PlacementStore(2)
+    store.publish({}, 0)
+    with pytest.raises(ValueError, match="non-decreasing"):
+        FailoverDriver(s, store, chips=[0, 1],
+                       brownout_deficits=(0.5, 0.25))
+    with pytest.raises(ValueError, match="rungs"):
+        FailoverDriver(s, store, chips=[0, 1],
+                       brownout_deficits=(0.1, 0.2, 0.3))
+
+
+# -- deadline-aware requeue --------------------------------------------------
+
+def test_requeue_within_deadline_is_lossless():
+    """A requeued request inside its SLO deadline goes back to the
+    FRONT of its tenant's queue and is served bit-identically."""
+    s = _stub_scheduler(max_batch_rows=8, max_wait_ms=0.0,
+                        request_deadline_ms=10_000.0)
+    feats = _feats()
+    s.add_tenant("t", object(), feats.take(2), slo=SLO_INTERACTIVE)
+    fut = s.submit("t", feats.take(4))
+    formed = s._next_batch(timeout=0.0)
+    assert formed is not None
+    assert s._requeue(formed[1]) == 1
+    assert s.tenant("t").metrics.requeued.value == 1
+    _drain(s)
+    out = fut.result(timeout=0)
+    assert np.array_equal(out["features"], feats.take(4)["features"])
+
+
+def test_requeue_past_deadline_sheds_with_fatal_error():
+    """A requeued request already past its deadline sheds with
+    DeadlineExceededError instead of burning survivor capacity — and
+    the classifier refuses to retry it even though it IS a
+    TimeoutError."""
+    s = _stub_scheduler(max_batch_rows=8, max_wait_ms=0.0,
+                        request_deadline_ms=1.0)
+    feats = _feats()
+    s.add_tenant("t", object(), feats.take(2), slo=SLO_INTERACTIVE)
+    fut = s.submit("t", feats.take(4))
+    formed = s._next_batch(timeout=0.0)
+    time.sleep(0.01)                    # blow the 1ms deadline
+    assert s._requeue(formed[1]) == 0
+    with pytest.raises(DeadlineExceededError) as ei:
+        fut.result(timeout=0)
+    assert default_classify(ei.value) is False
+    assert isinstance(ei.value, TimeoutError)
+    assert s._deadline_shed.value == 1
+    assert s.shed_counts()[SLO_INTERACTIVE] == 1
+    assert _drain(s) == 0               # queue is empty: truly shed
+
+
+def test_scheduler_validates_request_deadline():
+    with pytest.raises(ValueError):
+        _stub_scheduler(request_deadline_ms=0.0)
+
+
+# -- retry classification (ISSUE 20 satellite) -------------------------------
+
+def test_deadline_exceeded_outranks_timeout_retryability():
+    assert default_classify(TimeoutError("transient")) is True
+    assert default_classify(DeadlineExceededError("past SLO")) is False
+
+    class ForeignDeadline(Exception):
+        deadline_exceeded = True        # the marker, not the class
+
+    assert default_classify(ForeignDeadline()) is False
+
+
+def test_retry_policy_never_resurrects_a_dead_deadline():
+    policy = RetryPolicy(max_attempts=5, sleep=lambda s: None)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise DeadlineExceededError("answer is worthless now")
+
+    with pytest.raises(DeadlineExceededError):
+        policy.call(fn)
+    assert len(calls) == 1              # ONE attempt: fatal, no retries
+    assert policy.retries == 0 and policy.slept == []
+
+
+# -- replication -------------------------------------------------------------
+
+def test_replicated_tenant_fails_over_in_one_dispatch():
+    """ensure_replicas grows the placement to n distinct least-loaded
+    chips; on a chip loss the replicated tenant keeps a survivor —
+    no move, no re-admission, no registry generation bump (its failover
+    window is one dispatch, not one re-warm)."""
+    s, store, driver, _ = _fleet(
+        [0, 1, 2], {"hot": [2], "cold": [0]},
+        [("hot", SLO_INTERACTIVE), ("cold", SLO_STANDARD)])
+    pmap = driver.ensure_replicas("hot", 2)
+    assert set(pmap.chips_for("hot")) == {1, 2}     # least-loaded added
+    gen_after_replicas = store.generation
+    assert driver.ensure_replicas("hot", 2) is store.current()
+    assert store.generation == gen_after_replicas   # idempotent: no publish
+    hot_gen = s.registry.current("hot").generation
+
+    rep = driver.on_chip_fault(InjectedChipDown("injected chip death"))
+    assert rep is not None
+    assert rep.dead_chips == (2,)
+    assert rep.replicated == ("hot",) and rep.moved == ()
+    assert set(store.current().chips_for("hot")) == {1}
+    assert set(store.current().chips_for("cold")) == {0}
+    # the whole point of replication: NO re-admission happened
+    assert s.registry.current("hot").generation == hot_gen
+
+
+def test_ensure_replicas_validates_count():
+    s, store, driver, _ = _fleet(
+        [0, 1], {"t": [0]}, [("t", SLO_INTERACTIVE)])
+    with pytest.raises(ValueError):
+        driver.ensure_replicas("t", 0)
+
+
+# -- flap thrash bound + restore ---------------------------------------------
+
+def test_flap_costs_one_move_per_stability_window_then_restores():
+    """A flapping chip: ONE eviction publish when it dies, ZERO restores
+    while it is unstable, one restore publish once it has stayed live
+    hysteresis_s — and the brownout settles back to 0 with it."""
+    clock = FakeClock()
+    s, store, driver, _ = _fleet(
+        [0, 1, 2], {"a": [2], "b": [0]},
+        [("a", SLO_INTERACTIVE), ("b", SLO_STANDARD)],
+        clock=clock, hysteresis_s=20.0, flap_recovery_polls=2)
+    with FaultPlan().inject(CHIP_SCOPE, at=0, kind="chip_flap"):
+        rep = driver.tick()
+    assert rep is not None and rep.dead_chips == (2,)
+    assert rep.moved == ("a",)
+    gen_evict = store.generation
+    assert set(store.current().chips_for("a")) == {1}
+    assert driver.brownout_level == 1
+
+    assert driver.tick() is None        # flap recovery: chip 2 rejoins
+    assert driver.health.live() == [0, 1, 2]
+    assert store.generation == gen_evict            # no restore yet
+    clock.advance(10.0)
+    driver.tick()
+    assert store.generation == gen_evict            # still dwelling
+    assert driver.brownout_level == 1               # lowering dwells too
+    clock.advance(10.0)
+    driver.tick()                       # 20s stable: restore + level 0
+    assert store.generation == gen_evict + 1
+    assert set(store.current().chips_for("a")) == {2}
+    assert driver.snapshot()["restores"] == 1
+    assert driver.brownout_level == 0
+    assert driver.snapshot()["evicted_chips_pending_restore"] == 0
+
+
+# -- observability -----------------------------------------------------------
+
+def test_default_tree_exposes_failover_fleet_view():
+    s, store, driver, _ = _fleet(
+        [0, 1, 2], {"t": [0]}, [("t", SLO_INTERACTIVE)])
+    tree = default_tree(failover=driver)
+    snap = tree.snapshot()
+    assert snap["failover"]["chips_live"] == 3
+    assert snap["failover"]["chips_down"] == 0
+    assert snap["failover"]["brownout_level"] == 0
+    driver.on_chip_fault(InjectedChipFlap("injected flap"))
+    snap = tree.snapshot()
+    assert snap["failover"]["chips_live"] == 2
+    assert snap["failover"]["chips_down"] == 1
+    assert snap["failover"]["failovers"] == 1
+    assert snap["failover"]["chips_lost"] == 1
+    assert snap["failover"]["last_failover_wall_s"] >= 0.0

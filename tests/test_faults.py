@@ -1894,3 +1894,234 @@ def test_corrupt_retrieve_aot_entry_quarantines_and_recompiles_same_neighbors(
         assert any(".corrupt" in n for n in os.listdir(exec_root))
     finally:
         aot.set_cache(None)
+
+
+# -- serving fleet failover chaos (ISSUE 20) ---------------------------------
+# the failover PR's chaos acceptance, all on seeded FaultPlans: a chip
+# death at the dispatch boundary mid-sweep drops ZERO requests and the
+# retried answers are bit-identical with an unfailed sweep; a chip
+# death while a delta cut is in flight heals through the publisher's
+# drift re-anchor; an autoscale publish racing the failover's CAS
+# resolves through exactly one PlacementConflict retry.
+
+def _fo_drain(scheduler, max_batches=10_000):
+    """Inline serve loop: form + dispatch until the queues are empty
+    (deterministic — no background thread, no wall clock)."""
+    batches = 0
+    while batches < max_batches:
+        formed = scheduler._next_batch(timeout=0.0)
+        if formed is None:
+            return batches
+        scheduler._dispatch(*formed)
+        batches += 1
+    raise AssertionError("drain did not converge")
+
+
+def test_fault_plan_chip_kinds_fire_and_randomize_deterministically():
+    """chip_down/chip_flap are first-class schedulable kinds: explicit
+    schedules raise their own exception types, and ``inject_random``'s
+    seeded schedule for a chip kind replays identically (same seed,
+    same deaths) while keying on the KIND — a chip_down plan is not a
+    transient plan wearing a different label."""
+    from flink_ml_tpu.robustness.faults import (InjectedChipDown,
+                                                InjectedChipFlap)
+    from flink_ml_tpu.serving import CHIP_SCOPE
+
+    plan = (FaultPlan().inject(CHIP_SCOPE, at=0, kind="chip_down")
+            .inject(CHIP_SCOPE, at=1, kind="chip_flap"))
+    with pytest.raises(InjectedChipDown):
+        plan.fire(CHIP_SCOPE)
+    with pytest.raises(InjectedChipFlap):
+        plan.fire(CHIP_SCOPE)
+    assert plan.fires == [(CHIP_SCOPE, 0, "chip_down"),
+                          (CHIP_SCOPE, 1, "chip_flap")]
+
+    def deaths(seed):
+        return FaultPlan(seed=seed).inject_random(
+            CHIP_SCOPE, rate=0.15, horizon=60,
+            kind="chip_down").scheduled(CHIP_SCOPE)
+
+    assert deaths(11) == deaths(11)
+    assert deaths(11) != deaths(12)
+    assert 0 < len(deaths(11)) < 60
+    assert all(kind == "chip_down" for _, kind in deaths(11))
+    # the kind participates in the schedule derivation: the same seed's
+    # transient schedule lands on different indices
+    transients = FaultPlan(seed=11).inject_random(
+        CHIP_SCOPE, rate=0.15, horizon=60).scheduled(CHIP_SCOPE)
+    assert [i for i, _ in transients] != [i for i, _ in deaths(11)]
+
+
+def test_chip_death_mid_sweep_drops_nothing_and_answers_bitexact():
+    """THE ISSUE 20 chaos acceptance, half one: a seeded chip_down at
+    the dispatch boundary mid-sweep.  Every in-flight request is
+    requeued with its future intact and re-served by the survivor —
+    zero drops, and every answer is bit-identical with the unfailed
+    sweep (the requeue replays the same rows through the same compiled
+    programs; a chip move never perturbs the math)."""
+    from flink_ml_tpu.autoscale.placement import PlacementStore
+    from flink_ml_tpu.serving import (DISPATCH_SCOPE, SLO_INTERACTIVE,
+                                      SLO_STANDARD, FailoverDriver,
+                                      SharedScheduler)
+
+    model_rt, model_batch = _fit_lr(seed=0), _fit_lr(seed=1)
+    feats = _lr_table(n=96, seed=7).drop("label")
+    requests = [feats.slice(8 * i, 8 * i + 8) for i in range(12)]
+
+    def sweep(plan=None):
+        s = SharedScheduler(max_batch_rows=16, max_wait_ms=0.0,
+                            queue_capacity=4096)
+        s.add_tenant("rt", model_rt, feats.take(2), slo=SLO_INTERACTIVE)
+        s.add_tenant("batch", model_batch, feats.take(2),
+                     slo=SLO_STANDARD)
+        store = PlacementStore(2)
+        store.publish({"rt": [0], "batch": [1]}, 0)
+        driver = FailoverDriver(s, store, chips=[0, 1])
+        futures = [s.submit("rt" if i % 2 == 0 else "batch", req)
+                   for i, req in enumerate(requests)]
+        if plan is None:
+            _fo_drain(s)
+        else:
+            with plan:
+                _fo_drain(s)
+        return s, store, driver, [f.result(timeout=0) for f in futures]
+
+    _, _, _, ref = sweep()
+
+    plan = FaultPlan(seed=20).inject(DISPATCH_SCOPE, at=1,
+                                     kind="chip_down")
+    s, store, driver, outs = sweep(plan)
+    assert plan.fires == [(DISPATCH_SCOPE, 1, "chip_down")]
+    assert len(driver.reports) == 1
+    rep = driver.reports[0]
+    assert rep.dead_chips == (1,)       # LIFO victim: the newest lease
+    assert rep.cause == "dispatch"
+    assert rep.requeued > 0
+    assert s._requeued.value == rep.requeued
+    assert s._deadline_shed.value == 0  # nothing aged out of its SLO
+    assert rep.moved == ("batch",)
+    assert store.current().chips_for("batch") == (0,)
+    # zero drops, bit-identical: every future answered with the exact
+    # bits the unfailed sweep produced
+    assert len(outs) == len(ref) == len(requests)
+    for got, want in zip(outs, ref):
+        assert got.column_names == want.column_names
+        for col in got.column_names:
+            np.testing.assert_array_equal(np.asarray(got[col]),
+                                          np.asarray(want[col]))
+
+
+def test_chip_death_between_delta_cut_and_publish_reanchors():
+    """THE ISSUE 20 chaos acceptance, half two: a chip dies while a
+    delta cut is in flight (encoded, not yet published).  The failover
+    re-admits the moved tenant under a fresh registry generation; the
+    publisher's next apply() sees the drift, re-anchors its base on the
+    re-admitted generation, and the pending delta lands cleanly on top
+    — no divergent bits, no stuck publisher, idempotent by the same
+    digest discipline every other heal in this file rides."""
+    from flink_ml_tpu.autoscale.placement import PlacementStore
+    from flink_ml_tpu.online import DeltaEncoder, params_of_model
+    from flink_ml_tpu.robustness.faults import InjectedChipDown
+    from flink_ml_tpu.serving import (SLO_INTERACTIVE, FailoverDriver,
+                                      SharedScheduler)
+
+    model = _fit_lr(seed=0)
+    feats = _lr_table(seed=5).drop("label")
+    s = SharedScheduler(max_batch_rows=32, max_wait_ms=0.0)
+    s.add_tenant("t", model, feats.take(2), slo=SLO_INTERACTIVE)
+    store = PlacementStore(2)
+    store.publish({"t": [1]}, 0)
+    driver = FailoverDriver(s, store, chips=[0, 1])
+
+    pub = s.delta_publisher("t")
+    enc = DeltaEncoder()
+    p0 = params_of_model(model)
+    p1 = {"w": (p0["w"] * np.float32(1.25)).astype(np.float32),
+          "b": p0["b"]}
+    res1 = pub.apply(enc.encode(1, p1, pub.stats))
+    enc.ack()
+    assert res1.mode == "full"
+    gen1 = s.registry.current("t").generation
+    assert gen1 == res1.generation
+
+    # the step-2 cut is encoded — in flight — when its chip dies.
+    # Sparse on purpose: one touched coefficient keeps the payload
+    # under the staleness policy's full_ratio, so the cut IS a delta
+    w2 = p1["w"].copy()
+    w2[0] += np.float32(0.5)
+    p2 = {"w": w2, "b": p1["b"]}
+    update2 = enc.encode(2, p2, pub.stats)
+    rep = driver.on_chip_fault(InjectedChipDown("died mid-publish"))
+    assert rep is not None and rep.dead_chips == (1,)
+    assert rep.moved == ("t",)
+    assert store.current().chips_for("t") == (0,)
+    gen_readmit = s.registry.current("t").generation
+    assert gen_readmit == gen1 + 1      # re-admission stamped the move
+
+    res2 = pub.apply(update2)
+    enc.ack()
+    assert res2.mode == "delta"         # the delta survived the move
+    assert res2.generation == gen_readmit + 1
+    served = params_of_model(s.registry.current("t").servable.model)
+    np.testing.assert_array_equal(served["w"], p2["w"])
+    np.testing.assert_array_equal(served["b"], p2["b"])
+    # and the tenant still answers on the healed generation
+    fut = s.submit("t", feats.take(4))
+    _fo_drain(s)
+    assert fut.result(timeout=0).num_rows == 4
+
+
+def test_autoscale_publish_racing_failover_resolves_in_one_retry():
+    """THE ISSUE 20 chaos acceptance, half three: an autoscale tick's
+    placement publish lands between the failover's read of the current
+    map and its conditional publish.  The shared generation stream
+    turns that into exactly one PlacementConflict retry — the driver
+    re-derives the eviction against the racer's map and the second
+    publish wins; neither writer clobbers, the dead chip's tenant still
+    moves, and the racer's own edit survives."""
+    from flink_ml_tpu.autoscale.placement import PlacementStore
+    from flink_ml_tpu.robustness.faults import InjectedChipDown
+    from flink_ml_tpu.serving import (SLO_INTERACTIVE, FailoverDriver,
+                                      SharedScheduler)
+
+    class RacingStore(PlacementStore):
+        """Injects ONE out-of-band publish (the autoscale tick re-deriving
+        the learner extent) between a CAS caller's read and its
+        conditional publish — the deterministic rendering of the race."""
+
+        raced = 0
+
+        def publish(self, servables, learner_workers, *,
+                    expected_generation=None):
+            if expected_generation is not None and not self.raced:
+                self.raced += 1
+                cur = self.current()
+                PlacementStore.publish(self, dict(cur.servables),
+                                       cur.learner_workers + 1)
+            return PlacementStore.publish(
+                self, servables, learner_workers,
+                expected_generation=expected_generation)
+
+    model = _fit_lr(seed=0)
+    feats = _lr_table(seed=5).drop("label")
+    s = SharedScheduler(max_batch_rows=32, max_wait_ms=0.0)
+    s.add_tenant("x", model, feats.take(2), slo=SLO_INTERACTIVE)
+    store = RacingStore(3)
+    # "y" is placed but never admitted (another process's tenant): the
+    # re-placement must carry it anyway — placement is fleet state, not
+    # this scheduler's private view
+    store.publish({"x": [2], "y": [0]}, 0)
+    gen0 = store.generation
+    driver = FailoverDriver(s, store, chips=[0, 1, 2])
+
+    rep = driver.on_chip_fault(InjectedChipDown("death under the tick"))
+    assert rep is not None
+    assert store.raced == 1
+    assert rep.conflicts == 1 and driver.conflicts == 1
+    pmap = store.current()
+    assert pmap.generation == gen0 + 2  # racer's publish + the retry
+    assert rep.generation == pmap.generation
+    assert pmap.chips_for("x") == (1,)  # least-loaded live survivor
+    assert pmap.chips_for("y") == (0,)  # the racer's view preserved...
+    assert pmap.learner_workers == 1    # ...including its own edit
+    assert s.brownout_level == 1        # capacity loss still accounted

@@ -1296,6 +1296,40 @@ def test_kernels_modules_visited_by_host_sync():
         assert HostSyncPass().check_module(mod, project) == []
 
 
+def test_failover_module_visited_by_lock_and_host_sync_passes():
+    """ISSUE 20: ``serving/failover.py`` joined both scanned surfaces
+    through the existing roots (``flink_ml_tpu/serving`` for host-sync,
+    the whole package for lock-discipline).  Assert the walks genuinely
+    VISIT the module (a root that matches nothing keeps a rule from
+    ever firing — the visits-the-modules stance) and that it is clean
+    under both: the failover driver's requeue + re-placement runs
+    INLINE on the scheduler's one serve loop when a dispatch fault
+    fires, so a host sync there would stall every tenant during the
+    exact window the failover exists to keep short, and the lease
+    table computes under its lock but fires tracer instants and
+    recoveries outside it."""
+    from scripts.graftlint.passes.host_sync import SCAN_ROOTS
+
+    assert "flink_ml_tpu/serving" in SCAN_ROOTS
+    assert "flink_ml_tpu" in LockDisciplinePass.roots
+    rel = os.path.join("flink_ml_tpu", "serving", "failover.py")
+    project = Project(repo=REPO)
+    sync_visited = {
+        os.path.relpath(m.path, REPO)
+        for m in project.iter_modules(
+            [os.path.join(REPO, r) for r in SCAN_ROOTS])}
+    assert rel in sync_visited, "host-sync never visits failover.py"
+    lock_visited = {
+        os.path.relpath(m.path, REPO)
+        for m in project.iter_modules(
+            [os.path.join(REPO, r) for r in LockDisciplinePass.roots])}
+    assert rel in lock_visited, \
+        "lock-discipline never visits failover.py"
+    mod = project.module(os.path.join(REPO, rel))
+    assert HostSyncPass().check_module(mod, project) == []
+    assert LockDisciplinePass().check_module(mod, project) == []
+
+
 def test_retrieval_modules_visited_by_host_sync():
     """ISSUE 19: ``flink_ml_tpu/retrieval/`` joined the host-sync scan —
     the fused retrieve stage traces into every index tenant's serving
